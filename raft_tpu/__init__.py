@@ -1,0 +1,41 @@
+"""raft_tpu: a TPU-native reusable ML/analytics primitives framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of RAFT
+(RAPIDS Analytics Framework Toolkit): dense/sparse linear algebra,
+pairwise distances, k-NN, clustering (spectral / hierarchical), solvers,
+RNG, and a multi-device communicator abstraction — built TPU-first:
+
+- MXU-shaped compute: distances and contractions lower to large batched
+  matmuls or Pallas kernels, bfloat16/float32 on the systolic array.
+- SPMD over device meshes: ``jax.sharding.Mesh`` + ``shard_map`` with XLA
+  collectives replaces the reference's NCCL/UCX/MPI communicator
+  (reference: cpp/include/raft/comms/).
+- Functional, jit-compatible APIs: primitives are pure functions over JAX
+  arrays; the ``Handle`` carries device/mesh/comms resources the way the
+  reference's ``raft::handle_t`` carries streams and vendor-library handles
+  (reference: cpp/include/raft/handle.hpp:49).
+
+Layout (mirrors the reference's module inventory, see SURVEY.md section 2):
+
+- ``raft_tpu.core``     — handle, errors, tracing, integer/pow2 utilities
+- ``raft_tpu.linalg``   — gemm/gemv/eig/svd/qr, reductions, norms, lanczos
+- ``raft_tpu.matrix``   — matrix manipulation + math helpers
+- ``raft_tpu.stats``    — mean/stddev/sum/mean_center
+- ``raft_tpu.random``   — Rng with the reference's distribution set
+- ``raft_tpu.distance`` — pairwise distances (15+ metrics), fused_l2_nn
+- ``raft_tpu.spatial``  — brute-force / fused kNN, select_k, ball cover, ANN
+- ``raft_tpu.sparse``   — COO/CSR, conversions, ops, distances, kNN, MST,
+                          single-linkage hierarchy
+- ``raft_tpu.spectral`` — Laplacian/modularity operators, eigen + cluster
+                          solvers, partition, modularity maximization
+- ``raft_tpu.label``    — label relabeling / merging utilities
+- ``raft_tpu.cache``    — set-associative vector cache
+- ``raft_tpu.lap``      — linear assignment problem solver
+- ``raft_tpu.comms``    — comms_t-shaped collective/p2p interface over XLA
+                          collectives (ICI/DCN), mesh sub-communicators
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.error import RaftError, expects, fail  # noqa: F401
+from raft_tpu.core.handle import Handle  # noqa: F401

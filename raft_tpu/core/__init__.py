@@ -1,0 +1,34 @@
+"""Core runtime: resource handle, errors, tracing, small integer utilities.
+
+TPU-native equivalent of the reference's layer-1 core
+(cpp/include/raft/handle.hpp, error.hpp, cudart_utils.h, cuda_utils.cuh,
+pow2_utils.cuh, integer_utils.h, common/nvtx.hpp).
+"""
+
+from raft_tpu.core.error import RaftError, expects, fail
+from raft_tpu.core.handle import Handle
+from raft_tpu.core.tracing import annotate, range_pop, range_push
+from raft_tpu.core.utils import (
+    Pow2,
+    align_down,
+    align_to,
+    ceildiv,
+    is_pow2,
+    log2,
+)
+
+__all__ = [
+    "RaftError",
+    "expects",
+    "fail",
+    "Handle",
+    "annotate",
+    "range_push",
+    "range_pop",
+    "Pow2",
+    "ceildiv",
+    "align_to",
+    "align_down",
+    "is_pow2",
+    "log2",
+]

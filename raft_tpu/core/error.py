@@ -1,0 +1,52 @@
+"""Error types and assertion helpers.
+
+TPU-native analog of the reference's exception machinery
+(cpp/include/raft/error.hpp): ``raft::exception`` collects a stack trace at
+construction (error.hpp:28-92) and the ``RAFT_EXPECTS`` / ``RAFT_FAIL``
+macros (error.hpp:132,148) raise it with a formatted message.  Python
+exceptions already carry tracebacks, but we additionally capture the stack
+at construction time so errors raised from inside async XLA dispatch still
+point at the call site.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RaftError(RuntimeError):
+    """Exception with a captured construction-site stack trace.
+
+    Mirrors ``raft::exception`` (reference error.hpp:28): the message is
+    augmented with the stack collected where the error was *created*, which
+    matters when the raise happens later (e.g. out of an async callback).
+    """
+
+    def __init__(self, message: str, collect_stack: bool = True):
+        self.raw_message = message
+        if collect_stack:
+            stack = "".join(traceback.format_stack()[:-1])
+            message = f"{message}\nObtained stack trace:\n{stack}"
+        super().__init__(message)
+
+
+class LogicError(RaftError):
+    """Invariant violation (analog of raft::logic_error, error.hpp:94)."""
+
+
+def expects(cond: bool, fmt: str, *args) -> None:
+    """Raise :class:`LogicError` unless ``cond`` holds.
+
+    Analog of ``RAFT_EXPECTS(cond, fmt, ...)`` (reference error.hpp:132).
+    ``fmt`` is %-formatted with ``args`` to match the macro's printf style.
+    """
+    if not cond:
+        raise LogicError(fmt % args if args else fmt)
+
+
+def fail(fmt: str, *args) -> None:
+    """Unconditionally raise :class:`LogicError`.
+
+    Analog of ``RAFT_FAIL(fmt, ...)`` (reference error.hpp:148).
+    """
+    raise LogicError(fmt % args if args else fmt)

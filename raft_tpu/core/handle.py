@@ -1,0 +1,198 @@
+"""Resource handle: the TPU-native ``raft::handle_t``.
+
+The reference's ``handle_t`` (cpp/include/raft/handle.hpp:49-285) is the
+single resource context threaded through every primitive: device id, main
+stream, a stream pool for intra-process parallelism, lazily-created vendor
+library handles, an injected communicator plus named sub-communicators, and
+cached device properties.
+
+TPU mapping:
+
+- CUDA device            → a ``jax.Device`` (and optionally a
+                           ``jax.sharding.Mesh`` for SPMD primitives).
+- CUDA stream            → JAX async dispatch: every op is enqueued
+                           asynchronously; a ``Stream`` here is a handle that
+                           tracks the arrays dispatched "on" it so
+                           ``sync_stream`` can block on exactly that work.
+- stream pool            → pool of such trackers; XLA overlaps independent
+                           computations on its own, so the pool preserves the
+                           reference API (handle.hpp:148-227) while mapping
+                           to concurrent async dispatch.
+- cublas/cusolver/etc.   → XLA: no explicit handles needed; the analogous
+                           lazily-built resource is the jit executable cache,
+                           which JAX maintains per (fn, shapes, device).
+- comms_t injection      → :meth:`set_comms` / :meth:`get_comms` and named
+                           sub-communicators (handle.hpp:229-252).
+- cudaDeviceProp         → :meth:`get_device_properties` summarising the
+                           device kind / memory / core counts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from raft_tpu.core.error import expects
+
+
+class Stream:
+    """Async work tracker standing in for a CUDA stream.
+
+    JAX dispatch is asynchronous by default (the stream-ordered model the
+    reference assumes); a ``Stream`` records the output arrays of work
+    "enqueued on" it so :meth:`sync` blocks on precisely that work, matching
+    ``cudaStreamSynchronize`` granularity.
+    """
+
+    def __init__(self, name: str = "stream"):
+        self.name = name
+        self._pending: List[Any] = []
+
+    def record(self, *arrays) -> None:
+        """Associate dispatched work (its output arrays) with this stream."""
+        self._pending.extend(arrays)
+
+    def sync(self) -> None:
+        """Block until all recorded work is complete."""
+        if self._pending:
+            jax.block_until_ready(self._pending)
+            self._pending.clear()
+
+
+class Handle:
+    """Central resource context passed to every primitive.
+
+    Parameters
+    ----------
+    device:
+        The accelerator device to target.  Defaults to ``jax.devices()[0]``.
+    n_streams:
+        Size of the stream pool (reference handle.hpp:80 ctor arg
+        ``stream_pool``).  0 means no pool.
+    mesh:
+        Optional ``jax.sharding.Mesh`` for SPMD primitives; the TPU-native
+        extension of the reference's comms-carrying handle.
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        n_streams: int = 0,
+        mesh: Optional[jax.sharding.Mesh] = None,
+    ):
+        self.device = device if device is not None else jax.devices()[0]
+        self._stream = Stream("main")
+        self._stream_pool = [Stream(f"pool{i}") for i in range(n_streams)]
+        self._comms = None
+        self._subcomms: Dict[str, Any] = {}
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------ #
+    # streams (reference handle.hpp:148-227)
+    # ------------------------------------------------------------------ #
+    def get_stream(self) -> Stream:
+        """Main stream (reference ``get_stream``, handle.hpp:148)."""
+        return self._stream
+
+    def is_stream_pool_initialized(self) -> bool:
+        return len(self._stream_pool) > 0
+
+    def get_stream_pool_size(self) -> int:
+        return len(self._stream_pool)
+
+    def get_stream_from_stream_pool(self, idx: int = 0) -> Stream:
+        """Pool stream by index (reference handle.hpp:186)."""
+        expects(
+            len(self._stream_pool) > 0,
+            "ERROR: rmm::cuda_stream_pool was not initialized",
+        )
+        return self._stream_pool[idx % len(self._stream_pool)]
+
+    def get_next_usable_stream(self, idx: int = 0) -> Stream:
+        """Pool stream if a pool exists, else the main stream
+        (reference handle.hpp:205-214)."""
+        if self._stream_pool:
+            return self._stream_pool[idx % len(self._stream_pool)]
+        return self._stream
+
+    def sync_stream(self, stream: Optional[Stream] = None) -> None:
+        """Synchronize one stream (reference ``sync_stream``, handle.hpp:158)."""
+        (stream or self._stream).sync()
+
+    def sync_stream_pool(self) -> None:
+        """Synchronize every pool stream (reference handle.hpp:216)."""
+        for s in self._stream_pool:
+            s.sync()
+
+    def wait_stream_pool_on_stream(self) -> None:
+        """Order pool work after main-stream work (reference handle.hpp:221).
+
+        JAX data dependencies provide this ordering automatically; syncing
+        the main stream is the conservative host-side equivalent.
+        """
+        self._stream.sync()
+
+    # ------------------------------------------------------------------ #
+    # comms (reference handle.hpp:229-252)
+    # ------------------------------------------------------------------ #
+    def set_comms(self, comms) -> None:
+        self._comms = comms
+
+    def get_comms(self):
+        expects(self._comms is not None, "ERROR: Communicator was not initialized on the handle")
+        return self._comms
+
+    def comms_initialized(self) -> bool:
+        return self._comms is not None
+
+    def set_subcomm(self, key: str, comms) -> None:
+        self._subcomms[key] = comms
+
+    def get_subcomm(self, key: str):
+        expects(
+            key in self._subcomms,
+            "%s was not found in subcommunicators.",
+            key,
+        )
+        return self._subcomms[key]
+
+    # ------------------------------------------------------------------ #
+    # device properties (reference handle.hpp:254-262)
+    # ------------------------------------------------------------------ #
+    def get_device(self) -> jax.Device:
+        return self.device
+
+    def get_device_properties(self) -> Dict[str, Any]:
+        d = self.device
+        props: Dict[str, Any] = {
+            "platform": d.platform,
+            "device_kind": d.device_kind,
+            "id": d.id,
+            "process_index": d.process_index,
+        }
+        try:
+            stats = d.memory_stats()
+            if stats:
+                props.update(
+                    bytes_limit=stats.get("bytes_limit"),
+                    bytes_in_use=stats.get("bytes_in_use"),
+                )
+        except Exception:
+            pass
+        return props
+
+
+class stream_syncer:
+    """RAII-style scope that syncs the handle on exit
+    (reference ``stream_syncer``, handle.hpp:311)."""
+
+    def __init__(self, handle: Handle):
+        self.handle = handle
+
+    def __enter__(self) -> Handle:
+        return self.handle
+
+    def __exit__(self, *exc) -> None:
+        self.handle.sync_stream()
+        self.handle.sync_stream_pool()

@@ -1,0 +1,64 @@
+"""Tracing / profiling ranges — TPU analog of NVTX ranges.
+
+The reference wraps NVTX push/pop ranges in RAII helpers with printf-style
+messages, compiled out unless enabled (cpp/include/raft/common/nvtx.hpp:17-60,
+common/detail/nvtx.hpp:157-201).  On TPU the equivalent is the XLA/JAX
+profiler: ``jax.profiler.TraceAnnotation`` shows up on the host timeline and
+``jax.named_scope`` attaches names to the lowered HLO.  Ranges are cheap but
+can be disabled globally (the NVTX=OFF analog) via :func:`set_enabled` or the
+``RAFT_TPU_TRACING`` environment variable ("0" disables).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, List
+
+import jax
+
+_enabled = os.environ.get("RAFT_TPU_TRACING", "1") != "0"
+_range_stack: List[object] = []
+
+
+def set_enabled(on: bool) -> None:
+    """Globally enable/disable tracing ranges (CMake NVTX flag analog)."""
+    global _enabled
+    _enabled = on
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def annotate(fmt: str, *args) -> Iterator[None]:
+    """Scoped trace range (analog of nvtx::range RAII, common/nvtx.hpp:60).
+
+    Printf-style message formatting mirrors the reference's
+    ``push_range("name %d", i)`` usage.
+    """
+    if not _enabled:
+        yield
+        return
+    name = fmt % args if args else fmt
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+
+
+def range_push(fmt: str, *args) -> None:
+    """Imperative push (analog of nvtx::push_range, common/nvtx.hpp:40)."""
+    if not _enabled:
+        return
+    name = fmt % args if args else fmt
+    cm = jax.profiler.TraceAnnotation(name)
+    cm.__enter__()
+    _range_stack.append(cm)
+
+
+def range_pop() -> None:
+    """Imperative pop (analog of nvtx::pop_range, common/nvtx.hpp:50)."""
+    if not _enabled or not _range_stack:
+        return
+    cm = _range_stack.pop()
+    cm.__exit__(None, None, None)

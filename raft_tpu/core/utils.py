@@ -1,0 +1,78 @@
+"""Small host-side integer / power-of-two utilities.
+
+TPU-native analog of the reference's device-side helpers that remain
+meaningful on the host: ``ceildiv``/``alignTo``/``alignDown``/``isPo2``/
+``log2`` (cpp/include/raft/cuda_utils.cuh:109-217), the ``Pow2`` arithmetic
+helper (cpp/include/raft/pow2_utils.cuh) and ``integer_utils.h``.  Warp/lane
+intrinsics have no host analog — their role is played by Pallas kernel tiling
+(see raft_tpu/ops).
+"""
+
+from __future__ import annotations
+
+from raft_tpu.core.error import expects
+
+
+def ceildiv(a: int, b: int) -> int:
+    """Ceiling division (reference cuda_utils.cuh:109 ``raft::ceildiv``)."""
+    return -(-a // b)
+
+
+def round_up_safe(a: int, b: int) -> int:
+    """Round ``a`` up to a multiple of ``b`` (integer_utils.h)."""
+    return ceildiv(a, b) * b
+
+
+def round_down_safe(a: int, b: int) -> int:
+    """Round ``a`` down to a multiple of ``b`` (integer_utils.h)."""
+    return (a // b) * b
+
+
+def align_to(v: int, align: int) -> int:
+    """Align ``v`` up to ``align`` (reference cuda_utils.cuh ``alignTo``)."""
+    return round_up_safe(v, align)
+
+
+def align_down(v: int, align: int) -> int:
+    """Align ``v`` down to ``align`` (reference cuda_utils.cuh ``alignDown``)."""
+    return round_down_safe(v, align)
+
+
+def is_pow2(v: int) -> bool:
+    """True iff ``v`` is a power of two (reference cuda_utils.cuh ``isPo2``)."""
+    return v > 0 and (v & (v - 1)) == 0
+
+
+def log2(v: int) -> int:
+    """Floor log base 2 (reference cuda_utils.cuh ``log2``)."""
+    expects(v > 0, "log2: v must be positive, got %d", v)
+    return v.bit_length() - 1
+
+
+class Pow2:
+    """Fast arithmetic modulo a power of two (reference pow2_utils.cuh).
+
+    Provides div/mod/round up/round down and alignment predicates for a
+    compile-time-style power-of-two value.
+    """
+
+    def __init__(self, value: int):
+        expects(is_pow2(value), "Pow2: value must be a power of two, got %d", value)
+        self.value = value
+        self.mask = value - 1
+        self.log2 = log2(value)
+
+    def div(self, x: int) -> int:
+        return x >> self.log2
+
+    def mod(self, x: int) -> int:
+        return x & self.mask
+
+    def round_down(self, x: int) -> int:
+        return x & ~self.mask
+
+    def round_up(self, x: int) -> int:
+        return (x + self.mask) & ~self.mask
+
+    def is_aligned(self, x: int) -> bool:
+        return (x & self.mask) == 0

@@ -1,0 +1,43 @@
+"""Test configuration: run everything on a simulated 8-device CPU mesh.
+
+The reference tests multi-GPU comms on a real LocalCUDACluster
+(python/raft/test/conftest.py:17-48); we instead force the JAX host
+platform to expose 8 virtual CPU devices, which lets every multi-device
+code path (mesh sharding, collectives, comm_split) run hardware-free.
+"""
+
+import os
+
+# The environment may pre-set JAX_PLATFORMS to a real accelerator and even
+# import jax at interpreter startup (sitecustomize), so an env-var-only
+# override is too late.  Backend *initialization* is lazy, though: setting
+# XLA_FLAGS now and switching platforms via jax.config still works as long
+# as no backend has been touched yet.  RAFT_TPU_TEST_PLATFORM overrides the
+# CPU default for running tests on real hardware.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+_platform = os.environ.get("RAFT_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def handle():
+    from raft_tpu import Handle
+
+    return Handle(n_streams=4)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,140 @@
+"""Core runtime tests (reference: cpp/test/handle.cpp, test/integer_utils.cpp,
+test/pow2_utils.cu, test/nvtx.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu import Handle, RaftError, expects, fail
+from raft_tpu.core import tracing, utils
+from raft_tpu.core.handle import stream_syncer
+
+
+class TestErrors:
+    def test_expects_pass(self):
+        expects(True, "should not raise")
+
+    def test_expects_fail(self):
+        with pytest.raises(RaftError, match="bad value 42"):
+            expects(False, "bad value %d", 42)
+
+    def test_fail(self):
+        with pytest.raises(RaftError, match="always fails"):
+            fail("always fails")
+
+    def test_stack_trace_collected(self):
+        try:
+            fail("boom")
+        except RaftError as e:
+            assert "Obtained stack trace" in str(e)
+            assert e.raw_message == "boom"
+
+
+class TestHandle:
+    def test_default_device(self):
+        h = Handle()
+        assert h.get_device() in jax.devices()
+
+    def test_stream_pool(self):
+        h = Handle(n_streams=4)
+        assert h.is_stream_pool_initialized()
+        assert h.get_stream_pool_size() == 4
+        assert h.get_stream_from_stream_pool(1) is not h.get_stream_from_stream_pool(2)
+        # wraps around
+        assert h.get_stream_from_stream_pool(5) is h.get_stream_from_stream_pool(1)
+
+    def test_no_pool_raises(self):
+        h = Handle()
+        assert not h.is_stream_pool_initialized()
+        with pytest.raises(RaftError):
+            h.get_stream_from_stream_pool(0)
+        # next_usable falls back to main stream
+        assert h.get_next_usable_stream(3) is h.get_stream()
+
+    def test_stream_sync(self):
+        h = Handle(n_streams=2)
+        s = h.get_stream()
+        x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+        s.record(x)
+        h.sync_stream()
+        h.sync_stream_pool()
+
+    def test_comms_not_initialized(self):
+        h = Handle()
+        assert not h.comms_initialized()
+        with pytest.raises(RaftError):
+            h.get_comms()
+
+    def test_subcomm(self):
+        h = Handle()
+        sentinel = object()
+        h.set_comms(sentinel)
+        assert h.get_comms() is sentinel
+        h.set_subcomm("rows", sentinel)
+        assert h.get_subcomm("rows") is sentinel
+        with pytest.raises(RaftError):
+            h.get_subcomm("cols")
+
+    def test_device_properties(self):
+        props = Handle().get_device_properties()
+        assert "platform" in props and "device_kind" in props
+
+    def test_stream_syncer(self):
+        h = Handle(n_streams=1)
+        with stream_syncer(h) as hh:
+            assert hh is h
+
+
+class TestUtils:
+    def test_ceildiv(self):
+        assert utils.ceildiv(10, 3) == 4
+        assert utils.ceildiv(9, 3) == 3
+        assert utils.ceildiv(1, 128) == 1
+
+    def test_align(self):
+        assert utils.align_to(100, 64) == 128
+        assert utils.align_down(100, 64) == 64
+        assert utils.round_up_safe(7, 7) == 7
+
+    def test_pow2_predicates(self):
+        assert utils.is_pow2(128)
+        assert not utils.is_pow2(100)
+        assert utils.log2(1024) == 10
+        with pytest.raises(RaftError):
+            utils.log2(0)
+
+    def test_pow2_class(self):
+        p = utils.Pow2(16)
+        assert p.div(33) == 2
+        assert p.mod(33) == 1
+        assert p.round_up(33) == 48
+        assert p.round_down(33) == 32
+        assert p.is_aligned(48)
+        with pytest.raises(RaftError):
+            utils.Pow2(12)
+
+
+class TestTracing:
+    def test_annotate_runs(self):
+        with tracing.annotate("test range %d", 7):
+            x = jnp.arange(8).sum()
+        assert int(x) == 28
+
+    def test_push_pop(self):
+        tracing.range_push("outer %s", "range")
+        tracing.range_push("inner")
+        tracing.range_pop()
+        tracing.range_pop()
+        # popping an empty stack is a no-op
+        tracing.range_pop()
+
+    def test_disable(self):
+        tracing.set_enabled(False)
+        try:
+            with tracing.annotate("disabled"):
+                pass
+            tracing.range_push("disabled")
+            tracing.range_pop()
+        finally:
+            tracing.set_enabled(True)
+        assert tracing.is_enabled()

@@ -1,0 +1,18 @@
+"""Guard: the simulated multi-device environment is actually in effect.
+
+All multi-device tests assume an 8-device CPU mesh (see conftest.py); if the
+platform override silently fails (e.g. an environment pre-imports jax with a
+different backend), every mesh test would "pass" single-device.  Fail loudly
+here instead.
+"""
+
+import os
+
+import jax
+
+
+def test_virtual_device_mesh_active():
+    expected = os.environ.get("RAFT_TPU_TEST_PLATFORM", "cpu")
+    assert jax.devices()[0].platform == expected
+    if expected == "cpu":
+        assert len(jax.devices()) == 8, jax.devices()
