@@ -57,8 +57,13 @@ def range_push(fmt: str, *args) -> None:
 
 
 def range_pop() -> None:
-    """Imperative pop (analog of nvtx::pop_range, common/nvtx.hpp:50)."""
-    if not _enabled or not _range_stack:
+    """Imperative pop (analog of nvtx::pop_range, common/nvtx.hpp:50).
+
+    Pops regardless of the enabled flag: an already-entered range must be
+    closed even if tracing was disabled between push and pop, or the
+    profiler range leaks and later pops close the wrong ranges.
+    """
+    if not _range_stack:
         return
     cm = _range_stack.pop()
     cm.__exit__(None, None, None)

@@ -1,0 +1,99 @@
+"""Dense linear algebra primitives.
+
+TPU-native equivalent of the reference's ``raft::linalg`` module
+(cpp/include/raft/linalg/).  Where the reference hand-wraps cuBLAS/cuSOLVER
+and writes custom CUDA kernels, we lower to XLA HLO: matmuls hit the MXU,
+elementwise ops and reductions fuse, and solvers use XLA's native
+eigendecomposition/SVD/QR.  The one genuinely iterative solver — Lanczos —
+is built from our own primitives with the tridiagonal stage on the host,
+mirroring the reference's structure (linalg/lanczos.hpp).
+"""
+
+from raft_tpu.linalg.gemm import gemm, gemv
+from raft_tpu.linalg.eig import eig_dc, eig_jacobi, eig_sel_dc
+from raft_tpu.linalg.svd import svd_eig, svd_jacobi, svd_qr, svd_reconstruction
+from raft_tpu.linalg.qr import qr_get_q, qr_get_qr
+from raft_tpu.linalg.cholesky import cholesky_rank1_update
+from raft_tpu.linalg.elementwise import (
+    add,
+    add_scalar,
+    binary_op,
+    divide_scalar,
+    eltwise_add,
+    eltwise_divide,
+    eltwise_multiply,
+    eltwise_sub,
+    map_op,
+    multiply_scalar,
+    subtract,
+    subtract_scalar,
+    unary_op,
+)
+from raft_tpu.linalg.reduce import (
+    coalesced_reduction,
+    map_then_reduce,
+    map_then_sum_reduce,
+    reduce,
+    strided_reduction,
+)
+from raft_tpu.linalg.norm import (
+    L1Norm,
+    L2Norm,
+    LinfNorm,
+    NormType,
+    col_norm,
+    mean_squared_error,
+    row_norm,
+)
+from raft_tpu.linalg.matrix_vector_op import matrix_vector_op
+from raft_tpu.linalg.transpose import transpose
+from raft_tpu.linalg.init import range_init
+from raft_tpu.linalg.lanczos import (
+    compute_largest_eigenvectors,
+    compute_smallest_eigenvectors,
+)
+
+__all__ = [
+    "gemm",
+    "gemv",
+    "eig_dc",
+    "eig_sel_dc",
+    "eig_jacobi",
+    "svd_qr",
+    "svd_eig",
+    "svd_jacobi",
+    "svd_reconstruction",
+    "qr_get_q",
+    "qr_get_qr",
+    "cholesky_rank1_update",
+    "unary_op",
+    "binary_op",
+    "map_op",
+    "eltwise_add",
+    "eltwise_sub",
+    "eltwise_multiply",
+    "eltwise_divide",
+    "add",
+    "subtract",
+    "add_scalar",
+    "subtract_scalar",
+    "multiply_scalar",
+    "divide_scalar",
+    "reduce",
+    "coalesced_reduction",
+    "strided_reduction",
+    "map_then_reduce",
+    "map_then_sum_reduce",
+    "NormType",
+    "L1Norm",
+    "L2Norm",
+    "LinfNorm",
+    "row_norm",
+    "col_norm",
+    "mean_squared_error",
+    "matrix_vector_op",
+    "transpose",
+    "range_init",
+    "compute_smallest_eigenvectors",
+    "compute_largest_eigenvectors",
+]

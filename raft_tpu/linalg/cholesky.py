@@ -1,0 +1,64 @@
+"""Rank-1 Cholesky update.
+
+Reference: cpp/include/raft/linalg/cholesky_r1_update.cuh:125 — given the
+Cholesky factor of the leading (n-1, n-1) block of A, extend it to the
+(n, n) block after a new row/column is appended.  The reference builds this
+from a triangular solve + dot product; we do the same with XLA's
+``solve_triangular`` so the incremental-SVM/kernel use case carries over.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from raft_tpu.core.error import expects
+
+
+def _checked_sqrt(d: jnp.ndarray, eps: float | None) -> jnp.ndarray:
+    """sqrt of the new diagonal element with the reference's
+    positive-definiteness check (cholesky_r1_update.cuh docs: raises when
+    d <= eps).  Eager callers get a LogicError; under jit (where raising on
+    a traced value is impossible) the failure surfaces as NaN, which
+    ``jnp.sqrt`` of a negative produces anyway."""
+    if eps is not None:
+        try:
+            ok = bool(d > eps)
+        except Exception:  # traced value: signal via NaN instead of raising
+            return jnp.sqrt(jnp.where(d > eps, d, jnp.nan))
+        expects(ok, "cholesky_rank1_update: matrix is not positive definite")
+    return jnp.sqrt(d)
+
+
+def cholesky_rank1_update(
+    l_full: jnp.ndarray, n: int, lower: bool = True, eps: float | None = None
+) -> jnp.ndarray:
+    """Extend a Cholesky factorization by one row/column.
+
+    Parameters mirror the reference (cholesky_r1_update.cuh:125): ``l_full``
+    is an (n, n) array whose leading (n-1, n-1) block already holds the
+    factor L of A[:n-1, :n-1] and whose last row (lower) or column (upper)
+    holds the new entries of A.  Returns the array with the new row/column
+    replaced by the updated factor.  ``eps``: positive-definiteness
+    threshold for the new diagonal element (see :func:`_checked_sqrt`).
+    """
+    expects(l_full.ndim == 2 and l_full.shape[0] == l_full.shape[1], "cholesky_rank1_update: square input required")
+    expects(1 <= n <= l_full.shape[0], "cholesky_rank1_update: invalid n=%d", n)
+    if n == 1:
+        return l_full.at[0, 0].set(_checked_sqrt(l_full[0, 0], eps))
+    k = n - 1
+    if lower:
+        a_col = l_full[k, :k]  # new row of A (== column by symmetry)
+        l_sub = l_full[:k, :k]
+        # L_21 = L^-1 a  (triangular solve), L_22 = sqrt(a_nn - ||L_21||^2)
+        l21 = jsl.solve_triangular(l_sub, a_col, lower=True)
+        l22 = _checked_sqrt(l_full[k, k] - jnp.dot(l21, l21), eps)
+        out = l_full.at[k, :k].set(l21)
+        return out.at[k, k].set(l22)
+    else:
+        a_row = l_full[:k, k]
+        u_sub = l_full[:k, :k]
+        u12 = jsl.solve_triangular(u_sub.T, a_row, lower=True)
+        u22 = _checked_sqrt(l_full[k, k] - jnp.dot(u12, u12), eps)
+        out = l_full.at[:k, k].set(u12)
+        return out.at[k, k].set(u22)

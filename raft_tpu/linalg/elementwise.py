@@ -1,0 +1,94 @@
+"""Elementwise operations.
+
+Reference: cpp/include/raft/linalg/ — ``unaryOp``/``writeOnlyUnaryOp``
+(unary_op.cuh:73,96), ``binaryOp`` (binary_op.cuh:84), ``eltwiseAdd/Sub/
+Mul/Div`` (eltwise.cuh:37-114), scalar variants (add.cuh:40-87,
+subtract.cuh:41-90, multiply.cuh, divide.cuh), generic ``map`` over n
+arrays (map.cuh:65).  The reference hand-vectorizes these with TxN_t loads
+(vectorized.cuh); XLA fuses and vectorizes elementwise lambdas
+automatically, so each is a one-liner — kept as named functions so consumer
+code keeps its vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def unary_op(x: jnp.ndarray, op: Callable) -> jnp.ndarray:
+    """Apply ``op`` elementwise (reference unary_op.cuh:73)."""
+    return op(x)
+
+
+def write_only_unary_op(shape, dtype, op: Callable) -> jnp.ndarray:
+    """Generate an array from flat indices (reference unary_op.cuh:96:
+    the lambda receives the output offset)."""
+    idx = jnp.arange(int(jnp.prod(jnp.array(shape))))
+    return op(idx).astype(dtype).reshape(shape)
+
+
+def binary_op(x: jnp.ndarray, y: jnp.ndarray, op: Callable) -> jnp.ndarray:
+    """Apply a binary lambda elementwise (reference binary_op.cuh:84)."""
+    return op(x, y)
+
+
+def map_op(op: Callable, *arrays: jnp.ndarray) -> jnp.ndarray:
+    """Map an n-ary lambda over n same-shaped arrays (reference map.cuh:65)."""
+    return op(*arrays)
+
+
+def eltwise_add(x, y):
+    """(reference eltwise.cuh:37)"""
+    return x + y
+
+
+def eltwise_sub(x, y):
+    """(reference eltwise.cuh:63)"""
+    return x - y
+
+
+def eltwise_multiply(x, y):
+    """(reference eltwise.cuh:76)"""
+    return x * y
+
+
+def eltwise_divide(x, y):
+    """(reference eltwise.cuh:89)"""
+    return x / y
+
+
+def eltwise_divide_check_zero(x, y):
+    """Divide with 0 where divisor is 0 (reference eltwise.cuh:102)."""
+    return jnp.where(y == 0, 0, x / jnp.where(y == 0, 1, y))
+
+
+def add(x, y):
+    """(reference add.cuh:58 ``add``)"""
+    return x + y
+
+
+def subtract(x, y):
+    """(reference subtract.cuh:58)"""
+    return x - y
+
+
+def add_scalar(x, scalar):
+    """(reference add.cuh:40 ``addScalar``)"""
+    return x + scalar
+
+
+def subtract_scalar(x, scalar):
+    """(reference subtract.cuh:41 ``subtractScalar``)"""
+    return x - scalar
+
+
+def multiply_scalar(x, scalar):
+    """(reference multiply.cuh:38 ``multiplyScalar``)"""
+    return x * scalar
+
+
+def divide_scalar(x, scalar):
+    """(reference divide.cuh:38 ``divideScalar``)"""
+    return x / scalar

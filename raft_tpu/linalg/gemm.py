@@ -1,0 +1,72 @@
+"""GEMM / GEMV.
+
+Reference: cpp/include/raft/linalg/gemm.cuh:46,73,111 (cuBLAS-backed, three
+overloads with alpha/beta and transpose flags) and gemv.h:29-164.  On TPU a
+matmul is a single MXU-shaped XLA op; alpha/beta epilogues fuse into it, so
+the whole overload family collapses to two functions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def gemm(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    trans_a: bool = False,
+    trans_b: bool = False,
+    alpha: float = 1.0,
+    beta: float = 0.0,
+    c: Optional[jnp.ndarray] = None,
+    preferred_element_type=None,
+) -> jnp.ndarray:
+    """``alpha * op(a) @ op(b) + beta * c`` (reference gemm.cuh:73).
+
+    ``preferred_element_type`` controls MXU accumulation dtype (e.g. keep
+    float32 accumulation for bfloat16 inputs).
+    """
+    opa = a.T if trans_a else a
+    opb = b.T if trans_b else b
+    expects(
+        opa.shape[-1] == opb.shape[-2 if opb.ndim > 1 else 0],
+        "gemm: inner dimensions mismatch (%d vs %d)",
+        opa.shape[-1],
+        opb.shape[-2 if opb.ndim > 1 else 0],
+    )
+    out = jnp.matmul(opa, opb, preferred_element_type=preferred_element_type)
+    if alpha != 1.0:
+        out = alpha * out
+    if beta != 0.0:
+        expects(c is not None, "gemm: beta != 0 requires c")
+        out = out + beta * c
+    return out
+
+
+def gemv(
+    a: jnp.ndarray,
+    x: jnp.ndarray,
+    trans_a: bool = False,
+    alpha: float = 1.0,
+    beta: float = 0.0,
+    y: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """``alpha * op(a) @ x + beta * y`` (reference gemv.h:29-164)."""
+    opa = a.T if trans_a else a
+    expects(
+        opa.shape[-1] == x.shape[0],
+        "gemv: dimension mismatch (%d vs %d)",
+        opa.shape[-1],
+        x.shape[0],
+    )
+    out = opa @ x
+    if alpha != 1.0:
+        out = alpha * out
+    if beta != 0.0:
+        expects(y is not None, "gemv: beta != 0 requires y")
+        out = out + beta * y
+    return out

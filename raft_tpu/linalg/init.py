@@ -1,0 +1,11 @@
+"""Sequence initialization (reference cpp/include/raft/linalg/init.h:40
+``range(out, start, end, stream)`` — fill with [start, end))."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def range_init(start: int, end: int, dtype=jnp.int32) -> jnp.ndarray:
+    """Fill with the integer range [start, end) (reference init.h:40)."""
+    return jnp.arange(start, end, dtype=dtype)

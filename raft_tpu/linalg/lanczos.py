@@ -1,0 +1,206 @@
+"""Restarted Lanczos eigensolver.
+
+Reference: cpp/include/raft/linalg/lanczos.hpp (1,478 LoC) —
+``computeSmallestEigenvectors`` (:754,1033) / ``computeLargestEigenvectors``
+(:1141): Lanczos iteration (SpMV + dot/axpy/nrm2, :88-180), host LAPACK
+``steqr`` on the tridiagonal, Francis-QR implicit restarts (:388,546).
+
+TPU redesign: instead of translating the scalar-heavy CUDA iteration, we run
+*thick-restart* Lanczos with **full reorthogonalization**: basis expansion is
+a sequence of matvecs plus (n×m)ᵀ(n×1) projections — tall-skinny matmuls that
+map straight onto the MXU — and the small (m×m) projected problem is solved
+with a dense symmetric eigensolver (the ``steqr`` role).  Full
+reorthogonalization costs a little more FLOP but removes the ghost-eigenvalue
+pathology the reference's restart machinery exists to fight, and FLOPs are
+what a TPU has.
+
+The matrix is supplied as a callable ``mv(x) -> A @ x`` (the
+``sparse_matrix_t::mv`` interface, reference spectral/matrix_wrappers.hpp:180)
+or as a dense array.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+Operator = Union[jnp.ndarray, Callable[[jnp.ndarray], jnp.ndarray]]
+
+
+def _as_mv(a: Operator) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    if callable(a):
+        return a
+    return lambda x: a @ x
+
+
+def _expand_basis(mv, v_basis: jnp.ndarray, av_basis: jnp.ndarray,
+                  start: int, stop: int, key: jax.Array):
+    """Grow an orthonormal basis from ``start`` to ``stop`` columns.
+
+    v_basis is (n, m); columns [0, start) are already orthonormal and column
+    ``start`` holds the (normalized) next direction.  av_basis caches
+    ``mv(v_j)`` for every processed column so Rayleigh-Ritz never recomputes
+    a matvec.  Each step: w = A v_j, orthogonalize against ALL previous
+    columns twice (classical Gram-Schmidt, two passes — MXU-shaped), then
+    normalize into column j+1.  If the Krylov space is exhausted (w ~ 0) the
+    next column is re-seeded with a random direction orthogonal to the
+    basis, keeping the basis orthonormal instead of fabricating zero
+    columns (which would produce spurious zero-residual Ritz pairs).
+    """
+    n = v_basis.shape[0]
+
+    def orthonormalize(w, vb):
+        for _ in range(2):
+            w = w - vb @ (vb.T @ w)
+        return w, jnp.linalg.norm(w)
+
+    def step(j, carry):
+        vb, ab = carry
+        v_j = jax.lax.dynamic_slice_in_dim(vb, j, 1, axis=1)[:, 0]
+        av = mv(v_j)
+        ab = jax.lax.dynamic_update_slice_in_dim(ab, av[:, None], j, axis=1)
+        w, nrm = orthonormalize(av, vb)
+
+        def krylov_next(_):
+            return w / jnp.where(nrm > 0, nrm, 1.0)
+
+        def reseed(_):
+            r = jax.random.uniform(
+                jax.random.fold_in(key, j), (n,), dtype=vb.dtype,
+                minval=-1.0, maxval=1.0)
+            r, rn = orthonormalize(r, vb)
+            return r / jnp.maximum(rn, 1e-30)
+
+        w = jax.lax.cond(nrm > 1e-10, krylov_next, reseed, operand=None)
+        vb = jax.lax.dynamic_update_slice_in_dim(vb, w[:, None], j + 1, axis=1)
+        return vb, ab
+
+    return jax.lax.fori_loop(start, stop, step, (v_basis, av_basis))
+
+
+def _ritz(v_basis: jnp.ndarray, av_basis: jnp.ndarray, m: int):
+    """Rayleigh-Ritz on the first m columns using cached A@V."""
+    v = v_basis[:, :m]
+    av = av_basis[:, :m]
+    h = v.T @ av
+    h = 0.5 * (h + h.T)
+    theta, s = jnp.linalg.eigh(h)
+    y = v @ s
+    # residual norms ||A y - theta y|| per Ritz pair
+    r = av @ s - y * theta[None, :]
+    resid = jnp.linalg.norm(r, axis=0)
+    return theta, y, s, resid
+
+
+def _lanczos(
+    a: Operator,
+    n: int,
+    k: int,
+    which: str,
+    ncv: int,
+    max_restarts: int,
+    tol: float,
+    seed: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    mv = _as_mv(a)
+    expects(0 < k < n, "lanczos: need 0 < k < n (k=%d, n=%d)", k, n)
+    m = min(max(ncv, 2 * k + 1), n)
+    dtype = (a.dtype if hasattr(a, "dtype") else jnp.zeros(0).dtype)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        dtype = jnp.float32
+
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    v0 = jax.random.uniform(sub, (n,), dtype=dtype, minval=-1.0, maxval=1.0)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    v_basis = jnp.zeros((n, m), dtype=dtype).at[:, 0].set(v0)
+    av_basis = jnp.zeros((n, m), dtype=dtype)
+    n_iter = 0
+    keep = jnp.arange(k)
+    for restart in range(max_restarts):
+        start = 1 if restart == 0 else k + 1
+        key, sub = jax.random.split(key)
+        v_basis, av_basis = _expand_basis(mv, v_basis, av_basis, start - 1, m - 1, sub)
+        # matvec for the last column (the loop fills av only up to m-2)
+        av_last = mv(v_basis[:, m - 1])
+        av_basis = av_basis.at[:, m - 1].set(av_last)
+        n_iter += m - start + 1
+        theta, y, s, resid = _ritz(v_basis, av_basis, m)
+        if which == "smallest":
+            order = jnp.argsort(theta)
+        else:
+            order = jnp.argsort(-theta)
+        keep = order[:k]
+        max_resid = float(jnp.max(resid[keep]))
+        scale = float(jnp.max(jnp.abs(theta))) or 1.0
+        if max_resid <= tol * scale or restart == max_restarts - 1:
+            break
+        # thick restart: keep the k wanted Ritz vectors plus the next Krylov
+        # direction A v_m orthogonalized against the whole basis (all Ritz
+        # residuals are parallel to it in exact arithmetic); fall back to a
+        # random draw if the Krylov space is exhausted.
+        kept = y[:, keep]
+        kept_av = av_basis[:, :m] @ s[:, keep]
+        fresh = av_last
+        for _ in range(2):
+            fresh = fresh - v_basis @ (v_basis.T @ fresh)
+        fnorm = jnp.linalg.norm(fresh)
+        key, sub = jax.random.split(key)
+        rand = jax.random.uniform(sub, (n,), dtype=dtype, minval=-1.0, maxval=1.0)
+        rand = rand - kept @ (kept.T @ rand)
+        rand = rand / jnp.maximum(jnp.linalg.norm(rand), 1e-30)
+        fresh = jnp.where(fnorm > 1e-10, fresh / jnp.maximum(fnorm, 1e-30), rand)
+        v_basis = jnp.zeros((n, m), dtype=dtype)
+        v_basis = v_basis.at[:, :k].set(kept).at[:, k].set(fresh)
+        av_basis = jnp.zeros((n, m), dtype=dtype).at[:, :k].set(kept_av)
+
+    vals = theta[keep]
+    vecs = y[:, keep]
+    if which == "smallest":
+        srt = jnp.argsort(vals)
+    else:
+        srt = jnp.argsort(-vals)
+    return vals[srt], vecs[:, srt], n_iter
+
+
+def compute_smallest_eigenvectors(
+    a: Operator,
+    n: int,
+    n_eig_vecs: int,
+    maxiter: int = 4000,
+    restart_iter: int = 0,
+    tol: float = 1e-9,
+    seed: int = 1234567,
+) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Smallest-eigenpair Lanczos (reference lanczos.hpp:754,1033).
+
+    Returns ``(eigenvalues, eigenvectors, iters)`` — eigenvalues ascending,
+    eigenvectors as columns.  ``restart_iter`` sets the Krylov subspace size
+    (the reference's restart length); 0 picks ``max(4k, 32)``.
+    """
+    ncv = restart_iter if restart_iter > 0 else max(4 * n_eig_vecs, 32)
+    ncv = min(ncv, n)
+    max_restarts = max(1, maxiter // max(ncv, 1))
+    return _lanczos(a, n, n_eig_vecs, "smallest", ncv, max_restarts, tol, seed)
+
+
+def compute_largest_eigenvectors(
+    a: Operator,
+    n: int,
+    n_eig_vecs: int,
+    maxiter: int = 4000,
+    restart_iter: int = 0,
+    tol: float = 1e-9,
+    seed: int = 1234567,
+) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Largest-eigenpair Lanczos (reference lanczos.hpp:1141); eigenvalues
+    descending."""
+    ncv = restart_iter if restart_iter > 0 else max(4 * n_eig_vecs, 32)
+    ncv = min(ncv, n)
+    max_restarts = max(1, maxiter // max(ncv, 1))
+    return _lanczos(a, n, n_eig_vecs, "largest", ncv, max_restarts, tol, seed)

@@ -1,0 +1,48 @@
+"""Broadcast a vector op across matrix rows or columns.
+
+Reference: cpp/include/raft/linalg/matrix_vector_op.cuh:120
+(``matrixVectorOp``): apply ``op(mat_element, vec_element)`` where the
+vector is broadcast along rows or columns; a two-vector variant (:190)
+takes ``op(mat, vec1, vec2)``.  XLA broadcasting does the indexing; the
+named entry point keeps consumer code (mean_center, normalize, whiten)
+readable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def matrix_vector_op(
+    mat: jnp.ndarray,
+    vec: jnp.ndarray,
+    op: Callable,
+    bcast_along_rows: bool = True,
+    vec2: Optional[jnp.ndarray] = None,
+    row_major: bool = True,
+) -> jnp.ndarray:
+    """Apply ``op`` between ``mat`` and broadcast ``vec`` (and optionally
+    ``vec2``).
+
+    ``bcast_along_rows=True`` means the vector has one entry per *column*
+    and is broadcast down the rows (the reference's ``bcastAlongRows``,
+    matrix_vector_op.cuh:105 docs); False means one entry per row.
+    ``row_major`` kept for signature parity (layout is XLA's concern).
+    """
+    del row_major
+    n = mat.shape[-1] if bcast_along_rows else mat.shape[0]
+    expects(
+        vec.shape[0] == n,
+        "matrix_vector_op: vector length %d does not match matrix dim %d",
+        vec.shape[0],
+        n,
+    )
+    v = vec[None, :] if bcast_along_rows else vec[:, None]
+    if vec2 is None:
+        return op(mat, v)
+    v2 = vec2[None, :] if bcast_along_rows else vec2[:, None]
+    return op(mat, v, v2)

@@ -1,0 +1,70 @@
+"""Row/column norms and MSE.
+
+Reference: cpp/include/raft/linalg/norm.cuh — ``NormType {L1Norm, L2Norm}``
+(:25), ``rowNorm`` (:48) / ``colNorm`` (:105) with optional sqrt and a
+``fin_op`` epilogue; mean_squared_error.cuh:36.  We add ``LinfNorm`` (used
+by some consumers via the generic reduce path in the reference).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+class NormType(enum.IntEnum):
+    """(reference norm.cuh:25)"""
+
+    L1Norm = 0
+    L2Norm = 1
+    LinfNorm = 2
+
+
+L1Norm = NormType.L1Norm
+L2Norm = NormType.L2Norm
+LinfNorm = NormType.LinfNorm
+
+
+def _norm(data: jnp.ndarray, axis: int, norm_type: NormType, do_sqrt: bool,
+          fin_op: Optional[Callable]) -> jnp.ndarray:
+    if norm_type == NormType.L1Norm:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == NormType.L2Norm:
+        out = jnp.sum(data * data, axis=axis)
+    else:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    if do_sqrt:
+        out = jnp.sqrt(out)
+    if fin_op is not None:
+        out = fin_op(out)
+    return out
+
+
+def row_norm(
+    data: jnp.ndarray,
+    norm_type: NormType = NormType.L2Norm,
+    do_sqrt: bool = False,
+    fin_op: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Per-row norm (reference norm.cuh:48 ``rowNorm``).  L2 without sqrt
+    returns squared norms, the reference default used by expanded
+    distances."""
+    return _norm(data, -1, norm_type, do_sqrt, fin_op)
+
+
+def col_norm(
+    data: jnp.ndarray,
+    norm_type: NormType = NormType.L2Norm,
+    do_sqrt: bool = False,
+    fin_op: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Per-column norm (reference norm.cuh:105 ``colNorm``)."""
+    return _norm(data, 0, norm_type, do_sqrt, fin_op)
+
+
+def mean_squared_error(a: jnp.ndarray, b: jnp.ndarray, weight: float = 1.0) -> jnp.ndarray:
+    """``weight * mean((a-b)^2)`` (reference mean_squared_error.cuh:36)."""
+    diff = a - b
+    return weight * jnp.mean(diff * diff)

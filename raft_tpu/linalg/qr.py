@@ -1,0 +1,19 @@
+"""QR decomposition (reference cpp/include/raft/linalg/qr.cuh:44,88 —
+cuSOLVER geqrf/orgqr).  XLA's QR is a single fused op on TPU."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def qr_get_q(a: jnp.ndarray) -> jnp.ndarray:
+    """Orthonormal Q of the thin QR (reference qr.cuh:44 ``qrGetQ``)."""
+    q, _ = jnp.linalg.qr(a, mode="reduced")
+    return q
+
+
+def qr_get_qr(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Thin QR ``(q, r)`` (reference qr.cuh:88 ``qrGetQR``)."""
+    return jnp.linalg.qr(a, mode="reduced")

@@ -1,0 +1,11 @@
+"""Transpose (reference cpp/include/raft/linalg/transpose.h:36,73 — cuBLAS
+geam out-of-place and a square in-place variant).  One XLA op here."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def transpose(a: jnp.ndarray) -> jnp.ndarray:
+    """Out-of-place transpose (reference transpose.h:36)."""
+    return a.T

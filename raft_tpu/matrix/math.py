@@ -1,0 +1,111 @@
+"""Matrix math helpers (reference cpp/include/raft/matrix/math.hpp:38-496).
+
+Elementwise power/sqrt/reciprocal families, ratio, argmax-per-column,
+PCA sign stabilization, and the row/column broadcast binary ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def power(inp: jnp.ndarray, scalar: float | None = None) -> jnp.ndarray:
+    """Elementwise square, optionally scaled: ``scalar * x * x``
+    (reference math.hpp:46,95 — "power" means x*x there)."""
+    out = inp * inp
+    if scalar is not None:
+        out = scalar * out
+    return out
+
+
+def seq_root(inp: jnp.ndarray, scalar: float = 1.0, set_neg_zero: bool = False) -> jnp.ndarray:
+    """Elementwise sqrt of ``scalar * x`` (reference math.hpp:113-175
+    ``seqRoot``); ``set_neg_zero`` clamps negatives to 0 first like the
+    reference's guarded variant."""
+    x = scalar * inp
+    if set_neg_zero:
+        x = jnp.where(x < 0, 0.0, x)
+    return jnp.sqrt(x)
+
+
+def set_small_values_zero(inp: jnp.ndarray, thres: float = 1e-15) -> jnp.ndarray:
+    """Zero out entries with |x| <= thres (reference math.hpp:182,209)."""
+    return jnp.where(jnp.abs(inp) <= thres, 0.0, inp)
+
+
+def reciprocal(
+    inp: jnp.ndarray,
+    scalar: float = 1.0,
+    setzero: bool = False,
+    thres: float = 1e-15,
+) -> jnp.ndarray:
+    """Elementwise ``scalar / x`` (reference math.hpp:228-294); with
+    ``setzero`` entries with |x| < thres produce 0 instead of inf."""
+    if setzero:
+        small = jnp.abs(inp) < thres
+        return jnp.where(small, 0.0, scalar / jnp.where(small, 1.0, inp))
+    return scalar / inp
+
+
+def set_value(inp: jnp.ndarray, scalar: float) -> jnp.ndarray:
+    """Fill with a scalar (reference math.hpp:301 ``setValue``)."""
+    return jnp.full_like(inp, scalar)
+
+
+def ratio(inp: jnp.ndarray) -> jnp.ndarray:
+    """Each element divided by the sum of all (reference math.hpp:318)."""
+    return inp / jnp.sum(inp)
+
+
+def argmax(inp: jnp.ndarray) -> jnp.ndarray:
+    """Row index of the max per column (reference math.hpp:343)."""
+    return jnp.argmax(inp, axis=0)
+
+
+def sign_flip(inp: jnp.ndarray) -> jnp.ndarray:
+    """PCA sign stabilization (reference math.hpp:357 ``signFlip``): for each
+    column, if the entry with the largest |value| is negative, negate the
+    column."""
+    idx = jnp.argmax(jnp.abs(inp), axis=0)
+    pivot = inp[idx, jnp.arange(inp.shape[1])]
+    return jnp.where(pivot[None, :] < 0, -inp, inp)
+
+
+def _bcast(vec: jnp.ndarray, along_rows: bool) -> jnp.ndarray:
+    return vec[None, :] if along_rows else vec[:, None]
+
+
+def matrix_vector_binary_mult(data, vec, bcast_along_rows: bool = True):
+    """(reference math.hpp:363)"""
+    return data * _bcast(vec, bcast_along_rows)
+
+
+def matrix_vector_binary_mult_skip_zero(data, vec, bcast_along_rows: bool = True):
+    """Multiply, leaving entries unchanged where vec == 0
+    (reference math.hpp:384)."""
+    v = _bcast(vec, bcast_along_rows)
+    return jnp.where(v == 0, data, data * v)
+
+
+def matrix_vector_binary_div(data, vec, bcast_along_rows: bool = True):
+    """(reference math.hpp:410)"""
+    return data / _bcast(vec, bcast_along_rows)
+
+
+def matrix_vector_binary_div_skip_zero(data, vec, bcast_along_rows: bool = True, return_zero: bool = False):
+    """Divide, skipping (or zeroing) where vec == 0 (reference math.hpp:431)."""
+    v = _bcast(vec, bcast_along_rows)
+    safe = jnp.where(v == 0, 1.0, v)
+    if return_zero:
+        return jnp.where(v == 0, 0.0, data / safe)
+    return jnp.where(v == 0, data, data / safe)
+
+
+def matrix_vector_binary_add(data, vec, bcast_along_rows: bool = True):
+    """(reference math.hpp:476)"""
+    return data + _bcast(vec, bcast_along_rows)
+
+
+def matrix_vector_binary_sub(data, vec, bcast_along_rows: bool = True):
+    """(reference math.hpp:497)"""
+    return data - _bcast(vec, bcast_along_rows)

@@ -1,0 +1,81 @@
+"""Matrix manipulation (reference cpp/include/raft/matrix/matrix.hpp:49-284
+dispatching into detail/matrix.cuh).  Gathers/slices/reverses lower to XLA
+gather/slice/rev ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def copy_rows(inp: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Gather rows by index (reference matrix.hpp:50 ``copyRows``)."""
+    return jnp.take(inp, indices, axis=0)
+
+
+def trunc_zero_origin(inp: jnp.ndarray, n_rows: int, n_cols: int) -> jnp.ndarray:
+    """Top-left submatrix copy (reference matrix.hpp:87 ``truncZeroOrigin``)."""
+    expects(
+        n_rows <= inp.shape[0] and n_cols <= inp.shape[1],
+        "trunc_zero_origin: target (%d, %d) exceeds source (%d, %d)",
+        n_rows, n_cols, inp.shape[0], inp.shape[1],
+    )
+    return inp[:n_rows, :n_cols]
+
+
+def col_reverse(inp: jnp.ndarray) -> jnp.ndarray:
+    """Reverse column order (reference matrix.hpp:113 ``colReverse``)."""
+    return inp[:, ::-1]
+
+
+def row_reverse(inp: jnp.ndarray) -> jnp.ndarray:
+    """Reverse row order (reference matrix.hpp:143 ``rowReverse``)."""
+    return inp[::-1, :]
+
+
+def print_host(inp, h_separator: str = ";", v_separator: str = ",") -> str:
+    """Format like the reference's host printer (matrix.hpp:199
+    ``printHost``); returns the string instead of writing stdout."""
+    import numpy as np
+
+    arr = np.asarray(inp)
+    rows = [v_separator.join(str(v) for v in row) for row in arr]
+    return h_separator.join(rows)
+
+
+def slice_matrix(inp: jnp.ndarray, x1: int, y1: int, x2: int, y2: int) -> jnp.ndarray:
+    """Submatrix [x1:x2, y1:y2] (reference matrix.hpp:223 ``sliceMatrix``)."""
+    expects(
+        0 <= x1 < x2 <= inp.shape[0] and 0 <= y1 < y2 <= inp.shape[1],
+        "slice_matrix: invalid bounds (%d,%d)-(%d,%d) for shape (%d,%d)",
+        x1, y1, x2, y2, inp.shape[0], inp.shape[1],
+    )
+    return inp[x1:x2, y1:y2]
+
+
+def copy_upper_triangular(src: jnp.ndarray) -> jnp.ndarray:
+    """Copy the strictly-upper+diagonal part into the k×k output where
+    k = min(rows, cols) (reference matrix.hpp:245 ``copyUpperTriangular``)."""
+    k = min(src.shape[0], src.shape[1])
+    return jnp.triu(src[:k, :k])
+
+
+def initialize_diagonal_matrix(vec: jnp.ndarray) -> jnp.ndarray:
+    """Diagonal matrix from vector (reference matrix.hpp:259)."""
+    return jnp.diag(vec)
+
+
+def get_diagonal_inverse_matrix(mat: jnp.ndarray) -> jnp.ndarray:
+    """Invert the diagonal in place (reference matrix.hpp:272); off-diagonal
+    entries are preserved, zeros on the diagonal invert to 0 like the
+    reference's guarded kernel."""
+    d = jnp.diagonal(mat)
+    inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
+    n = mat.shape[0]
+    return mat.at[jnp.arange(n), jnp.arange(n)].set(inv)
+
+
+def get_l2_norm(mat: jnp.ndarray) -> jnp.ndarray:
+    """Frobenius norm (reference matrix.hpp:284 ``getL2Norm``)."""
+    return jnp.sqrt(jnp.sum(mat * mat))

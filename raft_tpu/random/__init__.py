@@ -1,0 +1,5 @@
+"""Random number generation (reference cpp/include/raft/random/rng.hpp)."""
+
+from raft_tpu.random.rng import GeneratorType, Rng
+
+__all__ = ["Rng", "GeneratorType"]

@@ -1,0 +1,163 @@
+"""Device RNG with the reference's distribution set.
+
+Reference: cpp/include/raft/random/rng.hpp — ``Rng`` class (:66) wrapping
+three counter-based device generators (Philox/TapsKiss99,
+random/detail/rng_impl.cuh:130,177,242) with distributions
+uniform/uniformInt/normal/normalInt/normalTable/fill/bernoulli/
+scaled_bernoulli/gumbel/lognormal/logistic/exponential/rayleigh/laplace
+(:113-347), weighted ``sampleWithoutReplacement`` (:350), and
+``affine_transform_params`` (:96).
+
+TPU redesign: JAX's splittable threefry counter-based PRNG plays the Philox
+role (same design family: stateless, counter-based, reproducible across
+devices).  The Rng object keeps the reference's stateful-object ergonomics
+by splitting its key on every draw.  Weighted sampling without replacement
+uses the Gumbel-top-k trick — an exact reformulation that turns the
+reference's sort-by-perturbed-weight kernel into one vectorized top-k.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class GeneratorType(enum.IntEnum):
+    """(reference rng.hpp:34 GenPhilox/GenTaps/GenKiss99; all map to
+    threefry on TPU — kept so consumer configs round-trip)."""
+
+    GenPhilox = 0
+    GenTaps = 1
+    GenKiss99 = 2
+
+
+class Rng:
+    """Stateful-feeling wrapper over JAX's functional PRNG
+    (reference rng.hpp:66)."""
+
+    def __init__(self, seed: int, gtype: GeneratorType = GeneratorType.GenPhilox):
+        self.gtype = gtype
+        self._key = jax.random.PRNGKey(seed)
+
+    def seed(self, s: int) -> None:
+        """Re-seed (reference rng.hpp:83)."""
+        self._key = jax.random.PRNGKey(s)
+
+    def _next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def affine_transform_params(self, n: int) -> Tuple[int, int]:
+        """Random (a, b) for the affine index transform ``a*i + b (mod n)``
+        with a coprime to n (reference rng.hpp:96)."""
+        import math
+
+        k1, k2 = jax.random.split(self._next())
+        a = int(jax.random.randint(k1, (), 1, max(n, 2)))
+        while math.gcd(a, n) != 1:
+            a = (a + 1) % n or 1
+        b = int(jax.random.randint(k2, (), 0, max(n, 1)))
+        return a, b
+
+    # ------------------------------------------------------------------ #
+    # distributions (reference rng.hpp:113-347)
+    # ------------------------------------------------------------------ #
+    def uniform(self, shape, start=0.0, end=1.0, dtype=jnp.float32):
+        """(reference rng.hpp:113)"""
+        return jax.random.uniform(self._next(), shape, dtype=dtype, minval=start, maxval=end)
+
+    def uniform_int(self, shape, start, end, dtype=jnp.int32):
+        """Integers in [start, end) (reference rng.hpp:118)."""
+        return jax.random.randint(self._next(), shape, start, end, dtype=dtype)
+
+    def normal(self, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+        """(reference rng.hpp:136)"""
+        return mu + sigma * jax.random.normal(self._next(), shape, dtype=dtype)
+
+    def normal_int(self, shape, mu, sigma, dtype=jnp.int32):
+        """Rounded normal (reference rng.hpp:141)."""
+        vals = mu + sigma * jax.random.normal(self._next(), shape, dtype=jnp.float32)
+        return jnp.round(vals).astype(dtype)
+
+    def normal_table(self, n_rows, mu_vec, sigma_vec=None, sigma=1.0, dtype=jnp.float32):
+        """Table of normals: row i ~ N(mu_vec, sigma_vec) per column
+        (reference rng.hpp:168 ``normalTable``)."""
+        n_cols = mu_vec.shape[0]
+        z = jax.random.normal(self._next(), (n_rows, n_cols), dtype=dtype)
+        s = sigma_vec[None, :] if sigma_vec is not None else sigma
+        return mu_vec[None, :] + s * z
+
+    def fill(self, shape, val, dtype=jnp.float32):
+        """(reference rng.hpp:189)"""
+        return jnp.full(shape, val, dtype=dtype)
+
+    def bernoulli(self, shape, prob, dtype=jnp.bool_):
+        """P(True) = prob (reference rng.hpp:207)."""
+        return jax.random.bernoulli(self._next(), prob, shape).astype(dtype)
+
+    def scaled_bernoulli(self, shape, prob, scale, dtype=jnp.float32):
+        """±scale with P(+scale) = prob (reference rng.hpp:223: the kernel
+        emits ``val > prob ? -scale : scale``, so +scale when u <= prob)."""
+        u = jax.random.uniform(self._next(), shape, dtype=dtype)
+        return jnp.where(u > prob, -scale, scale).astype(dtype)
+
+    def gumbel(self, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+        """(reference rng.hpp:240)"""
+        return mu + beta * jax.random.gumbel(self._next(), shape, dtype=dtype)
+
+    def lognormal(self, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+        """exp(N(mu, sigma)) (reference rng.hpp:256)."""
+        return jnp.exp(self.normal(shape, mu, sigma, dtype=dtype))
+
+    def logistic(self, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+        """(reference rng.hpp:272)"""
+        return mu + scale * jax.random.logistic(self._next(), shape, dtype=dtype)
+
+    def exponential(self, shape, lam=1.0, dtype=jnp.float32):
+        """Rate-lambda exponential (reference rng.hpp:287)."""
+        return jax.random.exponential(self._next(), shape, dtype=dtype) / lam
+
+    def rayleigh(self, shape, sigma=1.0, dtype=jnp.float32):
+        """(reference rng.hpp:302)"""
+        u = jax.random.uniform(self._next(), shape, dtype=dtype)
+        return sigma * jnp.sqrt(-2.0 * jnp.log1p(-u))
+
+    def laplace(self, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+        """(reference rng.hpp:318)"""
+        return jax.random.laplace(self._next(), shape, dtype=dtype) * scale + mu
+
+    # ------------------------------------------------------------------ #
+    # sampling (reference rng.hpp:350)
+    # ------------------------------------------------------------------ #
+    def sample_without_replacement(
+        self,
+        items: jnp.ndarray,
+        sampled_len: int,
+        weights: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Weighted sampling without replacement via Gumbel-top-k.
+
+        Reference (rng.hpp:350 + detail/rng_impl.cuh): perturbs each weight
+        with a random draw and sorts; the Gumbel-top-k trick is the exact
+        probabilistic equivalent (keys = log w + Gumbel noise; top-k keys
+        are a weighted sample without replacement) and maps to one top-k op.
+        Returns ``(sampled_items, sampled_indices)``.
+        """
+        n = items.shape[0]
+        expects(
+            0 < sampled_len <= n,
+            "sample_without_replacement: sampled_len %d out of range (0, %d]",
+            sampled_len, n,
+        )
+        g = jax.random.gumbel(self._next(), (n,), dtype=jnp.float32)
+        if weights is not None:
+            keys = jnp.log(jnp.maximum(weights.astype(jnp.float32), 1e-37)) + g
+        else:
+            keys = g
+        _, idx = jax.lax.top_k(keys, sampled_len)
+        return jnp.take(items, idx, axis=0), idx

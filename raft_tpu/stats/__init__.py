@@ -1,0 +1,7 @@
+"""Summary statistics (reference cpp/include/raft/stats/: mean.hpp:44,
+stddev.hpp:45,76, sum.hpp:41, mean_center.hpp:41,77 — row/col-major ×
+sample/population variants)."""
+
+from raft_tpu.stats.stats import mean, mean_add, mean_center, stddev, sum_cols, vars_
+
+__all__ = ["mean", "stddev", "vars_", "sum_cols", "mean_center", "mean_add"]

@@ -1,0 +1,59 @@
+"""Column statistics over (n_samples, n_features) data.
+
+Reference: cpp/include/raft/stats/ — the reference computes per-*column*
+statistics (one value per feature) with row-major/col-major kernel variants;
+here the logical reduction over axis 0 is all that remains.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mean(data: jnp.ndarray, sample: bool = False, row_major: bool = True) -> jnp.ndarray:
+    """Per-column mean (reference stats/mean.hpp:44).  ``sample`` selects the
+    (n-1) divisor — kept for signature parity; for mean both divisors are n
+    in the reference too (the flag matters for stddev)."""
+    del sample, row_major
+    return jnp.mean(data, axis=0)
+
+
+def sum_cols(data: jnp.ndarray, row_major: bool = True) -> jnp.ndarray:
+    """Per-column sum (reference stats/sum.hpp:41)."""
+    del row_major
+    return jnp.sum(data, axis=0)
+
+
+def vars_(
+    data: jnp.ndarray,
+    mu: jnp.ndarray | None = None,
+    sample: bool = True,
+    row_major: bool = True,
+) -> jnp.ndarray:
+    """Per-column variance (reference stats/stddev.hpp:76 ``vars``)."""
+    del row_major
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    n = data.shape[0]
+    ss = jnp.sum((data - mu[None, :]) ** 2, axis=0)
+    return ss / (n - 1 if sample else n)
+
+
+def stddev(
+    data: jnp.ndarray,
+    mu: jnp.ndarray | None = None,
+    sample: bool = True,
+    row_major: bool = True,
+) -> jnp.ndarray:
+    """Per-column standard deviation (reference stats/stddev.hpp:45)."""
+    return jnp.sqrt(vars_(data, mu=mu, sample=sample, row_major=row_major))
+
+
+def mean_center(data: jnp.ndarray, mu: jnp.ndarray, bcast_along_rows: bool = True) -> jnp.ndarray:
+    """Subtract the mean vector (reference stats/mean_center.hpp:41)."""
+    return data - (mu[None, :] if bcast_along_rows else mu[:, None])
+
+
+def mean_add(data: jnp.ndarray, mu: jnp.ndarray, bcast_along_rows: bool = True) -> jnp.ndarray:
+    """Add the mean vector back (reference stats/mean_center.hpp:77)."""
+    return data + (mu[None, :] if bcast_along_rows else mu[:, None])

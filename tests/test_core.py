@@ -138,3 +138,18 @@ class TestTracing:
         finally:
             tracing.set_enabled(True)
         assert tracing.is_enabled()
+
+
+class TestTracingPopWhileDisabled:
+    """Regression: pop must drain the stack even when tracing is disabled."""
+
+    def test_push_disable_pop(self):
+        from raft_tpu.core import tracing
+
+        tracing.range_push("leaky")
+        tracing.set_enabled(False)
+        try:
+            tracing.range_pop()
+            assert len(tracing._range_stack) == 0
+        finally:
+            tracing.set_enabled(True)

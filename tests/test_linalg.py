@@ -1,0 +1,309 @@
+"""Dense linalg tests vs naive numpy references.
+
+Mirrors the reference's parameterized-vs-naive-kernel strategy
+(cpp/test/linalg/*.cu, e.g. test/linalg/norm.cu, reduce.cu, eig.cu).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+
+SIZES = [(16, 8), (64, 33), (128, 128)]
+
+
+def _rand(rng, shape, dtype=np.float64):
+    return rng.standard_normal(shape).astype(dtype)
+
+
+class TestGemm:
+    @pytest.mark.parametrize("ta,tb", [(False, False), (True, False), (False, True), (True, True)])
+    def test_gemm_transposes(self, rng, ta, tb):
+        a = _rand(rng, (12, 7) if not ta else (7, 12))
+        b = _rand(rng, (7, 9) if not tb else (9, 7))
+        out = linalg.gemm(a, b, trans_a=ta, trans_b=tb)
+        ref = (a.T if ta else a) @ (b.T if tb else b)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-10)
+
+    def test_gemm_alpha_beta(self, rng):
+        a, b = _rand(rng, (5, 4)), _rand(rng, (4, 6))
+        c = _rand(rng, (5, 6))
+        out = linalg.gemm(a, b, alpha=2.5, beta=-0.5, c=c)
+        np.testing.assert_allclose(np.asarray(out), 2.5 * a @ b - 0.5 * c, rtol=1e-10)
+
+    def test_gemm_shape_error(self, rng):
+        from raft_tpu import RaftError
+
+        with pytest.raises(RaftError):
+            linalg.gemm(_rand(rng, (3, 4)), _rand(rng, (5, 6)))
+
+    def test_gemv(self, rng):
+        a, x, y = _rand(rng, (8, 5)), _rand(rng, (5,)), _rand(rng, (8,))
+        out = linalg.gemv(a, x, alpha=3.0, beta=1.0, y=y)
+        np.testing.assert_allclose(np.asarray(out), 3.0 * a @ x + y, rtol=1e-10)
+        out_t = linalg.gemv(a, y, trans_a=True)
+        np.testing.assert_allclose(np.asarray(out_t), a.T @ y, rtol=1e-10)
+
+
+class TestEig:
+    def _sym(self, rng, n):
+        a = _rand(rng, (n, n))
+        return (a + a.T) / 2
+
+    def test_eig_dc_reconstruction(self, rng):
+        a = self._sym(rng, 20)
+        v, w = linalg.eig_dc(a)
+        np.testing.assert_allclose(np.asarray(v) @ np.diag(np.asarray(w)) @ np.asarray(v).T, a, atol=1e-8)
+        assert np.all(np.diff(np.asarray(w)) >= -1e-12)
+
+    @pytest.mark.parametrize("largest", [False, True])
+    def test_eig_sel(self, rng, largest):
+        a = self._sym(rng, 16)
+        v, w = linalg.eig_sel_dc(a, 4, largest=largest)
+        ref_w = np.linalg.eigvalsh(a)
+        expect = ref_w[-4:] if largest else ref_w[:4]
+        np.testing.assert_allclose(np.asarray(w), expect, atol=1e-8)
+        assert v.shape == (16, 4)
+
+    def test_eig_jacobi_matches_dc(self, rng):
+        a = self._sym(rng, 10)
+        _, w1 = linalg.eig_dc(a)
+        _, w2 = linalg.eig_jacobi(a, tol=1e-8, sweeps=20)
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), atol=1e-10)
+
+
+class TestSvd:
+    @pytest.mark.parametrize("m,n", [(20, 8), (16, 16)])
+    def test_svd_qr(self, rng, m, n):
+        a = _rand(rng, (m, n))
+        u, s, v = linalg.svd_qr(a)
+        np.testing.assert_allclose(
+            np.asarray(linalg.svd_reconstruction(u, s, v)), a, atol=1e-8
+        )
+        assert linalg.svd.evaluate_svd_by_l2_norm(a, u, s, v, 1e-6)
+
+    def test_svd_eig_matches_svd_qr_values(self, rng):
+        a = _rand(rng, (30, 6))
+        _, s_ref, _ = linalg.svd_qr(a)
+        u, s, v = linalg.svd_eig(a)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(linalg.svd_reconstruction(u, s, v)), a, atol=1e-6
+        )
+
+    def test_svd_eig_requires_tall(self, rng):
+        from raft_tpu import RaftError
+
+        with pytest.raises(RaftError):
+            linalg.svd_eig(_rand(rng, (4, 8)))
+
+
+class TestQr:
+    def test_qr(self, rng):
+        a = _rand(rng, (12, 5))
+        q, r = linalg.qr_get_qr(a)
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(q).T @ np.asarray(q), np.eye(5), atol=1e-10)
+        q2 = linalg.qr_get_q(a)
+        np.testing.assert_allclose(np.abs(np.asarray(q2)), np.abs(np.asarray(q)), atol=1e-10)
+
+
+class TestCholesky:
+    def test_rank1_update_builds_full_factor(self, rng):
+        n = 8
+        b = _rand(rng, (n, n))
+        a = b @ b.T + n * np.eye(n)
+        ref_l = np.linalg.cholesky(a)
+        # incrementally build the factor row by row like the SVM use case
+        work = np.zeros((n, n))
+        for k in range(1, n + 1):
+            work[k - 1, :k] = a[k - 1, :k]
+            work = np.array(linalg.cholesky_rank1_update(jnp.array(work), k))
+        np.testing.assert_allclose(np.tril(work), ref_l, atol=1e-8)
+
+
+class TestElementwise:
+    def test_ops(self, rng):
+        x, y = _rand(rng, (6, 6)), _rand(rng, (6, 6))
+        np.testing.assert_allclose(np.asarray(linalg.eltwise_add(x, y)), x + y)
+        np.testing.assert_allclose(np.asarray(linalg.eltwise_sub(x, y)), x - y)
+        np.testing.assert_allclose(np.asarray(linalg.eltwise_multiply(x, y)), x * y)
+        np.testing.assert_allclose(np.asarray(linalg.eltwise_divide(x, y)), x / y)
+        np.testing.assert_allclose(np.asarray(linalg.add_scalar(x, 2.0)), x + 2)
+        np.testing.assert_allclose(np.asarray(linalg.multiply_scalar(x, 3.0)), x * 3)
+        np.testing.assert_allclose(
+            np.asarray(linalg.unary_op(x, lambda v: v * v)), x * x
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.binary_op(x, y, lambda a, b: a * b + 1)), x * y + 1
+        )
+
+    def test_divide_check_zero(self):
+        x = jnp.array([1.0, 2.0, 3.0])
+        y = jnp.array([2.0, 0.0, 4.0])
+        out = linalg.elementwise.eltwise_divide_check_zero(x, y)
+        np.testing.assert_allclose(np.asarray(out), [0.5, 0.0, 0.75])
+
+
+class TestReduce:
+    @pytest.mark.parametrize("shape", SIZES)
+    def test_coalesced_sum(self, rng, shape):
+        x = _rand(rng, shape)
+        out = linalg.coalesced_reduction(jnp.array(x))
+        np.testing.assert_allclose(np.asarray(out), x.sum(axis=1), rtol=1e-10)
+
+    def test_strided_sum(self, rng):
+        x = _rand(rng, (32, 9))
+        out = linalg.strided_reduction(jnp.array(x))
+        np.testing.assert_allclose(np.asarray(out), x.sum(axis=0), rtol=1e-10)
+
+    def test_reduce_lambdas(self, rng):
+        # L2-norm built from lambdas like the reference's norm tests
+        x = _rand(rng, (10, 7))
+        out = linalg.reduce(
+            jnp.array(x),
+            along_rows=True,
+            main_op=lambda v, i: v * v,
+            final_op=jnp.sqrt,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.linalg.norm(x, axis=1), rtol=1e-10)
+
+    def test_reduce_custom_reduce_op(self, rng):
+        x = np.abs(_rand(rng, (8, 5))) + 0.1
+        out = linalg.reduce(
+            jnp.array(x),
+            along_rows=False,
+            reduce_op=jnp.maximum,
+            init=-np.inf,
+        )
+        np.testing.assert_allclose(np.asarray(out), x.max(axis=0), rtol=1e-10)
+
+    def test_map_then_reduce(self, rng):
+        x, y = _rand(rng, (40,)), _rand(rng, (40,))
+        out = linalg.map_then_sum_reduce(lambda a, b: (a - b) ** 2, jnp.array(x), jnp.array(y))
+        np.testing.assert_allclose(float(out), ((x - y) ** 2).sum(), rtol=1e-10)
+
+
+class TestNorm:
+    @pytest.mark.parametrize("shape", SIZES)
+    def test_row_norms(self, rng, shape):
+        x = _rand(rng, shape)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(jnp.array(x), linalg.L1Norm)),
+            np.abs(x).sum(axis=1), rtol=1e-10)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(jnp.array(x), linalg.L2Norm)),
+            (x * x).sum(axis=1), rtol=1e-10)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(jnp.array(x), linalg.L2Norm, do_sqrt=True)),
+            np.linalg.norm(x, axis=1), rtol=1e-10)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(jnp.array(x), linalg.LinfNorm)),
+            np.abs(x).max(axis=1), rtol=1e-10)
+
+    def test_col_norm_fin_op(self, rng):
+        x = _rand(rng, (20, 6))
+        out = linalg.col_norm(jnp.array(x), linalg.L2Norm, do_sqrt=True, fin_op=lambda v: 1.0 / v)
+        np.testing.assert_allclose(np.asarray(out), 1.0 / np.linalg.norm(x, axis=0), rtol=1e-10)
+
+    def test_mse(self, rng):
+        a, b = _rand(rng, (50,)), _rand(rng, (50,))
+        out = linalg.mean_squared_error(jnp.array(a), jnp.array(b), weight=2.0)
+        np.testing.assert_allclose(float(out), 2.0 * ((a - b) ** 2).mean(), rtol=1e-10)
+
+
+class TestMatrixVectorOp:
+    def test_bcast_rows(self, rng):
+        m, v = _rand(rng, (6, 4)), _rand(rng, (4,))
+        out = linalg.matrix_vector_op(jnp.array(m), jnp.array(v), lambda a, b: a + b)
+        np.testing.assert_allclose(np.asarray(out), m + v[None, :], rtol=1e-10)
+
+    def test_bcast_cols_two_vecs(self, rng):
+        m, v1, v2 = _rand(rng, (6, 4)), _rand(rng, (6,)), _rand(rng, (6,))
+        out = linalg.matrix_vector_op(
+            jnp.array(m), jnp.array(v1), lambda a, b, c: (a - b) / c,
+            bcast_along_rows=False, vec2=jnp.array(v2))
+        np.testing.assert_allclose(np.asarray(out), (m - v1[:, None]) / v2[:, None], rtol=1e-10)
+
+    def test_length_mismatch(self, rng):
+        from raft_tpu import RaftError
+
+        with pytest.raises(RaftError):
+            linalg.matrix_vector_op(jnp.zeros((3, 4)), jnp.zeros(5), lambda a, b: a + b)
+
+
+class TestMisc:
+    def test_transpose(self, rng):
+        x = _rand(rng, (5, 9))
+        np.testing.assert_allclose(np.asarray(linalg.transpose(jnp.array(x))), x.T)
+
+    def test_range_init(self):
+        np.testing.assert_array_equal(np.asarray(linalg.range_init(3, 10)), np.arange(3, 10))
+
+
+class TestLanczos:
+    def test_smallest_dense(self, rng):
+        n = 60
+        b = _rand(rng, (n, n), np.float64)
+        a = jnp.array((b + b.T) / 2)
+        vals, vecs, iters = linalg.compute_smallest_eigenvectors(a, n, 3, tol=1e-9)
+        ref = np.linalg.eigvalsh(np.asarray(a))[:3]
+        np.testing.assert_allclose(np.asarray(vals), ref, atol=1e-6)
+        # residual check: ||A v - lambda v|| small
+        r = np.asarray(a) @ np.asarray(vecs) - np.asarray(vecs) * np.asarray(vals)[None, :]
+        assert np.linalg.norm(r, axis=0).max() < 1e-5
+        assert iters > 0
+
+    def test_largest_matvec_operator(self, rng):
+        n = 40
+        b = _rand(rng, (n, n), np.float64)
+        a = (b + b.T) / 2
+        a_j = jnp.array(a)
+        vals, vecs, _ = linalg.compute_largest_eigenvectors(lambda x: a_j @ x, n, 2, tol=1e-9)
+        # operator path needs explicit float dtype handling
+        ref = np.linalg.eigvalsh(a)[-2:][::-1]
+        np.testing.assert_allclose(np.asarray(vals), ref, atol=1e-5)
+
+    def test_k_out_of_range(self, rng):
+        from raft_tpu import RaftError
+
+        with pytest.raises(RaftError):
+            linalg.compute_smallest_eigenvectors(jnp.eye(5), 5, 5)
+
+
+class TestLanczosDegenerate:
+    """Regression: Krylov exhaustion must not fabricate zero-residual pairs."""
+
+    def test_identity(self):
+        vals, vecs, _ = linalg.compute_smallest_eigenvectors(jnp.eye(60), 60, 3)
+        np.testing.assert_allclose(np.asarray(vals), [1.0, 1.0, 1.0], atol=1e-8)
+        norms = np.linalg.norm(np.asarray(vecs), axis=0)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-8)
+
+    def test_low_rank_plus_shift(self, rng):
+        n = 50
+        u = rng.standard_normal((n, 2))
+        a = jnp.array(u @ u.T + 5.0 * np.eye(n))
+        vals, _, _ = linalg.compute_largest_eigenvectors(a, n, 2)
+        ref = np.linalg.eigvalsh(np.asarray(a))[-2:][::-1]
+        np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-6)
+
+
+class TestCholeskyJit:
+    def test_jit_compatible(self, rng):
+        import jax
+
+        b = rng.standard_normal((6, 6))
+        a = b @ b.T + 6 * np.eye(6)
+        work = np.zeros((6, 6))
+        work[0, 0] = a[0, 0]
+        f = jax.jit(lambda m: linalg.cholesky_rank1_update(m, 1, eps=1e-12))
+        out = f(jnp.array(work))
+        assert float(out[0, 0]) == pytest.approx(np.sqrt(a[0, 0]))
+
+    def test_n1_eps_check(self):
+        from raft_tpu import RaftError
+
+        with pytest.raises(RaftError):
+            linalg.cholesky_rank1_update(jnp.array([[-1.0]]), 1, eps=1e-12)

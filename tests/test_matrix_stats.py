@@ -1,0 +1,109 @@
+"""Matrix manipulation/math + stats tests vs numpy references
+(reference cpp/test/matrix/{matrix.cu,math.cu}, test/stats/*.cu)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix, stats
+from raft_tpu.core.error import RaftError
+
+
+class TestMatrix:
+    def test_copy_rows(self, rng):
+        x = rng.standard_normal((10, 4))
+        idx = jnp.array([7, 1, 3])
+        np.testing.assert_allclose(np.asarray(matrix.copy_rows(jnp.array(x), idx)), x[[7, 1, 3]])
+
+    def test_trunc_and_slice(self, rng):
+        x = rng.standard_normal((8, 8))
+        np.testing.assert_allclose(
+            np.asarray(matrix.trunc_zero_origin(jnp.array(x), 3, 5)), x[:3, :5])
+        np.testing.assert_allclose(
+            np.asarray(matrix.slice_matrix(jnp.array(x), 2, 1, 6, 4)), x[2:6, 1:4])
+        with pytest.raises(RaftError):
+            matrix.slice_matrix(jnp.array(x), 5, 0, 3, 4)
+
+    def test_reverses(self, rng):
+        x = rng.standard_normal((5, 7))
+        np.testing.assert_allclose(np.asarray(matrix.col_reverse(jnp.array(x))), x[:, ::-1])
+        np.testing.assert_allclose(np.asarray(matrix.row_reverse(jnp.array(x))), x[::-1, :])
+
+    def test_triangular_diag(self, rng):
+        x = rng.standard_normal((6, 6))
+        np.testing.assert_allclose(np.asarray(matrix.copy_upper_triangular(jnp.array(x))), np.triu(x))
+        v = rng.standard_normal(4)
+        np.testing.assert_allclose(np.asarray(matrix.initialize_diagonal_matrix(jnp.array(v))), np.diag(v))
+        m = np.ones((3, 3))
+        np.fill_diagonal(m, [2.0, 4.0, 0.0])
+        out = np.asarray(matrix.get_diagonal_inverse_matrix(jnp.array(m)))
+        np.testing.assert_allclose(np.diag(out), [0.5, 0.25, 0.0])
+        assert out[0, 1] == 1.0  # off-diagonal preserved
+
+    def test_l2norm_print(self, rng):
+        x = rng.standard_normal((4, 4))
+        np.testing.assert_allclose(float(matrix.get_l2_norm(jnp.array(x))), np.linalg.norm(x), rtol=1e-10)
+        s = matrix.print_host(jnp.array([[1.0, 2.0], [3.0, 4.0]]))
+        assert s == "1.0,2.0;3.0,4.0"
+
+
+class TestMatrixMath:
+    def test_power_seqroot(self, rng):
+        x = np.abs(rng.standard_normal((5, 5))) + 0.1
+        np.testing.assert_allclose(np.asarray(matrix.power(jnp.array(x))), x * x)
+        np.testing.assert_allclose(np.asarray(matrix.power(jnp.array(x), 2.0)), 2 * x * x)
+        np.testing.assert_allclose(np.asarray(matrix.seq_root(jnp.array(x))), np.sqrt(x), rtol=1e-7)
+        neg = jnp.array([-1.0, 4.0])
+        np.testing.assert_allclose(np.asarray(matrix.seq_root(neg, set_neg_zero=True)), [0.0, 2.0])
+
+    def test_small_values_reciprocal(self):
+        x = jnp.array([1e-20, 0.5, -1e-18, 2.0])
+        np.testing.assert_allclose(np.asarray(matrix.set_small_values_zero(x)), [0.0, 0.5, 0.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(matrix.reciprocal(x, setzero=True, thres=1e-10)), [0.0, 2.0, 0.0, 0.5])
+
+    def test_ratio_argmax_signflip(self, rng):
+        x = np.array([[1.0, 3.0], [4.0, 2.0]])
+        np.testing.assert_allclose(np.asarray(matrix.ratio(jnp.array(x))), x / x.sum())
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(jnp.array(x))), [1, 0])
+        m = np.array([[1.0, -5.0], [-3.0, 2.0]])
+        out = np.asarray(matrix.sign_flip(jnp.array(m)))
+        # col 0 pivot is -3 -> flipped; col 1 pivot is -5 -> flipped
+        np.testing.assert_allclose(out, [[-1.0, 5.0], [3.0, -2.0]])
+
+    def test_matrix_vector_binaries(self, rng):
+        m = rng.standard_normal((4, 3))
+        v = np.array([2.0, 0.0, 4.0])
+        jm, jv = jnp.array(m), jnp.array(v)
+        np.testing.assert_allclose(np.asarray(matrix.matrix_vector_binary_mult(jm, jv)), m * v)
+        out = np.asarray(matrix.matrix_vector_binary_mult_skip_zero(jm, jv))
+        np.testing.assert_allclose(out[:, 1], m[:, 1])  # zero col untouched
+        out = np.asarray(matrix.matrix_vector_binary_div_skip_zero(jm, jv, return_zero=True))
+        np.testing.assert_allclose(out[:, 1], 0.0)
+        np.testing.assert_allclose(np.asarray(matrix.matrix_vector_binary_add(jm, jv)), m + v)
+        np.testing.assert_allclose(np.asarray(matrix.matrix_vector_binary_sub(jm, jv)), m - v)
+
+
+class TestStats:
+    @pytest.mark.parametrize("n,d", [(100, 5), (1000, 32)])
+    def test_mean_sum(self, rng, n, d):
+        x = rng.standard_normal((n, d))
+        np.testing.assert_allclose(np.asarray(stats.mean(jnp.array(x))), x.mean(axis=0), atol=1e-10)
+        np.testing.assert_allclose(np.asarray(stats.sum_cols(jnp.array(x))), x.sum(axis=0), atol=1e-8)
+
+    @pytest.mark.parametrize("sample", [True, False])
+    def test_stddev_vars(self, rng, sample):
+        x = rng.standard_normal((200, 4))
+        ddof = 1 if sample else 0
+        np.testing.assert_allclose(
+            np.asarray(stats.vars_(jnp.array(x), sample=sample)), x.var(axis=0, ddof=ddof), rtol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(stats.stddev(jnp.array(x), sample=sample)), x.std(axis=0, ddof=ddof), rtol=1e-8)
+
+    def test_mean_center_roundtrip(self, rng):
+        x = rng.standard_normal((50, 3))
+        mu = stats.mean(jnp.array(x))
+        centered = stats.mean_center(jnp.array(x), mu)
+        np.testing.assert_allclose(np.asarray(stats.mean(centered)), 0.0, atol=1e-12)
+        back = stats.mean_add(centered, mu)
+        np.testing.assert_allclose(np.asarray(back), x, atol=1e-12)

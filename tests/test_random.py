@@ -1,0 +1,127 @@
+"""RNG distribution tests (reference cpp/test/random/rng.cu — mean/stddev
+moment checks per distribution; sample_without_replacement weight tests)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.error import RaftError
+from raft_tpu.random import GeneratorType, Rng
+
+N = 40_000
+
+
+@pytest.fixture
+def r():
+    return Rng(seed=42)
+
+
+def _moments(x):
+    x = np.asarray(x, dtype=np.float64)
+    return x.mean(), x.std()
+
+
+class TestDistributions:
+    def test_uniform(self, r):
+        x = np.asarray(r.uniform((N,), start=-1.0, end=3.0))
+        assert -1.0 <= x.min() and x.max() < 3.0
+        assert abs(x.mean() - 1.0) < 0.05
+
+    def test_uniform_int(self, r):
+        x = np.asarray(r.uniform_int((N,), 5, 10))
+        assert set(np.unique(x)) <= {5, 6, 7, 8, 9}
+
+    def test_normal(self, r):
+        m, s = _moments(r.normal((N,), mu=2.0, sigma=3.0))
+        assert abs(m - 2.0) < 0.1 and abs(s - 3.0) < 0.1
+
+    def test_normal_int(self, r):
+        x = np.asarray(r.normal_int((N,), 100, 10))
+        assert np.issubdtype(x.dtype, np.integer)
+        assert abs(x.mean() - 100) < 1.0
+
+    def test_normal_table(self, r):
+        import jax.numpy as jnp
+
+        mu = jnp.array([0.0, 10.0, -5.0])
+        x = np.asarray(r.normal_table(N, mu, sigma=2.0))
+        np.testing.assert_allclose(x.mean(axis=0), [0.0, 10.0, -5.0], atol=0.2)
+
+    def test_fill_bernoulli(self, r):
+        assert np.all(np.asarray(r.fill((7,), 3.5)) == 3.5)
+        b = np.asarray(r.bernoulli((N,), 0.3))
+        assert abs(b.mean() - 0.3) < 0.02
+        sb = np.asarray(r.scaled_bernoulli((N,), 0.3, 2.0))
+        assert set(np.unique(sb)) == {-2.0, 2.0}
+        # P(+scale) = P(u <= prob)? reference: val > prob ? -scale : scale
+        assert abs((sb == 2.0).mean() - 0.3) < 0.02
+
+    def test_gumbel(self, r):
+        m, _ = _moments(r.gumbel((N,), mu=1.0, beta=2.0))
+        assert abs(m - (1.0 + 2.0 * 0.5772)) < 0.1
+
+    def test_lognormal(self, r):
+        x = np.asarray(r.lognormal((N,), mu=0.0, sigma=0.5))
+        assert abs(np.log(x).mean()) < 0.05
+
+    def test_logistic(self, r):
+        m, s = _moments(r.logistic((N,), mu=3.0, scale=1.0))
+        assert abs(m - 3.0) < 0.1
+        assert abs(s - np.pi / np.sqrt(3)) < 0.1
+
+    def test_exponential(self, r):
+        m, _ = _moments(r.exponential((N,), lam=2.0))
+        assert abs(m - 0.5) < 0.02
+
+    def test_rayleigh(self, r):
+        m, _ = _moments(r.rayleigh((N,), sigma=2.0))
+        assert abs(m - 2.0 * np.sqrt(np.pi / 2)) < 0.1
+
+    def test_laplace(self, r):
+        m, s = _moments(r.laplace((N,), mu=1.0, scale=2.0))
+        assert abs(m - 1.0) < 0.1
+        assert abs(s - 2.0 * np.sqrt(2)) < 0.15
+
+    def test_reproducible(self):
+        a = np.asarray(Rng(7).uniform((100,)))
+        b = np.asarray(Rng(7).uniform((100,)))
+        np.testing.assert_array_equal(a, b)
+        c = np.asarray(Rng(8).uniform((100,)))
+        assert not np.array_equal(a, c)
+
+    def test_generator_types_accepted(self):
+        for g in GeneratorType:
+            Rng(1, gtype=g).uniform((4,))
+
+
+class TestSampling:
+    def test_without_replacement_unweighted(self, r):
+        import jax.numpy as jnp
+
+        items = jnp.arange(100)
+        vals, idx = r.sample_without_replacement(items, 20)
+        assert len(np.unique(np.asarray(idx))) == 20
+        np.testing.assert_array_equal(np.asarray(vals), np.asarray(idx))
+
+    def test_without_replacement_weighted(self):
+        import jax.numpy as jnp
+
+        # one item has overwhelming weight -> always sampled
+        w = jnp.ones(50).at[13].set(1e6)
+        hits = 0
+        for seed in range(20):
+            _, idx = Rng(seed).sample_without_replacement(jnp.arange(50), 5, weights=w)
+            hits += int(13 in np.asarray(idx))
+        assert hits == 20
+
+    def test_bad_len(self, r):
+        import jax.numpy as jnp
+
+        with pytest.raises(RaftError):
+            r.sample_without_replacement(jnp.arange(10), 11)
+
+    def test_affine_params(self, r):
+        import math
+
+        a, b = r.affine_transform_params(100)
+        assert math.gcd(a, 100) == 1
+        assert 0 <= b < 100
