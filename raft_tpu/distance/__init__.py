@@ -1,0 +1,19 @@
+"""Pairwise distances (reference cpp/include/raft/distance/ +
+linalg/distance_type.h)."""
+
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pairwise import (
+    distance,
+    get_workspace_size,
+    pairwise_distance,
+)
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_min_reduce
+
+__all__ = [
+    "DistanceType",
+    "pairwise_distance",
+    "distance",
+    "get_workspace_size",
+    "fused_l2_nn",
+    "fused_l2_nn_min_reduce",
+]

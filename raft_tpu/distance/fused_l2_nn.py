@@ -1,0 +1,125 @@
+"""Fused L2 distance + 1-nearest-neighbor reduction.
+
+Reference: cpp/include/raft/distance/fused_l2_nn.hpp:84 +
+detail/fused_l2_nn.cuh:134,267 — one kernel computes the L2 distance tile
+and immediately argmin-reduces each row to its single nearest neighbor
+(key-value pairs, per-row mutex + atomics), so the (m, n) distance matrix
+is never materialized.  This is the workhorse of MST/connect_components
+and single-linkage.
+
+TPU re-design: scan over column tiles of y; each step is one MXU matmul
+(the expanded-L2 form) plus a per-row tile argmin, merged into a running
+(value, index) pair — the atomics/mutex machinery is replaced by the
+sequential-scan reduction, which XLA pipelines.  Memory high-water mark is
+(m, tile_n) instead of (m, n).  Ties between finite values break toward
+the smaller index (deterministic; the reference's atomic version is
+first-writer-wins).  Rows with no admissible pair (fully masked) keep the
+sentinel ``(inf, int32-max)``, mirroring the reference's untouched init KVP.
+
+A custom reduce op over (value, index) pairs can be supplied for consumers
+like connect_components that need color-aware semantics
+(detail/connect_components.cuh:89 FixConnectivitiesRedOp).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+IDX_SENTINEL = jnp.iinfo(jnp.int32).max
+
+
+def _default_reduce(best, cand):
+    bv, bi = best
+    cv, ci = cand
+    # strict improvement, or a finite tie broken toward the smaller index;
+    # inf==inf is NOT a tie so fully-masked rows keep the init sentinel
+    take = (cv < bv) | ((cv == bv) & jnp.isfinite(cv) & (ci < bi))
+    return jnp.where(take, cv, bv), jnp.where(take, ci, bi)
+
+
+def fused_l2_nn_min_reduce(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    sqrt: bool = False,
+    reduce_op: Optional[Callable] = None,
+    init_val: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    tile_n: int = 4096,
+    mask: Optional[jnp.ndarray] = None,
+    precision: str = "highest",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tiled L2 + 1-NN scan with a pluggable KVP reduce op (reference
+    fused_l2_nn.hpp:29-45 MinAndDistanceReduceOp / custom ops).
+
+    ``reduce_op(best (val, idx), cand (val, idx)) -> (val, idx)`` merges
+    each tile's candidate minimum per row into the running pair;
+    ``init_val`` seeds the running pair (default: ``(inf, int32-max)``).
+    ``mask`` (m, n), True = pair admissible; ``sqrt`` reports root
+    distances (applied per tile — monotonic, so the reduction semantics
+    are unchanged, matching the reference's in-kernel epilogue).
+    """
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
+            "fused_l2_nn: shape mismatch")
+    m = x.shape[0]
+    n = y.shape[0]
+    tile_n = min(tile_n, n)
+    rop = reduce_op or _default_reduce
+
+    xn = jnp.sum(x * x, axis=1)
+    yn = jnp.sum(y * y, axis=1)
+    n_tiles = -(-n // tile_n)
+    n_pad = n_tiles * tile_n
+    y_p = jnp.pad(y, ((0, n_pad - n), (0, 0)))
+    yn_p = jnp.pad(yn, (0, n_pad - n), constant_values=jnp.inf)
+    if mask is not None:
+        mask_p = jnp.pad(mask, ((0, 0), (0, n_pad - n)), constant_values=False)
+
+    def step(carry, tile_idx):
+        j0 = tile_idx * tile_n
+        y_t = jax.lax.dynamic_slice_in_dim(y_p, j0, tile_n, axis=0)
+        yn_t = jax.lax.dynamic_slice_in_dim(yn_p, j0, tile_n, axis=0)
+        d = xn[:, None] + yn_t[None, :] - 2.0 * jnp.matmul(x, y_t.T, precision=precision)
+        d = jnp.maximum(d, 0.0)
+        d = jnp.where(jnp.isfinite(yn_t)[None, :], d, jnp.inf)
+        if mask is not None:
+            mk = jax.lax.dynamic_slice_in_dim(mask_p, j0, tile_n, axis=1)
+            d = jnp.where(mk, d, jnp.inf)
+        if sqrt:
+            d = jnp.sqrt(d)
+        t_idx = jnp.argmin(d, axis=1)
+        t_val = jnp.take_along_axis(d, t_idx[:, None], axis=1)[:, 0]
+        cand = (t_val, (j0 + t_idx).astype(jnp.int32))
+        return rop(carry, cand), None
+
+    if init_val is None:
+        init_val = (
+            jnp.full((m,), jnp.inf, x.dtype),
+            jnp.full((m,), IDX_SENTINEL, jnp.int32),
+        )
+    out, _ = jax.lax.scan(step, init_val, jnp.arange(n_tiles))
+    return out
+
+
+def fused_l2_nn(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    sqrt: bool = False,
+    tile_n: int = 4096,
+    mask: Optional[jnp.ndarray] = None,
+    precision: str = "highest",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """For each row of x (m, k): min L2 distance to rows of y (n, k) and its
+    index.  Returns ``(min_dists (m,), min_idx (m,) int32)``.
+
+    ``sqrt`` applies the square root to the reported minimum (reference
+    fused_l2_nn.hpp:84 Sqrt template param).  ``mask`` (m, n) optionally
+    excludes pairs (True = allowed), the hook connect_components uses to
+    skip same-color pairs; a fully-masked row returns
+    ``(inf, IDX_SENTINEL)``.
+    """
+    return fused_l2_nn_min_reduce(
+        x, y, sqrt=sqrt, tile_n=tile_n, mask=mask, precision=precision)

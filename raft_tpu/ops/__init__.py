@@ -1,0 +1,12 @@
+"""Pallas TPU kernels — the hand-tiled hot ops.
+
+This package plays the role of the reference's custom CUDA kernels
+(pairwise_distance_base.cuh, fused_l2_nn.cuh, fused_l2_knn.cuh,
+selection_faiss.cuh): everything here is written against the TPU memory
+hierarchy (HBM → VMEM → MXU/VPU) with explicit block shapes, and falls back
+to interpreter mode off-TPU so the full test suite runs on CPU.
+"""
+
+from raft_tpu.ops.pairwise_tile import pairwise_tile
+
+__all__ = ["pairwise_tile"]

@@ -1,0 +1,126 @@
+"""Generic tiled pairwise-accumulation kernel.
+
+TPU-native re-design of the reference's ``PairwiseDistances`` GEMM-like
+template (cpp/include/raft/distance/detail/pairwise_distance_base.cuh:76:
+smem double-buffered tile loads + per-metric core_lambda accumulate +
+epilog_lambda), which powers every *unexpanded* metric (L1, Chebyshev,
+Canberra, Minkowski, Hamming, Jensen-Shannon, unexpanded L2).
+
+Design: grid = (m/bm, n/bn, k/bk) with the k axis innermost ("arbitrary"
+semantics) accumulating into a VMEM scratch block, exactly the Pallas
+matmul pattern.  The combine lambda sees an (bm, bk) x-tile and a
+(bk, bn) yᵀ-tile and produces an (bm, bk, bn) elementwise term that is
+reduced over the middle axis — this layout keeps n on the 128-wide lane
+dimension and k on sublanes, so the VPU runs full-width.  Pipelining
+(double-buffered HBM→VMEM) is done by the Pallas runtime from the
+BlockSpecs, playing the role of the reference's ldgXY/stsXY page-flipping
+(pairwise_distance_base.cuh:122-226).
+
+Zero-padding is used for edge tiles; every supported combine maps
+(0, 0) -> 0 contribution (guarded Canberra/JS included) so padded k is
+harmless, and padded rows/cols are sliced away by the wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.core.utils import ceildiv
+
+
+def _kernel(x_ref, yt_ref, o_ref, acc_ref, *, combine, reduce_kind, epilog,
+            n_k_tiles, init):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.full_like(acc_ref, init)
+
+    xv = x_ref[:]            # (bm, bk)
+    ytv = yt_ref[:]          # (bk, bn)
+    term = combine(xv[:, :, None], ytv[None, :, :])  # (bm, bk, bn)
+    if reduce_kind == "add":
+        acc_ref[:] = acc_ref[:] + jnp.sum(term, axis=1)
+    else:
+        acc_ref[:] = jnp.maximum(acc_ref[:], jnp.max(term, axis=1))
+
+    @pl.when(pl.program_id(2) == n_k_tiles - 1)
+    def _fin():
+        out = acc_ref[:]
+        if epilog is not None:
+            out = epilog(out)
+        o_ref[:] = out.astype(o_ref.dtype)
+
+
+def pairwise_tile(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    combine: Callable,
+    reduce_kind: str = "add",
+    epilog: Optional[Callable] = None,
+    init: float = 0.0,
+    block_m: int = 128,
+    block_n: int = 128,
+    block_k: int = 128,
+    out_dtype=None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Compute ``reduce_k combine(x[i, k], y[j, k])`` for all (i, j).
+
+    ``combine`` receives broadcastable views shaped (bm, bk, 1) and
+    (1, bk, bn) and must work elementwise; ``reduce_kind`` is "add" or
+    "max"; ``epilog`` maps the accumulated (bm, bn) block.
+    """
+    m, k = x.shape
+    n, k2 = y.shape
+    assert k == k2, (k, k2)
+    if out_dtype is None:
+        # distances are fractional even for integer inputs (Hamming means,
+        # Canberra ratios): never truncate back to an integer dtype
+        out_dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Mosaic requires the last block dim to be 128-divisible or span the
+    # whole array, and the second-to-last to be 8-divisible or span it.
+    # k <= 128: one full-k block (padded to a sublane multiple); larger k is
+    # chunked in multiples of 128 (block_k rounded).  bm adapts so the
+    # (bm, bk, bn) broadcast intermediate stays within a VMEM budget.
+    bn = min(block_n, n) if n < 128 else 128 * min(ceildiv(block_n, 128), ceildiv(n, 128))
+    if k <= 128:
+        bk = ceildiv(k, 8) * 8
+    else:
+        bk = max(128, block_k // 128 * 128)
+    vmem_budget = 4 << 20
+    bm_cap = max(8, (vmem_budget // (bk * bn * 4)) // 8 * 8)
+    bm = min(block_m, m, bm_cap) if m < 8 else min(max(8, min(block_m, m) // 8 * 8), bm_cap)
+    # pad to tile multiples (zero padding is contribution-free, see module doc)
+    mp, np_, kp = ceildiv(m, bm) * bm, ceildiv(n, bn) * bn, ceildiv(k, bk) * bk
+    xp = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, kp - k)))
+    ytp = jnp.pad(y.astype(jnp.float32).T, ((0, kp - k), (0, np_ - n)))
+
+    grid = (mp // bm, np_ // bn, kp // bk)
+    kern = functools.partial(
+        _kernel, combine=combine, reduce_kind=reduce_kind, epilog=epilog,
+        n_k_tiles=grid[2], init=init)
+
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(xp, ytp)
+    return out[:m, :n].astype(out_dtype)

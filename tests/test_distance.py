@@ -1,0 +1,238 @@
+"""Pairwise distance tests vs naive O(mnk) numpy references.
+
+Mirrors the reference's strategy: every metric checked against a naive
+reference kernel over parameterized sizes/seeds
+(cpp/test/distance/distance_base.cuh:30-110, dist_*.cu).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import RaftError
+from raft_tpu.distance import DistanceType as D
+from raft_tpu.distance import fused_l2_nn, pairwise_distance, get_workspace_size
+
+# last case has k > 128 to exercise the tiled kernel's multi-k-tile
+# accumulation path (bk=128 chunks)
+SIZES = [(40, 32, 17), (65, 33, 8), (128, 128, 64), (33, 40, 300)]
+
+
+def naive(x, y, metric, p=2.0):
+    m, n = x.shape[0], y.shape[0]
+    out = np.zeros((m, n))
+    for i in range(m):
+        for j in range(n):
+            a, b = x[i], y[j]
+            if metric == D.L2Expanded or metric == D.L2Unexpanded:
+                out[i, j] = ((a - b) ** 2).sum()
+            elif metric == D.L2SqrtExpanded or metric == D.L2SqrtUnexpanded:
+                out[i, j] = np.sqrt(((a - b) ** 2).sum())
+            elif metric == D.CosineExpanded:
+                out[i, j] = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+            elif metric == D.CorrelationExpanded:
+                out[i, j] = 1 - np.corrcoef(a, b)[0, 1]
+            elif metric == D.InnerProduct:
+                out[i, j] = (a * b).sum()
+            elif metric == D.L1:
+                out[i, j] = np.abs(a - b).sum()
+            elif metric == D.Linf:
+                out[i, j] = np.abs(a - b).max()
+            elif metric == D.Canberra:
+                s = np.abs(a) + np.abs(b)
+                d = np.abs(a - b)
+                out[i, j] = np.where(s == 0, 0.0, d / np.where(s == 0, 1, s)).sum()
+            elif metric == D.LpUnexpanded:
+                out[i, j] = (np.abs(a - b) ** p).sum() ** (1 / p)
+            elif metric == D.HellingerExpanded:
+                acc = (np.sqrt(a) * np.sqrt(b)).sum()
+                out[i, j] = np.sqrt(max(0.0, 1 - acc))
+            elif metric == D.RusselRaoExpanded:
+                k = len(a)
+                out[i, j] = (k - (a * b).sum()) / k
+            elif metric == D.KLDivergence:
+                t = np.where(a > 0, a * (np.log(np.where(a > 0, a, 1))
+                                         - np.where(b > 0, np.log(np.where(b > 0, b, 1)), 0)), 0)
+                out[i, j] = 0.5 * t.sum()
+            elif metric == D.HammingUnexpanded:
+                out[i, j] = (a != b).mean()
+            elif metric == D.JensenShannon:
+                mm = 0.5 * (a + b)
+                def kl(u, v):
+                    return np.where(u > 0, u * (np.log(np.where(u > 0, u, 1))
+                                                - np.log(np.where(v > 0, v, 1))), 0).sum()
+                out[i, j] = np.sqrt(0.5 * (kl(a, mm) + kl(b, mm)))
+            elif metric == D.BrayCurtis:
+                den = (a + b).sum()
+                out[i, j] = np.abs(a - b).sum() / den if den != 0 else 0.0
+            else:
+                raise ValueError(metric)
+    return out
+
+
+GENERAL_METRICS = [
+    D.L2Expanded, D.L2SqrtExpanded, D.CosineExpanded, D.CorrelationExpanded,
+    D.InnerProduct, D.L1, D.L2Unexpanded, D.L2SqrtUnexpanded, D.Linf,
+    D.Canberra, D.LpUnexpanded, D.HammingUnexpanded, D.BrayCurtis,
+]
+# probability-simplex metrics (inputs must be distributions)
+PROB_METRICS = [D.HellingerExpanded, D.KLDivergence, D.JensenShannon, D.RusselRaoExpanded]
+
+
+@pytest.mark.parametrize("m,n,k", SIZES)
+@pytest.mark.parametrize("metric", GENERAL_METRICS)
+def test_pairwise_general(rng, m, n, k, metric):
+    x = rng.standard_normal((m, k)).astype(np.float32)
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    got = np.asarray(pairwise_distance(jnp.array(x), jnp.array(y), metric))
+    want = naive(x.astype(np.float64), y.astype(np.float64), metric)
+    atol = 2e-3 if metric in (D.L2Expanded, D.L2SqrtExpanded) else 1e-4
+    np.testing.assert_allclose(got, want, atol=atol, rtol=2e-3)
+
+
+@pytest.mark.parametrize("m,n,k", [(30, 25, 16), (64, 64, 32)])
+@pytest.mark.parametrize("metric", PROB_METRICS)
+def test_pairwise_probability(rng, m, n, k, metric):
+    x = rng.uniform(0.01, 1.0, (m, k))
+    y = rng.uniform(0.01, 1.0, (n, k))
+    x = (x / x.sum(1, keepdims=True)).astype(np.float32)
+    y = (y / y.sum(1, keepdims=True)).astype(np.float32)
+    got = np.asarray(pairwise_distance(jnp.array(x), jnp.array(y), metric))
+    want = naive(x.astype(np.float64), y.astype(np.float64), metric)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+def test_kl_with_zeros(rng):
+    # exercise the zero-guard branches (kl_divergence.cuh:95-99)
+    x = rng.uniform(0, 1, (10, 8))
+    y = rng.uniform(0, 1, (12, 8))
+    x[x < 0.3] = 0.0
+    y[y < 0.3] = 0.0
+    got = np.asarray(pairwise_distance(jnp.array(x, dtype=jnp.float32),
+                                       jnp.array(y, dtype=jnp.float32), D.KLDivergence))
+    want = naive(x, y, D.KLDivergence)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_hamming_int_inputs(rng):
+    x = rng.integers(0, 3, (20, 16)).astype(np.float32)
+    y = rng.integers(0, 3, (15, 16)).astype(np.float32)
+    got = np.asarray(pairwise_distance(jnp.array(x), jnp.array(y), D.HammingUnexpanded))
+    np.testing.assert_allclose(got, naive(x, y, D.HammingUnexpanded), atol=1e-6)
+
+
+def test_minkowski_p3(rng):
+    x = rng.standard_normal((12, 9)).astype(np.float32)
+    y = rng.standard_normal((11, 9)).astype(np.float32)
+    got = np.asarray(pairwise_distance(jnp.array(x), jnp.array(y), D.LpUnexpanded, metric_arg=3.0))
+    np.testing.assert_allclose(got, naive(x.astype(np.float64), y.astype(np.float64),
+                                          D.LpUnexpanded, p=3.0), rtol=1e-3, atol=1e-4)
+
+
+def test_fin_op(rng):
+    x = rng.standard_normal((5, 4)).astype(np.float32)
+    got = np.asarray(pairwise_distance(jnp.array(x), jnp.array(x), D.L2Expanded,
+                                       fin_op=lambda d: d + 1.0))
+    np.testing.assert_allclose(np.diag(got), 1.0, atol=1e-5)
+
+
+def test_unsupported_metric(rng):
+    x = jnp.zeros((4, 4))
+    with pytest.raises(RaftError, match="Unknown or unsupported"):
+        pairwise_distance(x, x, D.Haversine)
+    with pytest.raises(RaftError):
+        pairwise_distance(x, x, D.Precomputed)
+    with pytest.raises(RaftError):
+        pairwise_distance(x, jnp.zeros((4, 5)), D.L1)
+
+
+def test_workspace_size():
+    x, y = jnp.zeros((10, 4), jnp.float32), jnp.zeros((20, 4), jnp.float32)
+    assert get_workspace_size(x, y, D.L2Expanded) == 30 * 4
+    assert get_workspace_size(x, y, D.CorrelationExpanded) == 60 * 4
+    assert get_workspace_size(x, y, D.L1) == 0
+
+
+class TestFusedL2NN:
+    @pytest.mark.parametrize("m,n,k", [(50, 37, 8), (200, 513, 16)])
+    @pytest.mark.parametrize("sqrt", [False, True])
+    def test_matches_naive(self, rng, m, n, k, sqrt):
+        x = rng.standard_normal((m, k)).astype(np.float32)
+        y = rng.standard_normal((n, k)).astype(np.float32)
+        vals, idx = fused_l2_nn(jnp.array(x), jnp.array(y), sqrt=sqrt, tile_n=64)
+        d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        ref_idx = d.argmin(axis=1)
+        ref_val = d.min(axis=1)
+        if sqrt:
+            ref_val = np.sqrt(ref_val)
+        np.testing.assert_array_equal(np.asarray(idx), ref_idx)
+        np.testing.assert_allclose(np.asarray(vals), ref_val, atol=1e-3)
+
+    def test_mask_excludes(self, rng):
+        x = rng.standard_normal((10, 4)).astype(np.float32)
+        # nearest neighbor of each point in itself-set is itself; mask the
+        # diagonal to get second-nearest
+        mask = ~np.eye(10, dtype=bool)
+        vals, idx = fused_l2_nn(jnp.array(x), jnp.array(x), mask=jnp.array(mask), tile_n=4)
+        assert np.all(np.asarray(idx) != np.arange(10))
+        d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        np.fill_diagonal(d, np.inf)
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(axis=1))
+
+    def test_tie_breaks_to_smaller_index(self):
+        x = jnp.zeros((3, 2))
+        y = jnp.zeros((5, 2))  # all distances equal (0)
+        _, idx = fused_l2_nn(x, y, tile_n=2)
+        np.testing.assert_array_equal(np.asarray(idx), 0)
+
+
+class TestReviewRegressions:
+    def test_integer_inputs_not_truncated(self, rng):
+        # Hamming on int-coded categories must return fractional means
+        x = jnp.array(rng.integers(0, 3, (6, 8)), dtype=jnp.int32)
+        out = np.asarray(pairwise_distance(x, x, D.HammingUnexpanded))
+        assert out.dtype == np.float32
+        assert np.any((out > 0) & (out < 1))
+        np.testing.assert_allclose(np.diag(out), 0.0)
+
+    def test_fully_masked_row_keeps_sentinel(self, rng):
+        from raft_tpu.distance.fused_l2_nn import IDX_SENTINEL
+
+        x = jnp.array(rng.standard_normal((4, 3)), dtype=jnp.float32)
+        mask = np.ones((4, 4), dtype=bool)
+        mask[2, :] = False  # row 2 has no admissible pair
+        vals, idx = fused_l2_nn(x, x, mask=jnp.array(mask), tile_n=2)
+        assert np.isinf(np.asarray(vals)[2])
+        assert np.asarray(idx)[2] == IDX_SENTINEL
+        assert np.all(np.asarray(idx)[[0, 1, 3]] != IDX_SENTINEL)
+
+    def test_mask_with_custom_reduce_op(self, rng):
+        from raft_tpu.distance import fused_l2_nn_min_reduce
+
+        x = jnp.array(rng.standard_normal((6, 3)), dtype=jnp.float32)
+
+        def max_reduce(best, cand):  # deliberately invert: keep the farthest
+            bv, bi = best
+            cv, ci = cand
+            take = jnp.isfinite(cv) & ((cv > bv) | ~jnp.isfinite(bv))
+            return jnp.where(take, cv, bv), jnp.where(take, ci, bi)
+
+        mask = jnp.array(~np.eye(6, dtype=bool))
+        init = (jnp.full((6,), -np.inf, jnp.float32), jnp.zeros((6,), jnp.int32))
+        vals, idx = fused_l2_nn_min_reduce(x, x, reduce_op=max_reduce,
+                                           init_val=init, mask=mask, tile_n=2)
+        d = ((np.asarray(x)[:, None, :] - np.asarray(x)[None, :, :]) ** 2).sum(-1)
+        np.fill_diagonal(d, -np.inf)
+        # per-tile argmin feeding a max-reduce doesn't give the global max,
+        # but every reported pair must be admissible and finite
+        assert np.all(np.asarray(idx) != np.arange(6))
+        assert np.all(np.isfinite(np.asarray(vals)))
+
+    def test_block_k_honored(self, rng):
+        from raft_tpu.ops import pairwise_tile
+
+        x = rng.standard_normal((10, 300)).astype(np.float32)
+        out = pairwise_tile(jnp.array(x), jnp.array(x),
+                            lambda a, b: jnp.abs(a - b), block_k=256)
+        ref = np.abs(x[:, None, :] - x[None, :, :]).sum(-1)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
