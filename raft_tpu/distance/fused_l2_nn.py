@@ -69,6 +69,11 @@ def fused_l2_nn_min_reduce(
     tile_n = min(tile_n, n)
     rop = reduce_op or _default_reduce
 
+    # integer inputs promote to float: the inf padding sentinel and the
+    # distance arithmetic below are floating-point
+    val_dtype = jnp.result_type(x.dtype, jnp.float32)
+    x = x.astype(val_dtype)
+    y = y.astype(val_dtype)
     xn = jnp.sum(x * x, axis=1)
     yn = jnp.sum(y * y, axis=1)
     n_tiles = -(-n // tile_n)
@@ -96,8 +101,12 @@ def fused_l2_nn_min_reduce(
         return rop(carry, cand), None
 
     if init_val is None:
+        # distances come out floating (matmul promotes integer inputs), so
+        # the carry must too — an int dtype would mangle the inf sentinel
+        # and trip lax.scan's carry-type check
+        val_dtype = jnp.result_type(x.dtype, jnp.float32)
         init_val = (
-            jnp.full((m,), jnp.inf, x.dtype),
+            jnp.full((m,), jnp.inf, val_dtype),
             jnp.full((m,), IDX_SENTINEL, jnp.int32),
         )
     out, _ = jax.lax.scan(step, init_val, jnp.arange(n_tiles))

@@ -152,10 +152,6 @@ def _c_jensen_shannon(xv, yv):
     return term(xv) + term(yv)
 
 
-def _c_braycurtis_num(xv, yv):
-    return jnp.abs(xv - yv)
-
-
 def _tiled(x, y, combine, reduce_kind="add", epilog=None, init=0.0, **kw):
     return pairwise_tile(x, y, combine, reduce_kind=reduce_kind,
                          epilog=epilog, init=init, **kw)
@@ -220,7 +216,7 @@ def pairwise_distance(
         out = _tiled(x, y, _c_jensen_shannon,
                      epilog=lambda a: jnp.sqrt(jnp.maximum(0.5 * a, 0.0)), **tile_kw)
     elif metric == D.BrayCurtis:
-        num = _tiled(x, y, _c_braycurtis_num, **tile_kw)
+        num = _tiled(x, y, _c_l1, **tile_kw)
         sx, sy = jnp.sum(x, axis=1), jnp.sum(y, axis=1)
         den = sx[:, None] + sy[None, :]
         out = jnp.where(den == 0, 0.0, num / jnp.where(den == 0, 1.0, den))

@@ -78,6 +78,7 @@ def pairwise_tile(
     m, k = x.shape
     n, k2 = y.shape
     assert k == k2, (k, k2)
+    assert reduce_kind in ("add", "max"), reduce_kind
     if out_dtype is None:
         # distances are fractional even for integer inputs (Hamming means,
         # Canberra ratios): never truncate back to an integer dtype

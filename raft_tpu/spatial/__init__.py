@@ -1,0 +1,13 @@
+"""Spatial / k-nearest-neighbor primitives.
+
+TPU-native re-design of the reference ``raft/spatial/knn`` module
+(cpp/include/raft/spatial/knn/): brute-force kNN with partitioned inputs
+and heap-merge, fused L2 kNN, k-selection, haversine kNN, metric
+processors, random-ball-cover ANN and IVF quantized ANN.
+"""
+
+from raft_tpu.spatial.select_k import select_k  # noqa: F401
+from raft_tpu.spatial.fused_l2_knn import fused_l2_knn  # noqa: F401
+from raft_tpu.spatial.haversine import haversine_distances, haversine_knn  # noqa: F401
+from raft_tpu.spatial.knn import brute_force_knn, knn_merge_parts  # noqa: F401
+from raft_tpu.spatial.processing import create_processor  # noqa: F401

@@ -1,0 +1,64 @@
+"""Metric pre/post-processors for kNN.
+
+Reference: cpp/include/raft/spatial/knn/detail/processing.hpp:38-187.
+Expanded metrics are reduced to inner products by transforming the data:
+cosine L2-normalizes rows (CosineMetricProcessor::preprocess) and
+correlation mean-centers first (CorrelationMetricProcessor::preprocess);
+after the inner-product search, ``postprocess`` maps similarities to
+distances via ``1 - sim`` (processing.hpp:109).
+
+The reference mutates device buffers in place and ``revert``s afterwards;
+the TPU design is functional — ``preprocess`` returns a transformed copy
+and ``revert`` is the identity on the caller's original array (kept for
+API parity, documented as a no-op).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.distance.distance_type import DistanceType
+
+
+class MetricProcessor:
+    """Identity processor (reference DefaultMetricProcessor,
+    processing.hpp:166)."""
+
+    def preprocess(self, data: jnp.ndarray) -> jnp.ndarray:
+        return data
+
+    def revert(self, data: jnp.ndarray) -> jnp.ndarray:
+        return data
+
+    def postprocess(self, distances: jnp.ndarray) -> jnp.ndarray:
+        return distances
+
+
+class CosineMetricProcessor(MetricProcessor):
+    """Row-normalize so inner product = cosine similarity; distances are
+    ``1 - sim`` (processing.hpp:50-113)."""
+
+    def preprocess(self, data: jnp.ndarray) -> jnp.ndarray:
+        norms = jnp.sqrt(jnp.sum(data * data, axis=1, keepdims=True))
+        return data / jnp.where(norms == 0, 1.0, norms)
+
+    def postprocess(self, distances: jnp.ndarray) -> jnp.ndarray:
+        return 1.0 - distances
+
+
+class CorrelationMetricProcessor(CosineMetricProcessor):
+    """Mean-center then normalize so inner product = Pearson r
+    (processing.hpp:117-163)."""
+
+    def preprocess(self, data: jnp.ndarray) -> jnp.ndarray:
+        centered = data - jnp.mean(data, axis=1, keepdims=True)
+        return super().preprocess(centered)
+
+
+def create_processor(metric: DistanceType) -> MetricProcessor:
+    """Factory matching reference create_processor (processing.hpp:173)."""
+    if metric == DistanceType.CosineExpanded:
+        return CosineMetricProcessor()
+    if metric == DistanceType.CorrelationExpanded:
+        return CorrelationMetricProcessor()
+    return MetricProcessor()

@@ -1,0 +1,70 @@
+"""k-selection: per-row top-k of a key matrix.
+
+Reference: ``select_k`` (cpp/include/raft/spatial/knn/knn.hpp:90)
+dispatching into the forked-FAISS warp/block select kernels
+(detail/selection_faiss.cuh:131-160, detail/warp_select_faiss.cuh,
+detail/block_select_faiss.cuh) — a register-heap per warp merged through
+shared memory, specialised for k ≤ {32,64,128,256,512,1024}.
+
+TPU re-design: there are no warp shuffles or per-thread heaps on a
+systolic/vector machine; the efficient shapes are (a) XLA's native sorted
+``TopK`` (bitonic-style, k-specialised) and (b) on real TPU hardware the
+``approx_max_k`` MIPS instruction path with recall=1.0.  Both keep the
+whole row in VMEM-resident vectors; for very wide rows XLA tiles
+internally.  We dispatch to ``lax.top_k`` (exact, sorted, stable toward
+smaller index on ties — the same tie rule as the reference's heap with
+sequential insertion) and translate min-selection by key negation.
+
+``select_k`` is THE building block for kNN merge and ANN list scans, so it
+accepts an optional payload (``values``) to carry indices through
+selection, mirroring the (key, value) pairs of the reference heaps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.error import expects
+
+
+def select_k(
+    keys: jnp.ndarray,
+    k: int,
+    select_min: bool = True,
+    values: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Select the k smallest (or largest) keys per row.
+
+    Parameters
+    ----------
+    keys:
+        (m, n) key matrix (e.g. distances).
+    k:
+        Number of entries to keep per row (k <= n).
+    select_min:
+        True → k smallest (distance semantics); False → k largest
+        (inner-product semantics).  Reference knn.hpp:90 ``select_min``.
+    values:
+        Optional (m, n) payload carried through selection (e.g. global
+        ids).  Defaults to the column index, matching the reference's
+        identity-value path.
+
+    Returns
+    -------
+    (out_keys, out_values): (m, k) selected keys, sorted best-first, and
+    their payloads (int32 column indices when ``values`` is None).
+    """
+    expects(keys.ndim == 2, "select_k: 2-D keys required")
+    n = keys.shape[1]
+    expects(0 < k <= n, "select_k: k=%d out of range for n=%d", k, n)
+
+    sel = -keys if select_min else keys
+    top_vals, top_idx = lax.top_k(sel, k)
+    out_keys = -top_vals if select_min else top_vals
+    if values is None:
+        return out_keys, top_idx.astype(jnp.int32)
+    out_values = jnp.take_along_axis(values, top_idx, axis=1)
+    return out_keys, out_values
