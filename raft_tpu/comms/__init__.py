@@ -1,0 +1,30 @@
+"""Communicator abstraction over ICI/DCN (XLA collectives).
+
+Reference: cpp/include/raft/comms/ — ``comms_t``/``comms_iface``
+(comms.hpp:91,193) with NCCL+UCX (std_comms.hpp) and MPI (mpi_comms.hpp)
+implementations, injected into the handle (handle.hpp:229).
+
+TPU-native design (SURVEY.md §2.2): one implementation over XLA
+collectives — :class:`MeshComms` for use *inside* shard_map traces (the
+collectives compile onto ICI) and :class:`HostComms` for eager host-level
+orchestration, tagged p2p, comm_split and status-returning sync.
+``build_comms`` injects a communicator into a :class:`raft_tpu.Handle`
+(reference helper.hpp:39 build_comms_nccl_only).
+"""
+
+from raft_tpu.comms.types import Datatype, Op, Status, get_type  # noqa: F401
+from raft_tpu.comms.mesh_comms import MeshComms  # noqa: F401
+from raft_tpu.comms.host_comms import HostComms, default_mesh  # noqa: F401
+from raft_tpu.comms import selftest  # noqa: F401
+
+
+def build_comms(handle, mesh=None, n_devices=None):
+    """Create a :class:`HostComms` over ``mesh`` (or the first
+    ``n_devices`` local devices) and inject it into ``handle``
+    (reference build_comms_nccl_only, comms/helper.hpp:39)."""
+    if mesh is None:
+        mesh = default_mesh(n_devices)
+    comms = HostComms(mesh)
+    handle.set_comms(comms)
+    handle.mesh = mesh
+    return comms

@@ -1,0 +1,324 @@
+"""Host-level communicator: eager collectives, tagged p2p, comm_split.
+
+Reference: ``std_comms`` (cpp/include/raft/comms/std_comms.hpp) plus the
+injection helpers (comms/helper.hpp:39-95).  The reference is
+multi-controller: one process per GPU, each holding a per-rank ``comms_t``
+bootstrapped by an out-of-band NCCL-uid exchange.  JAX on TPU is
+**single-controller SPMD**: one host process drives every device, so the
+host-level communicator represents the *whole* communicator and verbs
+operate on rank-major data (a leading axis of extent ``size``), sharded
+or to-be-sharded over the mesh.  Under multi-host JAX
+(``jax.distributed.initialize``) the same object spans hosts — the
+coordination service plays the NCCL-uid bootstrap role (SURVEY.md §2.2).
+
+Each verb compiles (and caches) a tiny ``shard_map`` program that calls
+the in-trace :class:`~raft_tpu.comms.mesh_comms.MeshComms` verb — so the
+eager API and the in-trace API cannot diverge.
+
+Tagged p2p (UCX's role, std_comms.hpp:204-298): ``isend``/``irecv``
+record host-side descriptors with *dynamic* ranks and tags; ``waitall``
+matches them, groups matched pairs by tag, and executes one ``ppermute``
+per tag over ICI.  Unmatched requests raise — the reference's analog is a
+UCX progress-loop timeout abort (std_comms.hpp:234-298).
+
+``sync_stream`` reproduces the reference's status-returning health check
+(std_comms.hpp:443-475: poll stream + ncclCommGetAsyncError, abort on
+failure): it blocks on the given arrays and maps runtime errors to
+``Status.ERROR`` and an aborted communicator to ``Status.ABORT``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 (replication checking arg renamed check_rep -> check_vma)
+    import inspect
+
+    from jax import shard_map as _shard_map
+
+    _CHECK_ARG = ("check_vma" if "check_vma"
+                  in inspect.signature(_shard_map).parameters else "check_rep")
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_ARG = "check_rep"
+
+
+def shard_map(fn, **kw):
+    kw[_CHECK_ARG] = kw.pop("check_rep")
+    return _shard_map(fn, **kw)
+
+from raft_tpu.core.error import expects
+from raft_tpu.comms.mesh_comms import MeshComms
+from raft_tpu.comms.types import Op, Status
+
+_AXIS = "ranks"
+
+
+def default_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (bootstrap
+    analog of reference helper.hpp:39 build_comms_nccl_only)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        expects(n_devices <= len(devs),
+                "requested %d devices, only %d available", n_devices, len(devs))
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (_AXIS,))
+
+
+class _Request:
+    """Pending p2p operation (reference request_t, comms.hpp:46)."""
+
+    __slots__ = ("kind", "rank", "peer", "tag", "data", "result")
+
+    def __init__(self, kind: str, rank: int, peer: int, tag: int, data=None):
+        self.kind = kind      # "send" | "recv"
+        self.rank = rank      # owning rank
+        self.peer = peer      # destination (send) / source (recv)
+        self.tag = tag
+        self.data = data      # send payload (a row of host/device data)
+        self.result = None    # filled for recv by waitall
+
+
+class HostComms:
+    """Whole-communicator handle over a 1-D device mesh axis.
+
+    Data convention: collective inputs/outputs are **rank-major** arrays —
+    shape ``(size, ...)`` where row r is rank r's buffer.  Results follow
+    the replicated-superset convention of
+    :class:`~raft_tpu.comms.mesh_comms.MeshComms` (root-only results are
+    valid on every rank).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = _AXIS):
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.axis = axis
+        expects(axis in self.mesh.axis_names, "axis %s not in mesh", axis)
+        self._mc = MeshComms(axis, self.mesh.shape[axis])
+        self._requests: List[_Request] = []
+        self._aborted = False
+        self._progs: Dict[tuple, object] = {}
+
+    # ------------------------------------------------------------------ #
+    # topology
+    # ------------------------------------------------------------------ #
+    def get_size(self) -> int:
+        return self._mc.get_size()
+
+    @property
+    def mesh_comms(self) -> MeshComms:
+        """The in-trace communicator for use inside user shard_map code."""
+        return self._mc
+
+    # ------------------------------------------------------------------ #
+    # eager collective execution
+    # ------------------------------------------------------------------ #
+    def _run(self, key: tuple, fn, *args):
+        """shard_map-execute ``fn(mesh_comms-visible blocks)`` with
+        rank-major in/out over the mesh axis.  Programs are cached by
+        ``key`` (verb + static parameters) so repeated eager calls reuse
+        the compiled executable — jax.jit's own cache keys on function
+        identity, which a fresh lambda per call would always miss."""
+        prog = self._progs.get(key)
+        if prog is None:
+            spec = P(self.axis)
+            prog = jax.jit(shard_map(
+                fn, mesh=self.mesh, in_specs=spec, out_specs=spec,
+                check_rep=False))
+            self._progs[key] = prog
+        return prog(*args)
+
+    def _check(self, x) -> jnp.ndarray:
+        x = jnp.asarray(x)
+        expects(x.ndim >= 1 and x.shape[0] == self.get_size(),
+                "rank-major input required: leading axis must be size=%d",
+                self.get_size())
+        return x
+
+    def allreduce(self, x, op: Op = Op.SUM):
+        x = self._check(x)
+        return self._run(("allreduce", op),
+                         lambda b: self._mc.allreduce(b, op), x)
+
+    def bcast(self, x, root: int = 0):
+        x = self._check(x)
+        return self._run(("bcast", root), lambda b: self._mc.bcast(b, root), x)
+
+    def reduce(self, x, root: int = 0, op: Op = Op.SUM):
+        x = self._check(x)
+        return self._run(("reduce", op),
+                         lambda b: self._mc.reduce(b, root, op), x)
+
+    def allgather(self, x):
+        """Rank-major (size, n, ...) → (size, size*n, ...): every row
+        holds the concatenation of all rows."""
+        x = self._check(x)
+        return self._run(("allgather",),
+                         lambda b: self._mc.allgather(b[0])[None], x)
+
+    def allgatherv(self, x, recvcounts: Sequence[int]):
+        x = self._check(x)
+        return self._run(("allgatherv", tuple(recvcounts)),
+                         lambda b: self._mc.allgatherv(b[0], recvcounts)[None],
+                         x)
+
+    def gather(self, x, root: int = 0):
+        return self.allgather(x)
+
+    def gatherv(self, x, recvcounts: Sequence[int], root: int = 0):
+        return self.allgatherv(x, recvcounts)
+
+    def reducescatter(self, x, op: Op = Op.SUM):
+        """Rank-major (size, size*n, ...) → (size, n, ...)."""
+        x = self._check(x)
+        return self._run(("reducescatter", op),
+                         lambda b: self._mc.reducescatter(b[0], op)[None], x)
+
+    def barrier(self) -> None:
+        jax.block_until_ready(self._run(
+            ("barrier",), lambda b: b + self._mc.barrier(),
+            jnp.zeros((self.get_size(),), jnp.int32)))
+
+    # ------------------------------------------------------------------ #
+    # tagged p2p (reference comms.hpp:254-292 isend/irecv/waitall)
+    # ------------------------------------------------------------------ #
+    def isend(self, buf, rank: int, dest: int, tag: int = 0) -> _Request:
+        """Queue a tagged send of ``buf`` from ``rank`` to ``dest``."""
+        req = _Request("send", rank, dest, tag, jnp.asarray(buf))
+        self._requests.append(req)
+        return req
+
+    def irecv(self, rank: int, source: int, tag: int = 0) -> _Request:
+        """Queue a tagged receive on ``rank`` from ``source``."""
+        req = _Request("recv", rank, source, tag)
+        self._requests.append(req)
+        return req
+
+    def waitall(self, requests: Optional[Sequence[_Request]] = None) -> None:
+        """Match queued sends/recvs and execute them.  Matched pairs are
+        partitioned into disjoint permutation layers (unique source AND
+        destination per layer — a ppermute must be a bijection), one
+        ppermute each.  Unmatched requests raise, standing in for the
+        reference's UCX progress-timeout abort (std_comms.hpp:234-298)."""
+        reqs = list(requests) if requests is not None else list(self._requests)
+        sends = [r for r in reqs if r.kind == "send"]
+        recvs = [r for r in reqs if r.kind == "recv"]
+        pairs: List[Tuple[_Request, _Request]] = []
+        taken: set = set()
+        for s in sends:
+            match = next(
+                (r for r in recvs
+                 if r.tag == s.tag and r.peer == s.rank and s.peer == r.rank
+                 and r.result is None and id(r) not in taken),
+                None)
+            expects(match is not None,
+                    "waitall: unmatched send rank=%d->%d tag=%d",
+                    s.rank, s.peer, s.tag)
+            taken.add(id(match))
+            pairs.append((s, match))
+        leftover = [r for r in recvs
+                    if id(r) not in taken and r.result is None]
+        expects(not leftover, "waitall: %d unmatched irecv(s)", len(leftover))
+
+        # greedy layering: each layer is a bijection (src and dst unique)
+        layers: List[List[Tuple[_Request, _Request]]] = []
+        for s, r in pairs:
+            placed = False
+            for layer in layers:
+                if all(s.rank != ls.rank and s.peer != ls.peer
+                       and s.data.shape == ls.data.shape
+                       and s.data.dtype == ls.data.dtype
+                       for ls, _ in layer):
+                    layer.append((s, r))
+                    placed = True
+                    break
+            if not placed:
+                layers.append([(s, r)])
+
+        size = self.get_size()
+        for layer in layers:
+            perm = [(s.rank, s.peer) for s, _ in layer]
+            shape = layer[0][0].data.shape
+            dtype = layer[0][0].data.dtype
+            buf = np.zeros((size,) + shape, dtype)
+            for s, _ in layer:
+                buf[s.rank] = np.asarray(s.data)
+            out = self._run(("p2p", tuple(perm)),
+                            lambda b: self._mc.device_sendrecv(b, perm),
+                            jnp.asarray(buf))
+            for s, r in layer:
+                r.result = out[r.rank]
+        done = {id(r) for r in reqs}
+        self._requests = [r for r in self._requests if id(r) not in done]
+
+    # device_send/recv parity shims: in the reference these are the
+    # stream-ordered NCCL p2p verbs (comms.hpp:508,522); here they share
+    # the tagged machinery with a reserved tag.
+    _DEVICE_TAG = -1
+
+    def device_send(self, buf, rank: int, dest: int) -> _Request:
+        return self.isend(buf, rank, dest, tag=self._DEVICE_TAG)
+
+    def device_recv(self, rank: int, source: int) -> _Request:
+        return self.irecv(rank, source, tag=self._DEVICE_TAG)
+
+    def device_sendrecv(self, x, perm: Sequence[Tuple[int, int]]):
+        """Eager static-permutation exchange (reference comms.hpp:522)."""
+        x = self._check(x)
+        return self._run(("sendrecv", tuple(perm)),
+                         lambda b: self._mc.device_sendrecv(b, list(perm)), x)
+
+    def device_multicast_sendrecv(self, x, sends: Sequence[Tuple[int, int]]):
+        x = self._check(x)
+        return self._run(
+            ("multicast", tuple(sends)),
+            lambda b: self._mc.device_multicast_sendrecv(b, list(sends)), x)
+
+    # ------------------------------------------------------------------ #
+    # comm_split (reference comms.hpp:96 / std_comms.hpp:115-177)
+    # ------------------------------------------------------------------ #
+    def comm_split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+                   ) -> Dict[int, "HostComms"]:
+        """Partition the communicator by color; within a color, ranks are
+        ordered by key (reference comm_split semantics — there each rank
+        passes its own (color, key); single-controller passes the full
+        vectors).  Returns {color: sub-communicator}."""
+        size = self.get_size()
+        expects(len(colors) == size, "comm_split: need one color per rank")
+        keys = list(keys) if keys is not None else list(range(size))
+        expects(len(keys) == size, "comm_split: need one key per rank")
+        devs = list(self.mesh.devices.ravel())
+        out: Dict[int, HostComms] = {}
+        for color in sorted(set(colors)):
+            members = sorted(
+                (r for r in range(size) if colors[r] == color),
+                key=lambda r: (keys[r], r))
+            sub_mesh = Mesh(np.asarray([devs[r] for r in members]), (self.axis,))
+            out[color] = HostComms(sub_mesh, self.axis)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # failure surfacing (reference sync_stream, std_comms.hpp:443-475)
+    # ------------------------------------------------------------------ #
+    def abort(self) -> None:
+        """Mark the communicator unusable (reference ncclCommAbort,
+        exposed to Python via nccl.pyx:173)."""
+        self._aborted = True
+
+    def sync_stream(self, *arrays) -> Status:
+        """Block until the given in-flight arrays complete; map failures
+        to a status instead of raising."""
+        if self._aborted:
+            return Status.ABORT
+        try:
+            jax.block_until_ready(arrays)
+            return Status.SUCCESS
+        except Exception:
+            self._aborted = True
+            return Status.ERROR

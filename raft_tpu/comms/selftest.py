@@ -1,0 +1,173 @@
+"""Communicator self-tests, runnable against a live mesh.
+
+Reference: cpp/include/raft/comms/test.hpp:40-542 — one in-header test
+function per collective plus p2p and comm_split, exported to Python
+(comms_utils.pyx:57+) and driven by pytest on a real cluster
+(python/raft/test/test_comms.py).  Each returns True on success so a
+session layer can health-check a communicator the same way.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.comms.host_comms import HostComms
+from raft_tpu.comms.types import Op, Status
+
+
+def test_collective_allreduce(comms: HostComms) -> bool:
+    """Each rank contributes 1; every rank must see size (reference
+    test.hpp:40)."""
+    size = comms.get_size()
+    out = comms.allreduce(jnp.ones((size, 1), jnp.int32))
+    return bool((np.asarray(out) == size).all())
+
+
+def test_collective_broadcast(comms: HostComms) -> bool:
+    """Root holds 1, others 0; everyone must end with 1 (test.hpp:76)."""
+    size = comms.get_size()
+    x = jnp.zeros((size, 1), jnp.float32).at[0, 0].set(1.0)
+    out = comms.bcast(x, root=0)
+    return bool((np.asarray(out) == 1.0).all())
+
+
+def test_collective_reduce(comms: HostComms) -> bool:
+    """Sum-to-root of per-rank ranks (test.hpp:114)."""
+    size = comms.get_size()
+    x = jnp.arange(size, dtype=jnp.float32)[:, None]
+    out = comms.reduce(x, root=0, op=Op.SUM)
+    return bool((np.asarray(out)[0] == size * (size - 1) / 2).all())
+
+
+def test_collective_allgather(comms: HostComms) -> bool:
+    """Rank r contributes r; every rank must see [0..size) (test.hpp:151)."""
+    size = comms.get_size()
+    x = jnp.arange(size, dtype=jnp.float32)[:, None]
+    out = np.asarray(comms.allgather(x))
+    return all((out[r].ravel() == np.arange(size)).all() for r in range(size))
+
+
+def test_collective_gather(comms: HostComms) -> bool:
+    """(test.hpp:190)"""
+    return test_collective_allgather(comms)
+
+
+def test_collective_gatherv(comms: HostComms) -> bool:
+    """Variable block sizes: rank r contributes r+1 copies of r
+    (test.hpp:229)."""
+    size = comms.get_size()
+    counts = [r + 1 for r in range(size)]
+    maxc = max(counts)
+    buf = np.zeros((size, maxc, 1), np.float32)
+    for r in range(size):
+        buf[r, : counts[r]] = r
+    out = np.asarray(comms.gatherv(jnp.asarray(buf), counts))
+    expected = np.concatenate(
+        [np.full((c, 1), r, np.float32) for r, c in enumerate(counts)])
+    return all((out[r] == expected).all() for r in range(size))
+
+
+def test_collective_allgatherv(comms: HostComms) -> bool:
+    """(test.hpp:289)"""
+    return test_collective_gatherv(comms)
+
+
+def test_collective_reducescatter(comms: HostComms) -> bool:
+    """Every rank sends ones(size); each gets back its scalar block == size
+    (test.hpp:349)."""
+    size = comms.get_size()
+    x = jnp.ones((size, size), jnp.float32)
+    out = np.asarray(comms.reducescatter(x, op=Op.SUM))
+    return bool((out == size).all())
+
+
+def test_pointToPoint_simple_send_recv(comms: HostComms) -> bool:
+    """Ring exchange: rank r sends its payload to (r+1) % size
+    (reference test.hpp:385 pointToPoint tag matching)."""
+    size = comms.get_size()
+    recvs = []
+    for r in range(size):
+        comms.isend(jnp.full((3,), float(r)), rank=r, dest=(r + 1) % size, tag=7)
+        recvs.append(comms.irecv(rank=r, source=(r - 1) % size, tag=7))
+    comms.waitall()
+    return all(
+        (np.asarray(recvs[r].result) == float((r - 1) % size)).all()
+        for r in range(size))
+
+
+def test_pointToPoint_device_send_or_recv(comms: HostComms) -> bool:
+    """Pairwise exchange via the device verbs (reference test.hpp:432):
+    even ranks send to rank+1, odd ranks receive."""
+    size = comms.get_size()
+    if size < 2:
+        return True
+    recvs = {}
+    for r in range(0, size - 1, 2):
+        comms.device_send(jnp.full((2,), float(r)), rank=r, dest=r + 1)
+        recvs[r + 1] = comms.device_recv(rank=r + 1, source=r)
+    comms.waitall()
+    return all(
+        (np.asarray(req.result) == float(r - 1)).all()
+        for r, req in recvs.items())
+
+
+def test_pointToPoint_device_sendrecv(comms: HostComms) -> bool:
+    """Static-ring ppermute exchange (reference test.hpp:470)."""
+    size = comms.get_size()
+    perm = [(r, (r + 1) % size) for r in range(size)]
+    x = jnp.arange(size, dtype=jnp.float32)[:, None]
+    out = np.asarray(comms.device_sendrecv(x, perm))
+    return all(out[(r + 1) % size, 0] == r for r in range(size))
+
+
+def test_pointToPoint_device_multicast_sendrecv(comms: HostComms) -> bool:
+    """Rank 0 multicasts to everyone (reference test.hpp:496)."""
+    size = comms.get_size()
+    sends = [(0, d) for d in range(size)]
+    x = jnp.zeros((size, 1), jnp.float32).at[0, 0].set(42.0)
+    out = np.asarray(comms.device_multicast_sendrecv(x, sends))
+    return bool((out == 42.0).all())
+
+
+def test_commsplit(comms: HostComms, n_colors: int = 2) -> bool:
+    """Split into n_colors round-robin groups and run allreduce in each
+    (reference test.hpp:522)."""
+    size = comms.get_size()
+    n_colors = min(n_colors, size)
+    colors = [r % n_colors for r in range(size)]
+    subs = comms.comm_split(colors)
+    for color, sub in subs.items():
+        if not test_collective_allreduce(sub):
+            return False
+        if sub.get_size() != sum(1 for c in colors if c == color):
+            return False
+    return True
+
+
+def test_sync_stream_status(comms: HostComms) -> bool:
+    """sync_stream returns SUCCESS on good work and ABORT after abort()
+    (reference std_comms.hpp:443-475 semantics)."""
+    size = comms.get_size()
+    out = comms.allreduce(jnp.ones((size, 1)))
+    if comms.sync_stream(out) != Status.SUCCESS:
+        return False
+    comms.abort()
+    return comms.sync_stream(out) == Status.ABORT
+
+
+ALL_TESTS = [
+    test_collective_allreduce,
+    test_collective_broadcast,
+    test_collective_reduce,
+    test_collective_allgather,
+    test_collective_gather,
+    test_collective_gatherv,
+    test_collective_allgatherv,
+    test_collective_reducescatter,
+    test_pointToPoint_simple_send_recv,
+    test_pointToPoint_device_send_or_recv,
+    test_pointToPoint_device_sendrecv,
+    test_pointToPoint_device_multicast_sendrecv,
+    test_commsplit,
+]

@@ -1,0 +1,67 @@
+"""Communicator datatypes, reduction ops and status codes.
+
+Reference: cpp/include/raft/comms/comms.hpp:28-89 — ``datatype_t`` (:28),
+``op_t`` (:34, SUM/PROD/MIN/MAX), ``status_t`` (:41, SUCCESS/ERROR/ABORT)
+and the ``get_type<T>()`` mapping.  On TPU the datatype travels with the
+JAX array, so ``Datatype`` exists for API parity and for consumers that
+serialize communicator descriptions.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class Op(enum.IntEnum):
+    """Reduction operator (reference op_t, comms.hpp:34)."""
+
+    SUM = 0
+    PROD = 1
+    MIN = 2
+    MAX = 3
+
+
+class Status(enum.IntEnum):
+    """Result of :meth:`sync_stream` (reference status_t, comms.hpp:41).
+
+    SUCCESS: all work completed.  ERROR: an error occurred in this
+    participant's queued work.  ABORT: an error was observed on another
+    participant / the communicator is no longer usable.
+    """
+
+    SUCCESS = 0
+    ERROR = 1
+    ABORT = 2
+
+
+class Datatype(enum.IntEnum):
+    """Wire datatype ids (reference datatype_t, comms.hpp:28)."""
+
+    CHAR = 0
+    UINT8 = 1
+    INT32 = 2
+    UINT32 = 3
+    INT64 = 4
+    UINT64 = 5
+    FLOAT32 = 6
+    FLOAT64 = 7
+
+
+_DTYPE_MAP = {
+    jnp.int8.dtype: Datatype.CHAR,
+    jnp.uint8.dtype: Datatype.UINT8,
+    jnp.int32.dtype: Datatype.INT32,
+    jnp.uint32.dtype: Datatype.UINT32,
+    jnp.int64.dtype: Datatype.INT64,
+    jnp.uint64.dtype: Datatype.UINT64,
+    jnp.float32.dtype: Datatype.FLOAT32,
+    jnp.float64.dtype: Datatype.FLOAT64,
+}
+
+
+def get_type(dtype) -> Datatype:
+    """Map a JAX/numpy dtype to its wire id (reference get_type<T>(),
+    comms.hpp:62-89)."""
+    return _DTYPE_MAP[jnp.dtype(dtype)]

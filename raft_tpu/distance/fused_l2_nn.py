@@ -101,10 +101,6 @@ def fused_l2_nn_min_reduce(
         return rop(carry, cand), None
 
     if init_val is None:
-        # distances come out floating (matmul promotes integer inputs), so
-        # the carry must too — an int dtype would mangle the inf sentinel
-        # and trip lax.scan's carry-type check
-        val_dtype = jnp.result_type(x.dtype, jnp.float32)
         init_val = (
             jnp.full((m,), jnp.inf, val_dtype),
             jnp.full((m,), IDX_SENTINEL, jnp.int32),

@@ -8,12 +8,11 @@ so the (n_queries, n_index) distance matrix never exists in memory.
 It is the fast path of ``brute_force_knn`` for k ≤ 64 / L2 / row-major
 (detail/knn_brute_force_faiss.cuh:297-313).
 
-TPU re-design: a ``lax.scan`` over index-row tiles.  Each step is one MXU
-matmul (expanded ``xn + yn − 2·q@yᵀ`` form) followed by a tile-local
-top-k, merged into the running (k,) result by concatenation + re-selection
-— the reference's smem-merge becomes a (k + k)-wide top-k on registers,
-and XLA pipelines the scan so the matmul of tile t+1 overlaps the
-selection of tile t.  High-water memory is (n_queries, tile_n).
+TPU re-design: the shared tile-scan driver
+(:mod:`raft_tpu.spatial.tiled_knn`) with an MXU-matmul distance tile in
+the expanded ``qn + xn − 2·q@xᵀ`` form.  The reference's smem-merge
+becomes a (k + k)-wide re-selection per tile; high-water memory is
+(n_queries, tile_n).
 
 Like the reference kernel, returned distances are *squared* L2; the sqrt
 fixup for L2Sqrt metrics is the caller's postprocess step
@@ -24,12 +23,10 @@ from __future__ import annotations
 
 from typing import Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
 
 from raft_tpu.core.error import expects
-from raft_tpu.core.utils import ceildiv
+from raft_tpu.spatial.tiled_knn import tiled_knn
 
 
 def fused_l2_knn(
@@ -58,42 +55,15 @@ def fused_l2_knn(
     (distances, indices): (n_queries, k) squared-L2 distances sorted
     ascending and int32 index-row ids.
     """
-    expects(index.ndim == 2 and queries.ndim == 2 and index.shape[1] == queries.shape[1],
+    expects(index.ndim == 2 and queries.ndim == 2
+            and index.shape[1] == queries.shape[1],
             "fused_l2_knn: shape mismatch")
-    n = index.shape[0]
-    expects(0 < k <= n, "fused_l2_knn: k=%d out of range for n_index=%d", k, n)
-    nq = queries.shape[0]
-
-    tile_n = max(k, min(tile_n, n))
-    n_tiles = ceildiv(n, tile_n)
-    n_pad = n_tiles * tile_n
-
     qn = jnp.sum(queries * queries, axis=1)
-    xn = jnp.sum(index * index, axis=1)
-    # padded rows get +inf norms so they can never be selected
-    x_p = jnp.pad(index, ((0, n_pad - n), (0, 0)))
-    xn_p = jnp.pad(xn, (0, n_pad - n), constant_values=jnp.inf)
 
-    def step(carry, tile_idx):
-        best_d, best_i = carry
-        j0 = tile_idx * tile_n
-        x_t = lax.dynamic_slice_in_dim(x_p, j0, tile_n, axis=0)
-        xn_t = lax.dynamic_slice_in_dim(xn_p, j0, tile_n, axis=0)
+    def tile_dist(q, x_t):
+        xn_t = jnp.sum(x_t * x_t, axis=1)
         d = qn[:, None] + xn_t[None, :] - 2.0 * jnp.matmul(
-            queries, x_t.T, precision=precision)
-        d = jnp.maximum(d, 0.0)
-        d = jnp.where(jnp.isfinite(xn_t)[None, :], d, jnp.inf)
-        kk = min(k, tile_n)
-        t_vals, t_idx = lax.top_k(-d, kk)
-        t_idx = (j0 + t_idx).astype(jnp.int32)
-        # merge running and tile top-k: 2k-wide re-selection
-        cat_d = jnp.concatenate([best_d, -t_vals], axis=1)
-        cat_i = jnp.concatenate([best_i, t_idx], axis=1)
-        m_vals, m_pos = lax.top_k(-cat_d, k)
-        m_idx = jnp.take_along_axis(cat_i, m_pos, axis=1)
-        return (-m_vals, m_idx), None
+            q, x_t.T, precision=precision)
+        return jnp.maximum(d, 0.0)
 
-    init = (jnp.full((nq, k), jnp.inf, dtype=jnp.result_type(queries.dtype, jnp.float32)),
-            jnp.full((nq, k), jnp.iinfo(jnp.int32).max, dtype=jnp.int32))
-    (best_d, best_i), _ = lax.scan(step, init, jnp.arange(n_tiles))
-    return best_d, best_i
+    return tiled_knn(index, queries, k, tile_dist, tile_n=tile_n)

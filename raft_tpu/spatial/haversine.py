@@ -6,9 +6,8 @@ cos(lat₁)cos(lat₂)sin²(Δlon/2)))`` on radian coordinates, and
 ``haversine_knn_kernel`` (:61) pairs it with a block-select top-k.
 
 TPU re-design: the 2-D feature dimension makes this VPU-bound elementwise
-work — broadcast the (m, 1, 2) × (1, n, 2) trig terms and reduce with
-``select_k``.  For large n the kNN path tiles over index rows the same
-way as :mod:`raft_tpu.spatial.fused_l2_knn`.
+work — broadcast the (m, 1, 2) × (1, n, 2) trig terms; the kNN path runs
+on the shared tile-scan driver (:mod:`raft_tpu.spatial.tiled_knn`).
 """
 
 from __future__ import annotations
@@ -16,10 +15,9 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax.numpy as jnp
-from jax import lax
 
 from raft_tpu.core.error import expects
-from raft_tpu.core.utils import ceildiv
+from raft_tpu.spatial.tiled_knn import tiled_knn
 
 
 def haversine_distances(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
@@ -44,31 +42,6 @@ def haversine_knn(
 
     Returns (distances, indices) of shape (n_queries, k).
     """
-    n = index.shape[0]
-    expects(0 < k <= n, "haversine_knn: k=%d out of range for n_index=%d", k, n)
-    nq = queries.shape[0]
-    tile_n = max(k, min(tile_n, n))
-    n_tiles = ceildiv(n, tile_n)
-    n_pad = n_tiles * tile_n
-    x_p = jnp.pad(index, ((0, n_pad - n), (0, 0)))
-    valid = jnp.arange(n_pad) < n
-
-    def step(carry, tile_idx):
-        best_d, best_i = carry
-        j0 = tile_idx * tile_n
-        x_t = lax.dynamic_slice_in_dim(x_p, j0, tile_n, axis=0)
-        v_t = lax.dynamic_slice_in_dim(valid, j0, tile_n, axis=0)
-        d = haversine_distances(queries, x_t)
-        d = jnp.where(v_t[None, :], d, jnp.inf)
-        kk = min(k, tile_n)
-        t_vals, t_idx = lax.top_k(-d, kk)
-        t_idx = (j0 + t_idx).astype(jnp.int32)
-        cat_d = jnp.concatenate([best_d, -t_vals], axis=1)
-        cat_i = jnp.concatenate([best_i, t_idx], axis=1)
-        m_vals, m_pos = lax.top_k(-cat_d, k)
-        return (-m_vals, jnp.take_along_axis(cat_i, m_pos, axis=1)), None
-
-    init = (jnp.full((nq, k), jnp.inf, dtype=jnp.result_type(queries.dtype, jnp.float32)),
-            jnp.full((nq, k), jnp.iinfo(jnp.int32).max, dtype=jnp.int32))
-    (best_d, best_i), _ = lax.scan(step, init, jnp.arange(n_tiles))
-    return best_d, best_i
+    expects(queries.ndim == 2 and queries.shape[1] == 2,
+            "haversine distance requires 2 dimensions (latitude / longitude).")
+    return tiled_knn(index, queries, k, haversine_distances, tile_n=tile_n)

@@ -80,6 +80,9 @@ def knn_merge_parts(
     expects(k <= n_parts * kk, "knn_merge_parts: k=%d > total candidates", k)
     idx = part_indices
     if translations is not None:
+        expects(len(translations) == n_parts,
+                "knn_merge_parts: %d translations for %d partitions",
+                len(translations), n_parts)
         trans = jnp.asarray(translations, dtype=part_indices.dtype)
         idx = idx + trans[:, None, None]
     # (n_parts, nq, k) -> (nq, n_parts*k) candidate lists

@@ -1,0 +1,66 @@
+"""Shared tile-scan kNN driver.
+
+The reference's fused kNN kernels (fused_l2_knn.cuh:196, and the
+haversine variant haversine_distance.cuh:61) share one structure: stream
+index tiles through fast memory, compute a distance tile, select top-k in
+the tile, merge with the running top-k (the usePrevTopKs path).  This
+module is that structure as a ``lax.scan``, parameterized by the per-tile
+distance function — XLA pipelines the scan so tile t+1's distance
+computation overlaps tile t's selection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.utils import ceildiv
+
+
+def tiled_knn(
+    index: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    tile_dist: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    tile_n: int = 8192,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k best (smallest-distance) index rows per query.
+
+    ``tile_dist(queries, index_tile) -> (n_queries, tile_n)`` computes the
+    distance tile; padding rows of the index are zeros and their distances
+    are overridden to +inf here, so ``tile_dist`` need not handle them.
+
+    Returns (distances, indices): (n_queries, k) ascending, int32 ids.
+    """
+    n = index.shape[0]
+    expects(0 < k <= n, "tiled_knn: k=%d out of range for n_index=%d", k, n)
+    nq = queries.shape[0]
+    tile_n = max(k, min(tile_n, n))
+    n_tiles = ceildiv(n, tile_n)
+    n_pad = n_tiles * tile_n
+    x_p = jnp.pad(index, ((0, n_pad - n), (0, 0)))
+    valid = jnp.arange(n_pad) < n
+
+    def step(carry, tile_idx):
+        best_d, best_i = carry
+        j0 = tile_idx * tile_n
+        x_t = lax.dynamic_slice_in_dim(x_p, j0, tile_n, axis=0)
+        v_t = lax.dynamic_slice_in_dim(valid, j0, tile_n, axis=0)
+        d = jnp.where(v_t[None, :], tile_dist(queries, x_t), jnp.inf)
+        t_vals, t_idx = lax.top_k(-d, k)
+        t_idx = (j0 + t_idx).astype(jnp.int32)
+        # merge running and tile top-k: 2k-wide re-selection
+        cat_d = jnp.concatenate([best_d, -t_vals], axis=1)
+        cat_i = jnp.concatenate([best_i, t_idx], axis=1)
+        m_vals, m_pos = lax.top_k(-cat_d, k)
+        m_idx = jnp.take_along_axis(cat_i, m_pos, axis=1)
+        return (-m_vals, m_idx), None
+
+    init = (jnp.full((nq, k), jnp.inf,
+                     dtype=jnp.result_type(queries.dtype, jnp.float32)),
+            jnp.full((nq, k), jnp.iinfo(jnp.int32).max, dtype=jnp.int32))
+    (best_d, best_i), _ = lax.scan(step, init, jnp.arange(n_tiles))
+    return best_d, best_i
