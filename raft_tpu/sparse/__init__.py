@@ -1,0 +1,15 @@
+"""Sparse formats, conversions, ops, and linear algebra (TPU-native).
+
+Re-designs the reference's largest module (``cpp/include/raft/sparse/``,
+~11.6k LoC of CUDA) for a dense-tile machine:
+
+- Containers are **fixed-capacity padded pytrees** (static shapes for XLA);
+  invalid entries carry a sentinel row id that sorts past every real row.
+- Irregular CUDA patterns (atomics, warp scans, cuCollections hash tables)
+  become sort + segment-reduce, which XLA lowers to efficient TPU code.
+- nnz-changing ops (filter, dedup) keep capacity and return a valid count,
+  so they stay jittable; ``compact()`` trims eagerly outside jit.
+"""
+
+from raft_tpu.sparse.formats import COO, CSR  # noqa: F401
+from raft_tpu.sparse import convert, op, linalg  # noqa: F401

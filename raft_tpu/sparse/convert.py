@@ -1,0 +1,55 @@
+"""Format conversions: COO <-> CSR <-> dense.
+
+Reference: sparse/convert/csr.hpp:27 (``coo_to_csr``), :55-95
+(``sorted_coo_to_csr``), sparse/convert/coo.hpp:34 (``csr_to_coo``),
+sparse/convert/dense.hpp:44 (``csr_to_dense`` via cuSPARSE).
+
+TPU design: conversions are pure index arithmetic — ``searchsorted`` over
+sorted row ids replaces the reference's atomic histogram + exclusive scan,
+and stays fully inside XLA (no scatter with conflicts).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse.formats import COO, CSR
+
+
+def sorted_rows_to_indptr(rows: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+    """indptr from row-sorted COO row ids (padding rows == n_rows sort last).
+
+    Reference: sorted_coo_to_csr (sparse/convert/csr.hpp:55) — there an
+    atomic-count + cumsum; here one vectorized binary search.
+    """
+    targets = jnp.arange(n_rows + 1, dtype=jnp.int32)
+    return jnp.searchsorted(rows, targets, side="left").astype(jnp.int32)
+
+
+def coo_to_csr(coo: COO, assume_sorted: bool = False) -> CSR:
+    """Convert COO to CSR (reference sparse/convert/csr.hpp:27).
+
+    Sorts by (row, col) unless ``assume_sorted``; padding stays at the tail.
+    """
+    rows, cols, vals = coo.rows, coo.cols, coo.vals
+    if not assume_sorted:
+        order = jnp.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], vals[order]
+    indptr = sorted_rows_to_indptr(rows, coo.n_rows)
+    return CSR(indptr, cols, vals, coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """Expand indptr to per-entry row ids (reference sparse/convert/coo.hpp:34)."""
+    rows = csr.row_ids()
+    return COO(rows, csr.indices, csr.data, csr.shape, nnz=csr.indptr[-1])
+
+
+def csr_to_dense(csr: CSR) -> jnp.ndarray:
+    """Densify (reference sparse/convert/dense.hpp:44; duplicates sum)."""
+    return csr.to_dense()
+
+
+def dense_to_csr(dense, capacity: int | None = None) -> CSR:
+    """Eager dense→CSR (host-side helper, inverse of csr_to_dense)."""
+    return CSR.from_dense(dense, capacity)

@@ -1,0 +1,202 @@
+"""Sparse containers: padded, static-shape COO and CSR pytrees.
+
+Reference: ``raft::sparse::COO`` (sparse/detail/coo.cuh:46, public
+sparse/coo.hpp) — an owning device container with (rows, cols, vals, nnz,
+n_rows, n_cols) — and the CSR free-function convention (indptr + indices +
+data raw pointers, sparse/csr.hpp).
+
+TPU design: XLA requires static shapes, so both containers are
+**fixed-capacity**: the leaf arrays have length ``capacity`` and only the
+first ``nnz`` entries (after compaction) are valid.  Padding entries carry
+``row == n_rows`` — a sentinel that sorts after every valid row, so sorted
+containers keep padding at the tail and ``searchsorted``-built indptrs are
+automatically correct.  Both classes are registered as pytrees so they can
+flow through ``jit`` / ``vmap`` / ``shard_map`` unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _as_idx(x) -> jnp.ndarray:
+    return jnp.asarray(x, dtype=jnp.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+class COO:
+    """Coordinate-format sparse matrix (padded, static capacity).
+
+    Parameters
+    ----------
+    rows, cols : int32 arrays of shape (capacity,)
+    vals : array of shape (capacity,)
+    shape : (n_rows, n_cols) — static.
+    nnz : number of valid entries.  May be a Python int (static) or a traced
+        int32 scalar (when produced inside jit by an nnz-changing op).
+    """
+
+    def __init__(self, rows, cols, vals, shape: Tuple[int, int], nnz=None):
+        self.rows = _as_idx(rows)
+        self.cols = _as_idx(cols)
+        self.vals = jnp.asarray(vals)
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.nnz = self.capacity if nnz is None else nnz
+
+    # -- pytree protocol ------------------------------------------------ #
+    def tree_flatten(self):
+        static_nnz = isinstance(self.nnz, (int, np.integer))
+        if static_nnz:
+            return (self.rows, self.cols, self.vals), (self.shape, int(self.nnz))
+        return (self.rows, self.cols, self.vals, self.nnz), (self.shape, None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        shape, static_nnz = aux
+        if static_nnz is not None:
+            rows, cols, vals = leaves
+            return cls(rows, cols, vals, shape, static_nnz)
+        rows, cols, vals, nnz = leaves
+        return cls(rows, cols, vals, shape, nnz)
+
+    # -- properties ----------------------------------------------------- #
+    @property
+    def capacity(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def sentinel(self) -> int:
+        """Row id marking padding entries (sorts after all valid rows)."""
+        return self.shape[0]
+
+    def valid_mask(self) -> jnp.ndarray:
+        """Boolean mask of real (non-padding) entries."""
+        return self.rows < self.shape[0]
+
+    # -- construction helpers ------------------------------------------- #
+    @classmethod
+    def from_dense(cls, dense, capacity: int | None = None) -> "COO":
+        """Eager construction from a dense matrix (host-side helper)."""
+        d = np.asarray(dense)
+        r, c = np.nonzero(d)
+        v = d[r, c]
+        nnz = len(r)
+        cap = capacity if capacity is not None else max(nnz, 1)
+        assert cap >= nnz, "capacity too small"
+        rows = np.full(cap, d.shape[0], dtype=np.int32)
+        cols = np.zeros(cap, dtype=np.int32)
+        vals = np.zeros(cap, dtype=d.dtype)
+        rows[:nnz], cols[:nnz], vals[:nnz] = r, c, v
+        return cls(rows, cols, vals, d.shape, nnz)
+
+    def to_dense(self) -> jnp.ndarray:
+        """Densify; duplicate coordinates are summed."""
+        mask = self.valid_mask()
+        r = jnp.where(mask, self.rows, 0)
+        c = jnp.where(mask, self.cols, 0)
+        v = jnp.where(mask, self.vals, 0)
+        out = jnp.zeros(self.shape, dtype=self.vals.dtype)
+        return out.at[r, c].add(v, mode="drop")
+
+    def compact(self) -> "COO":
+        """Trim padding to the true nnz (eager; not jittable)."""
+        n = int(self.nnz)
+        order = jnp.argsort(~self.valid_mask(), stable=True)  # valid first
+        return COO(
+            self.rows[order][:n], self.cols[order][:n], self.vals[order][:n],
+            self.shape, n,
+        )
+
+    def __repr__(self):
+        return (f"COO(shape={self.shape}, capacity={self.capacity}, "
+                f"nnz={self.nnz})")
+
+
+@jax.tree_util.register_pytree_node_class
+class CSR:
+    """Compressed-sparse-row matrix (padded, static capacity).
+
+    ``indptr`` has length n_rows+1 and indexes into ``indices``/``data``;
+    entries at positions >= indptr[-1] are padding.  Mirrors the reference's
+    raw-pointer CSR convention (sparse/csr.hpp) as an owning container.
+    """
+
+    def __init__(self, indptr, indices, data, shape: Tuple[int, int]):
+        self.indptr = _as_idx(indptr)
+        self.indices = _as_idx(indices)
+        self.data = jnp.asarray(data)
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, aux[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def nnz(self):
+        return self.indptr[-1]
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    def row_ids(self) -> jnp.ndarray:
+        """Per-entry row id (padding entries get n_rows).
+
+        The segment-id vector that replaces the reference's per-row kernel
+        launches (e.g. sparse/op/row_op.hpp:37) — TPU primitives express
+        per-row work as segment reductions over this vector.
+        """
+        pos = jnp.arange(self.capacity, dtype=jnp.int32)
+        r = jnp.searchsorted(self.indptr, pos, side="right").astype(jnp.int32) - 1
+        return jnp.where(pos < self.indptr[-1], r, self.shape[0])
+
+    @classmethod
+    def from_dense(cls, dense, capacity: int | None = None) -> "CSR":
+        d = np.asarray(dense)
+        r, c = np.nonzero(d)
+        v = d[r, c]
+        nnz = len(r)
+        cap = capacity if capacity is not None else max(nnz, 1)
+        assert cap >= nnz, "capacity too small"
+        indptr = np.zeros(d.shape[0] + 1, dtype=np.int32)
+        np.add.at(indptr[1:], r, 1)
+        indptr = np.cumsum(indptr).astype(np.int32)
+        indices = np.zeros(cap, dtype=np.int32)
+        data = np.zeros(cap, dtype=d.dtype)
+        indices[:nnz], data[:nnz] = c, v
+        return cls(indptr, indices, data, d.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        rows = self.row_ids()
+        mask = rows < self.shape[0]
+        r = jnp.where(mask, rows, 0)
+        c = jnp.where(mask, self.indices, 0)
+        v = jnp.where(mask, self.data, 0)
+        out = jnp.zeros(self.shape, dtype=self.data.dtype)
+        return out.at[r, c].add(v, mode="drop")
+
+    def __repr__(self):
+        return f"CSR(shape={self.shape}, capacity={self.capacity})"

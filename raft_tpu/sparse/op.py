@@ -1,0 +1,155 @@
+"""COO/CSR element operations: sort, dedup, filter, row ops, slicing.
+
+Reference: sparse/op/sort.hpp (``coo_sort``, ``coo_sort_by_weight``),
+sparse/op/reduce.hpp:47,70 (``compute_duplicates_mask``, ``max_duplicates``),
+sparse/op/filter.hpp:44 (``coo_remove_scalar``), sparse/op/row_op.hpp:37
+(``csr_row_op``), sparse/op/slice.hpp:38,63 (``csr_row_slice_*``).
+
+TPU design: the reference leans on thrust sort / CUB scans / atomic
+compaction.  Here every nnz-changing op is sort-to-tail + count: removed
+entries get the sentinel row id, one stable sort moves them to the end, and
+the valid count rides along as a traced scalar — capacity never changes, so
+everything stays jittable with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.formats import COO, CSR
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort entries by (row, col); padding sorts last.
+
+    Reference: coo_sort (sparse/op/sort.hpp) — thrust::sort_by_key there,
+    one XLA lexsort here.
+    """
+    order = jnp.lexsort((coo.cols, coo.rows))
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order],
+               coo.shape, coo.nnz)
+
+
+def coo_sort_by_weight(coo: COO) -> COO:
+    """Sort entries ascending by value (reference sparse/op/sort.hpp:67).
+
+    Padding entries are pushed to the tail regardless of their value.
+    """
+    key = jnp.where(coo.valid_mask(), coo.vals, jnp.inf)
+    order = jnp.argsort(key, stable=True)
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order],
+               coo.shape, coo.nnz)
+
+
+def compute_duplicates_mask(rows: jnp.ndarray, cols: jnp.ndarray,
+                            n_rows: int) -> jnp.ndarray:
+    """1 at the first occurrence of each (row, col) in sorted order, else 0.
+
+    Reference: compute_duplicates_mask (sparse/op/reduce.hpp:47).  Input must
+    be sorted by (row, col); padding (row == n_rows) is always masked 0.
+    """
+    prev_r = jnp.concatenate([jnp.array([-1], rows.dtype), rows[:-1]])
+    prev_c = jnp.concatenate([jnp.array([-1], cols.dtype), cols[:-1]])
+    first = (rows != prev_r) | (cols != prev_c)
+    return (first & (rows < n_rows)).astype(jnp.int32)
+
+
+def max_duplicates(coo: COO) -> COO:
+    """Reduce duplicate coordinates keeping the max value.
+
+    Reference: max_duplicates (sparse/op/reduce.hpp:70) — custom kernel with
+    atomicMax; here sort + segment-max into compacted slots.
+    """
+    s = coo_sort(coo)
+    mask = compute_duplicates_mask(s.rows, s.cols, s.n_rows)
+    # Slot id for each unique coordinate, in sorted order.
+    slot = jnp.cumsum(mask) - 1
+    n_unique = slot[-1] + 1
+    cap = s.capacity
+    sentinel = s.sentinel
+    valid = s.valid_mask()
+    slot = jnp.where(valid, slot, cap - 1)
+    neg_inf = jnp.array(-jnp.inf, dtype=s.vals.dtype) \
+        if jnp.issubdtype(s.vals.dtype, jnp.floating) \
+        else jnp.iinfo(s.vals.dtype).min
+    out_vals = jax.ops.segment_max(
+        jnp.where(valid, s.vals, neg_inf), slot, num_segments=cap)
+    out_rows = jax.ops.segment_min(
+        jnp.where(valid, s.rows, sentinel), slot, num_segments=cap)
+    out_cols = jax.ops.segment_min(
+        jnp.where(valid, s.cols, 0), slot, num_segments=cap)
+    in_range = jnp.arange(cap) < n_unique
+    out_rows = jnp.where(in_range, out_rows, sentinel)
+    out_vals = jnp.where(in_range, out_vals, 0)
+    out_cols = jnp.where(in_range, out_cols, 0)
+    return COO(out_rows, out_cols, out_vals, s.shape, nnz=n_unique)
+
+
+def sum_duplicates(coo: COO) -> COO:
+    """Reduce duplicate coordinates by summing (segment-sum variant of
+    max_duplicates; the symmetrize path needs it)."""
+    s = coo_sort(coo)
+    mask = compute_duplicates_mask(s.rows, s.cols, s.n_rows)
+    slot = jnp.cumsum(mask) - 1
+    n_unique = slot[-1] + 1
+    cap = s.capacity
+    valid = s.valid_mask()
+    slot = jnp.where(valid, slot, cap - 1)
+    out_vals = jax.ops.segment_sum(
+        jnp.where(valid, s.vals, 0), slot, num_segments=cap)
+    out_rows = jax.ops.segment_min(
+        jnp.where(valid, s.rows, s.sentinel), slot, num_segments=cap)
+    out_cols = jax.ops.segment_min(
+        jnp.where(valid, s.cols, 0), slot, num_segments=cap)
+    in_range = jnp.arange(cap) < n_unique
+    out_rows = jnp.where(in_range, out_rows, s.sentinel)
+    out_vals = jnp.where(in_range, out_vals, 0)
+    out_cols = jnp.where(in_range, out_cols, 0)
+    return COO(out_rows, out_cols, out_vals, s.shape, nnz=n_unique)
+
+
+def coo_remove_scalar(coo: COO, scalar) -> COO:
+    """Drop entries whose value equals ``scalar``.
+
+    Reference: coo_remove_scalar (sparse/op/filter.hpp:44) — there a
+    count/exclusive-scan/compact kernel chain; here mark-with-sentinel +
+    stable sort-to-tail.
+    """
+    keep = coo.valid_mask() & (coo.vals != scalar)
+    rows = jnp.where(keep, coo.rows, coo.sentinel)
+    order = jnp.argsort(~keep, stable=True)
+    return COO(rows[order], coo.cols[order], coo.vals[order], coo.shape,
+               nnz=jnp.sum(keep.astype(jnp.int32)))
+
+
+def coo_remove_zeros(coo: COO) -> COO:
+    """Reference's coo_remove_zeros convenience wrapper."""
+    return coo_remove_scalar(coo, 0)
+
+
+def csr_row_op(csr: CSR, fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+               ) -> jnp.ndarray:
+    """Apply a per-entry function with its row id: fn(row_ids, data).
+
+    Reference: csr_row_op (sparse/op/row_op.hpp:37) launches a lambda per
+    row over [start, stop); the TPU formulation hands the segment-id vector
+    to a vectorized lambda — combine with ``jax.ops.segment_*`` for per-row
+    reductions.
+    """
+    return fn(csr.row_ids(), csr.data)
+
+
+def csr_row_slice(csr: CSR, start: int, stop: int) -> CSR:
+    """Slice rows [start, stop) into a new CSR (eager; dynamic output size).
+
+    Reference: csr_row_slice_indptr + csr_row_slice_populate
+    (sparse/op/slice.hpp:38,63).
+    """
+    lo = int(csr.indptr[start])
+    hi = int(csr.indptr[stop])
+    indptr = csr.indptr[start:stop + 1] - lo
+    return CSR(indptr, csr.indices[lo:hi], csr.data[lo:hi],
+               (stop - start, csr.n_cols))
