@@ -21,9 +21,9 @@ TPU re-design in two regimes:
   ``PairwiseDistances`` template.
 
 Parity notes (verified against the reference):
-- ``CosineExpanded`` returns the cosine **similarity** acc/(|x||y|) — the
-  default fin_op is identity (detail/distance.cuh:635, cosine.cuh:85-97);
-  the 1-sim conversion is the consumer's job in the reference.
+- ``CosineExpanded`` returns the cosine **distance** 1 - acc/(|x||y|)
+  (cosine.cuh:29,171 "C = 1 - op(...)"; the fin_op wrapper computes 1 - pA
+  before the user lambda, cosine.cuh:210).  Zero-norm rows get distance 1.
 - ``CorrelationExpanded`` returns the correlation *distance*
   1 - r (correlation.cuh:124-128).
 - ``KLDivergence`` returns 0.5 * KL (kl_divergence.cuh:124).
@@ -75,9 +75,13 @@ def _l2_expanded(x, y, sqrt: bool):
 
 
 def _cosine(x, y):
+    # distance form: 1 - sim (reference distance/detail/cosine.cuh:29);
+    # zero-norm rows have empty support -> similarity 0 -> distance 1
     xn = jnp.sqrt(jnp.sum(x * x, axis=1))
     yn = jnp.sqrt(jnp.sum(y * y, axis=1))
-    return _mm(x, y.T) / (xn[:, None] * yn[None, :])
+    den = xn[:, None] * yn[None, :]
+    sim = jnp.where(den > 0, _mm(x, y.T) / jnp.where(den == 0, 1.0, den), 0.0)
+    return 1.0 - sim
 
 
 def _correlation(x, y):

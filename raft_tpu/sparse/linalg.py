@@ -109,9 +109,7 @@ def csr_add(a: CSR, b: CSR) -> CSR:
     cols = jnp.concatenate([ca.cols, cb.cols])
     vals = jnp.concatenate([ca.vals.astype(jnp.result_type(ca.vals, cb.vals)),
                             cb.vals.astype(jnp.result_type(ca.vals, cb.vals))])
-    merged = COO(rows, cols, vals, a.shape,
-                 nnz=ca.nnz + cb.nnz if isinstance(ca.nnz, int) and
-                 isinstance(cb.nnz, int) else None)
+    merged = COO(rows, cols, vals, a.shape)
     summed = sparse_op.sum_duplicates(merged)
     return convert.coo_to_csr(summed, assume_sorted=True)
 
@@ -148,13 +146,17 @@ def coo_symmetrize(coo: COO,
     s = sparse_op.coo_sort(coo)
     valid = s.valid_mask()
     n_cols_p1 = s.n_cols + 1
-    key = s.rows.astype(jnp.int64) * n_cols_p1 + s.cols
-    key = jnp.where(valid, key, jnp.iinfo(jnp.int64).max)
-    # transposed key for each entry: (col, row)
-    tkey = s.cols.astype(jnp.int64) * n_cols_p1 + s.rows
-    pos = jnp.searchsorted(key, tkey)
-    pos_c = jnp.clip(pos, 0, s.capacity - 1)
-    found = (key[pos_c] == tkey) & valid
+    # 64-bit combined keys regardless of the session's x64 setting: int32
+    # keys collide once n_rows*(n_cols+1) exceeds 2^31 (any ~46k-vertex
+    # graph), so force x64 locally for the key match
+    with jax.enable_x64(True):
+        key = s.rows.astype(jnp.int64) * n_cols_p1 + s.cols
+        key = jnp.where(valid, key, jnp.iinfo(jnp.int64).max)
+        # transposed key for each entry: (col, row)
+        tkey = s.cols.astype(jnp.int64) * n_cols_p1 + s.rows
+        pos = jnp.searchsorted(key, tkey)
+        pos_c = jnp.clip(pos, 0, s.capacity - 1).astype(jnp.int32)
+        found = (key[pos_c] == tkey) & valid
     vt = jnp.where(found, s.vals[pos_c], 0)
 
     # combined value for the directed edge (i,j); union with (j,i) edges

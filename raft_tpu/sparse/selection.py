@@ -1,0 +1,95 @@
+"""Sparse brute-force kNN and kNN-graph construction.
+
+Reference: sparse/selection/knn.hpp:52 (``brute_force_knn`` over CSR) whose
+engine ``sparse_knn_t::run`` (selection/detail/knn.cuh:117,162) tiles index
+and query matrices with ``csr_batcher_t`` (:41), computes block distances,
+k-selects per block, and merges running results; and
+sparse/selection/knn_graph.hpp:46 (symmetrized kNN graph from dense input).
+
+TPU design: batching is a static double loop over row tiles (shapes fixed →
+one XLA program); per-block select_k is the shared sort-based top-k; the
+running merge is ``knn_merge_parts`` over [running, block] — identical
+dataflow to the reference, minus streams/heaps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.sparse.distance import block_pairwise, densify_rows
+from raft_tpu.sparse.formats import CSR
+from raft_tpu.sparse.linalg import symmetrize_knn
+from raft_tpu.spatial.knn import knn_merge_parts
+from raft_tpu.spatial.select_k import select_k
+
+D = DistanceType
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "metric", "metric_arg", "batch_size_index", "batch_size_query"))
+def brute_force_knn(idx: CSR, query: CSR, k: int,
+                    metric: DistanceType = D.L2Expanded,
+                    metric_arg: float = 2.0,
+                    batch_size_index: int = 2048,
+                    batch_size_query: int = 2048,
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest index rows for every query row, both CSR.
+
+    Returns (distances, indices) of shape (n_query, k), best-first.
+    Reference: sparse/selection/knn.hpp:52.
+    """
+    m, nq = idx.n_rows, query.n_rows
+    select_min = metric != D.InnerProduct
+    bi = min(batch_size_index, m)
+    bq = min(batch_size_query, nq)
+    n_tiles_i = -(-m // bi)
+    n_tiles_q = -(-nq // bq)
+
+    worst = jnp.inf if select_min else -jnp.inf
+    all_d = []
+    all_i = []
+    # densify each index tile once, not once per query tile
+    idx_tiles = [densify_rows(idx, ii * bi, bi) for ii in range(n_tiles_i)]
+    for iq in range(n_tiles_q):
+        xq = densify_rows(query, iq * bq, bq)
+        run_d = jnp.full((bq, k), worst, dtype=jnp.float32)
+        run_i = jnp.full((bq, k), -1, dtype=jnp.int32)
+        for ii, xi in enumerate(idx_tiles):
+            blk = block_pairwise(xq, xi, metric, metric_arg).astype(jnp.float32)
+            # mask out padding index rows of the last tile
+            col_ids = ii * bi + jnp.arange(bi)
+            blk = jnp.where(col_ids[None, :] < m, blk, worst)
+            bd, bi_local = select_k(blk, min(k, bi), select_min=select_min)
+            if bd.shape[1] < k:  # pad block result up to k candidates
+                pad = k - bd.shape[1]
+                bd = jnp.pad(bd, ((0, 0), (0, pad)), constant_values=worst)
+                bi_local = jnp.pad(bi_local, ((0, 0), (0, pad)),
+                                   constant_values=-1)
+            cand_d = jnp.stack([run_d, bd])
+            cand_i = jnp.stack([run_i, bi_local + ii * bi])
+            run_d, run_i = knn_merge_parts(cand_d, cand_i, k,
+                                           select_min=select_min)
+        all_d.append(run_d)
+        all_i.append(run_i)
+    out_d = jnp.concatenate(all_d, axis=0)[:nq]
+    out_i = jnp.concatenate(all_i, axis=0)[:nq]
+    return out_d, out_i
+
+
+def knn_graph(X: jnp.ndarray, k: int,
+              metric: DistanceType = D.L2SqrtExpanded) -> COO:
+    """Symmetrized kNN graph of dense row set X (m, d) → COO (m, m).
+
+    Reference: sparse/selection/knn_graph.hpp:46 — kNN (k includes self,
+    which is then an explicit zero-weight loop edge filtered by
+    symmetrization semantics downstream) + max-symmetrize.
+    """
+    from raft_tpu.spatial.knn import brute_force_knn as dense_knn
+
+    dists, inds = dense_knn([X], X, k=k, metric=metric)
+    return symmetrize_knn(inds, dists, X.shape[0])

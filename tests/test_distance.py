@@ -29,7 +29,7 @@ def naive(x, y, metric, p=2.0):
             elif metric == D.L2SqrtExpanded or metric == D.L2SqrtUnexpanded:
                 out[i, j] = np.sqrt(((a - b) ** 2).sum())
             elif metric == D.CosineExpanded:
-                out[i, j] = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+                out[i, j] = 1 - (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
             elif metric == D.CorrelationExpanded:
                 out[i, j] = 1 - np.corrcoef(a, b)[0, 1]
             elif metric == D.InnerProduct:

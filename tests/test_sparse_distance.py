@@ -1,0 +1,85 @@
+"""Sparse distance + sparse kNN tests vs dense/scipy naive references.
+
+Mirrors cpp/test/sparse/dist_*.cu and cpp/test/sparse/knn.cu: sparse results
+must match the dense metric computed on the densified operands.
+"""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance.distance_type import DistanceType as D
+from raft_tpu.sparse import CSR
+from raft_tpu.sparse.distance import pairwise_distance
+from raft_tpu.sparse.selection import brute_force_knn, knn_graph
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    a = (rng.random((23, 17)) * (rng.random((23, 17)) < 0.4)).astype(np.float32)
+    b = (rng.random((19, 17)) * (rng.random((19, 17)) < 0.4)).astype(np.float32)
+    return a, b
+
+
+METRICS = [
+    (D.L2Expanded, lambda a, b: spd.cdist(a, b, "sqeuclidean"), 2e-3),
+    (D.L2SqrtExpanded, lambda a, b: spd.cdist(a, b, "euclidean"), 2e-3),
+    (D.InnerProduct, lambda a, b: a @ b.T, 1e-4),
+    (D.L1, lambda a, b: spd.cdist(a, b, "cityblock"), 1e-4),
+    (D.Linf, lambda a, b: spd.cdist(a, b, "chebyshev"), 1e-4),
+    (D.CosineExpanded, lambda a, b: spd.cdist(a, b, "cosine"), 1e-3),
+    (D.JaccardExpanded,
+     lambda a, b: spd.cdist(a != 0, b != 0, "jaccard"), 1e-4),
+    (D.DiceExpanded, lambda a, b: spd.cdist(a != 0, b != 0, "dice"), 1e-4),
+    (D.Canberra, lambda a, b: spd.cdist(a, b, "canberra"), 1e-3),
+    (D.LpUnexpanded, lambda a, b: spd.cdist(a, b, "minkowski", p=3.0), 1e-3),
+]
+
+
+@pytest.mark.parametrize("metric,ref,tol", METRICS,
+                         ids=[m[0].name for m in METRICS])
+def test_sparse_pairwise(data, metric, ref, tol):
+    a, b = data
+    ca = CSR.from_dense(a, capacity=256)
+    cb = CSR.from_dense(b, capacity=256)
+    got = np.asarray(pairwise_distance(ca, cb, metric, metric_arg=3.0,
+                                       batch_size_a=8, batch_size_b=8))
+    expect = np.asarray(ref(a, b), dtype=np.float64)
+    np.testing.assert_allclose(got, expect, rtol=tol, atol=tol)
+
+
+def test_sparse_knn_matches_dense(data):
+    a, b = data
+    ca = CSR.from_dense(a, capacity=256)
+    cb = CSR.from_dense(b, capacity=256)
+    dists, inds = brute_force_knn(ca, cb, k=5, metric=D.L2Expanded,
+                                  batch_size_index=8, batch_size_query=8)
+    full = spd.cdist(b, a, "sqeuclidean")
+    expect_i = np.argsort(full, axis=1, kind="stable")[:, :5]
+    expect_d = np.take_along_axis(full, expect_i, axis=1)
+    np.testing.assert_allclose(np.asarray(dists), expect_d, atol=2e-3)
+    # indices may tie-swap; compare distances at chosen indices
+    chosen = np.take_along_axis(full, np.asarray(inds), axis=1)
+    np.testing.assert_allclose(chosen, expect_d, atol=2e-3)
+
+
+def test_sparse_knn_inner_product(data):
+    a, b = data
+    ca = CSR.from_dense(a, capacity=256)
+    cb = CSR.from_dense(b, capacity=256)
+    dists, inds = brute_force_knn(ca, cb, k=3, metric=D.InnerProduct)
+    full = b @ a.T
+    expect_i = np.argsort(-full, axis=1, kind="stable")[:, :3]
+    expect_d = np.take_along_axis(full, expect_i, axis=1)
+    np.testing.assert_allclose(np.asarray(dists), expect_d, atol=1e-4)
+
+
+def test_knn_graph_symmetric():
+    rng = np.random.default_rng(0)
+    X = rng.random((20, 4)).astype(np.float32)
+    g = knn_graph(X, k=4)
+    dense = np.asarray(g.to_dense())
+    np.testing.assert_allclose(dense, dense.T, atol=1e-6)
+    # every vertex keeps at least k-1 neighbors (self edge has weight 0)
+    assert ((dense > 0).sum(axis=1) >= 3).all()
