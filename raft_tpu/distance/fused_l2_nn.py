@@ -50,6 +50,7 @@ def fused_l2_nn_min_reduce(
     init_val: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     tile_n: int = 4096,
     mask: Optional[jnp.ndarray] = None,
+    tile_mask_fn: Optional[Callable] = None,
     precision: str = "highest",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Tiled L2 + 1-NN scan with a pluggable KVP reduce op (reference
@@ -58,9 +59,13 @@ def fused_l2_nn_min_reduce(
     ``reduce_op(best (val, idx), cand (val, idx)) -> (val, idx)`` merges
     each tile's candidate minimum per row into the running pair;
     ``init_val`` seeds the running pair (default: ``(inf, int32-max)``).
-    ``mask`` (m, n), True = pair admissible; ``sqrt`` reports root
-    distances (applied per tile — monotonic, so the reduction semantics
-    are unchanged, matching the reference's in-kernel epilogue).
+    ``mask`` (m, n), True = pair admissible; ``tile_mask_fn(j0, tile_n) ->
+    (m, tile_n) bool`` computes the admissibility mask per tile on the fly
+    (True = allowed) without materializing m×n — the color-test hook
+    connect_components folds into the scan this way, playing
+    FixConnectivitiesRedOp's role (connect_components.cuh:89).  ``sqrt``
+    reports root distances (applied per tile — monotonic, so the reduction
+    semantics are unchanged, matching the reference's in-kernel epilogue).
     """
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
             "fused_l2_nn: shape mismatch")
@@ -93,6 +98,8 @@ def fused_l2_nn_min_reduce(
         if mask is not None:
             mk = jax.lax.dynamic_slice_in_dim(mask_p, j0, tile_n, axis=1)
             d = jnp.where(mk, d, jnp.inf)
+        if tile_mask_fn is not None:
+            d = jnp.where(tile_mask_fn(j0, tile_n), d, jnp.inf)
         if sqrt:
             d = jnp.sqrt(d)
         t_idx = jnp.argmin(d, axis=1)
@@ -122,9 +129,10 @@ def fused_l2_nn(
 
     ``sqrt`` applies the square root to the reported minimum (reference
     fused_l2_nn.hpp:84 Sqrt template param).  ``mask`` (m, n) optionally
-    excludes pairs (True = allowed), the hook connect_components uses to
-    skip same-color pairs; a fully-masked row returns
-    ``(inf, IDX_SENTINEL)``.
+    excludes pairs (True = allowed); a fully-masked row returns
+    ``(inf, IDX_SENTINEL)``.  (connect_components uses the per-tile
+    ``tile_mask_fn`` hook of :func:`fused_l2_nn_min_reduce` instead, which
+    avoids materializing m×n.)
     """
     return fused_l2_nn_min_reduce(
         x, y, sqrt=sqrt, tile_n=tile_n, mask=mask, precision=precision)

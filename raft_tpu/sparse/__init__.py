@@ -14,3 +14,4 @@ Re-designs the reference's largest module (``cpp/include/raft/sparse/``,
 from raft_tpu.sparse.formats import COO, CSR  # noqa: F401
 from raft_tpu.sparse import convert, op, linalg  # noqa: F401
 from raft_tpu.sparse import distance, selection  # noqa: F401
+from raft_tpu.sparse import mst, linkage, hierarchy  # noqa: F401

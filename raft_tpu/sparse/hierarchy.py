@@ -1,0 +1,219 @@
+"""Single-linkage hierarchical agglomerative clustering (HAC).
+
+Reference: ``single_linkage`` (sparse/hierarchy/single_linkage.hpp:48) and
+its pipeline (hierarchy/detail/single_linkage.hpp:64-120):
+
+1. ``get_distance_graph`` — kNN-graph (k = log2(m) + c) or full-pairwise
+   connectivity (detail/connectivities.cuh);
+2. ``build_sorted_mst`` — Borůvka MST, reconnecting a forest with
+   ``connect_components`` until one component (detail/mst.cuh:80-160);
+3. ``build_dendrogram_host`` — host union-find over weight-sorted edges
+   (detail/agglomerative.cuh:101), scipy convention: merged cluster i gets
+   id m+i, children[i] = (find(src), find(dst));
+4. ``extract_flattened_clusters`` — cut the dendrogram into n_clusters
+   monotonic labels (detail/agglomerative.cuh:237).
+
+TPU design: stages 1-2 are device programs (segment-min Borůvka, fused
+masked 1-NN); stages 3-4 stay on the host exactly like the reference — the
+dendrogram is inherently sequential and tiny (m-1 merges).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.sparse import convert
+from raft_tpu.sparse.formats import COO, CSR
+from raft_tpu.sparse.linkage import connect_components
+from raft_tpu.sparse.mst import mst
+from raft_tpu.sparse.selection import knn_graph
+
+D = DistanceType
+
+
+class LinkageResult(NamedTuple):
+    """Reference ``linkage_output`` (hierarchy/common.h:22-36)."""
+
+    labels: np.ndarray        # (m,) flattened cluster assignments
+    children: np.ndarray      # (m-1, 2) scipy-convention merge tree
+    deltas: np.ndarray        # (m-1,) merge distances
+    sizes: np.ndarray         # (m-1,) merged cluster sizes
+    n_clusters: int
+    n_leaves: int
+
+
+class _UnionFind:
+    """Host union-find with scipy-style next-id assignment
+    (reference UnionFind, detail/agglomerative.cuh:38-80)."""
+
+    def __init__(self, n: int):
+        self.parent = np.full(2 * n - 1, -1, dtype=np.int64)
+        self.size = np.ones(2 * n - 1, dtype=np.int64)
+        self.size[n:] = 0
+        self.next_id = n
+
+    def find(self, x: int) -> int:
+        root = x
+        while self.parent[root] != -1:
+            root = self.parent[root]
+        while self.parent[x] != -1:  # path compression
+            self.parent[x], x = root, self.parent[x]
+        return root
+
+    def union(self, a: int, b: int) -> None:
+        nid = self.next_id
+        self.parent[a] = nid
+        self.parent[b] = nid
+        self.size[nid] = self.size[a] + self.size[b]
+        self.next_id += 1
+
+
+def build_dendrogram_host(src, dst, weights, m: int,
+                          assume_sorted: bool = False
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Union-find dendrogram from weight-sorted MST edges (reference
+    build_dendrogram_host, detail/agglomerative.cuh:101).
+
+    ``assume_sorted`` skips the weight sort when the caller already sorted
+    (build_sorted_mst's contract).
+    """
+    src = np.asarray(src)[: m - 1]
+    dst = np.asarray(dst)[: m - 1]
+    weights = np.asarray(weights)[: m - 1]
+    if not assume_sorted:
+        order = np.argsort(weights, kind="stable")
+        src, dst, weights = src[order], dst[order], weights[order]
+
+    children = np.zeros((m - 1, 2), dtype=np.int64)
+    sizes = np.zeros(m - 1, dtype=np.int64)
+    uf = _UnionFind(m)
+    for i in range(m - 1):
+        aa, bb = uf.find(int(src[i])), uf.find(int(dst[i]))
+        children[i, 0], children[i, 1] = aa, bb
+        sizes[i] = uf.size[aa] + uf.size[bb]
+        uf.union(aa, bb)
+    return children, weights.astype(np.float64), sizes
+
+
+def extract_flattened_clusters(children: np.ndarray, n_clusters: int,
+                               n_leaves: int) -> np.ndarray:
+    """Cut the dendrogram into n_clusters monotonic labels (reference
+    extract_flattened_clusters, detail/agglomerative.cuh:237)."""
+    m = n_leaves
+    if n_clusters == 1:
+        return np.zeros(m, dtype=np.int64)
+    # undo the last (n_clusters - 1) merges: union over the first
+    # m - n_clusters merges only
+    parent = np.full(2 * m - 1, -1, dtype=np.int64)
+    for i in range(m - n_clusters):
+        nid = m + i
+        parent[children[i, 0]] = nid
+        parent[children[i, 1]] = nid
+
+    def find(x):
+        while parent[x] != -1:
+            x = parent[x]
+        return x
+
+    roots = np.array([find(i) for i in range(m)])
+    # monotonic relabel (the reference reuses label roots + make_monotonic)
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels
+
+
+_SQRT_L2 = (D.L2SqrtExpanded, D.L2SqrtUnexpanded)
+_SQUARED_L2 = (D.L2Expanded, D.L2Unexpanded)
+
+
+def build_sorted_mst(X: jnp.ndarray, graph: CSR, max_iter: int = 10,
+                     metric: DistanceType = D.L2SqrtExpanded
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """MST over the connectivity graph; if the graph is a forest, stitch
+    components with connect_components and re-solve (reference
+    build_sorted_mst, detail/mst.cuh:133-160).
+
+    ``metric`` must describe the units of the graph's edge weights so the
+    stitch edges (Euclidean, computed from X) are in the same units; like
+    the reference's fusedL2NN-based fix-up, only the L2 family can be
+    stitched.
+
+    Returns host (src, dst, weights) with exactly m-1 edges, weight-sorted.
+    """
+    m = X.shape[0]
+    g, colors = mst(graph)
+    edges_src = [np.asarray(g.src)]
+    edges_dst = [np.asarray(g.dst)]
+    edges_w = [np.asarray(g.weights)]
+
+    iters = 1
+    n_components = len(np.unique(np.asarray(colors)))
+    if n_components > 1:
+        expects(metric in _SQRT_L2 or metric in _SQUARED_L2,
+                "build_sorted_mst: graph is disconnected and metric %d is "
+                "not in the L2 family — cannot stitch components (the "
+                "reference's fusedL2NN fix-up is L2-only)", int(metric))
+    while n_components > 1 and iters < max_iter:
+        fix = connect_components(X, colors, sqrt=metric in _SQRT_L2)
+        fix_csr = convert.coo_to_csr(fix)
+        g2, colors = mst(fix_csr, colors=colors)
+        edges_src.append(np.asarray(g2.src))
+        edges_dst.append(np.asarray(g2.dst))
+        edges_w.append(np.asarray(g2.weights))
+        n_components = len(np.unique(np.asarray(colors)))
+        iters += 1
+    expects(n_components == 1,
+            "MST or MSF still disconnected after %d iterations", max_iter)
+
+    src = np.concatenate(edges_src)
+    dst = np.concatenate(edges_dst)
+    w = np.concatenate(edges_w)
+    keep = src >= 0
+    src, dst, w = src[keep], dst[keep], w[keep]
+    expects(len(src) == m - 1,
+            "MST has %d edges, expected %d", len(src), m - 1)
+    order = np.argsort(w, kind="stable")
+    return src[order], dst[order], w[order]
+
+
+def get_distance_graph(X: jnp.ndarray, c: int,
+                       metric: DistanceType,
+                       linkage: str = "knn") -> CSR:
+    """Connectivity graph: kNN (k = log2(m) + c, reference
+    detail/connectivities.cuh) or full pairwise."""
+    m = X.shape[0]
+    if linkage == "knn":
+        k = min(m, int(math.log2(max(m, 2))) + c)
+        g: COO = knn_graph(X, k=k, metric=metric)
+        return convert.coo_to_csr(g)
+    if linkage == "pairwise":
+        from raft_tpu.distance.pairwise import pairwise_distance
+
+        dmat = pairwise_distance(X, X, metric)
+        dmat = jnp.where(jnp.eye(m, dtype=bool), 0.0, dmat)
+        return CSR.from_dense(np.asarray(dmat))
+    raise ValueError(f"unknown linkage '{linkage}'")
+
+
+def single_linkage(X, n_clusters: int,
+                   metric: DistanceType = D.L2SqrtExpanded,
+                   linkage: str = "knn", c: int = 15) -> LinkageResult:
+    """Single-linkage HAC over dense rows X (m, d) (reference
+    single_linkage, sparse/hierarchy/single_linkage.hpp:48).
+    """
+    X = jnp.asarray(X)
+    m = X.shape[0]
+    expects(n_clusters <= m,
+            "n_clusters must be less than or equal to the number of data points")
+    graph = get_distance_graph(X, c, metric, linkage)
+    src, dst, w = build_sorted_mst(X, graph, metric=metric)
+    children, deltas, sizes = build_dendrogram_host(src, dst, w, m,
+                                                    assume_sorted=True)
+    labels = extract_flattened_clusters(children, n_clusters, m)
+    return LinkageResult(labels, children, deltas, sizes,
+                         n_clusters=n_clusters, n_leaves=m)

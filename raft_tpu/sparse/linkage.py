@@ -1,0 +1,82 @@
+"""Graph connection fix-up: cross-component nearest neighbors.
+
+Reference: ``connect_components`` (sparse/selection/detail/
+connect_components.cuh:89,215,230) — runs fusedL2NN with the color-aware
+``FixConnectivitiesRedOp`` so every point finds its nearest neighbor in a
+*different* component, then reduces per component to the single best
+cross-edge pair and emits symmetric COO edges that stitch a disconnected
+kNN graph into one component.
+
+TPU design: the color test folds into the fused tiled 1-NN scan as an
+on-the-fly mask (computed per tile from the colors vector — no m×m mask
+materialized); the per-component argmin is the same three-pass segment-min
+used by the MST solver.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
+from raft_tpu.sparse.formats import COO
+
+
+def cross_color_nn(X: jnp.ndarray, colors: jnp.ndarray,
+                   sqrt: bool = True, tile_n: int = 4096
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """For each point, its nearest neighbor with a different color.
+
+    The fusedL2NN + FixConnectivitiesRedOp composition
+    (connect_components.cuh:230); returns (dists (m,), idx (m,) int32).
+    The color test rides the shared fused scan's per-tile mask hook, so no
+    m×m mask is materialized.
+    """
+    c = colors.astype(jnp.int32)
+    m = X.shape[0]
+    tile = min(tile_n, m)
+    n_tiles = -(-m // tile)
+    cp = jnp.pad(c, (0, n_tiles * tile - m), constant_values=-1)
+
+    def color_mask(j0, tn):
+        ct = jax.lax.dynamic_slice_in_dim(cp, j0, tn, axis=0)
+        return (c[:, None] != ct[None, :]) & (ct[None, :] >= 0)
+
+    return fused_l2_nn_min_reduce(X, X, sqrt=sqrt, tile_n=tile,
+                                  tile_mask_fn=color_mask)
+
+
+def connect_components(X: jnp.ndarray, colors: jnp.ndarray,
+                       sqrt: bool = True) -> COO:
+    """Emit symmetric edges joining each component to its nearest other
+    component (reference connect_components, connect_components.cuh:215).
+
+    Output COO capacity is 2V (≤ one undirected edge per component, both
+    directions); padding rows carry the sentinel.
+    """
+    m = X.shape[0]
+    d, j = cross_color_nn(X, colors, sqrt=sqrt)
+    c = colors.astype(jnp.int32)
+
+    # per-component best (d, point index) — three-pass segment-min
+    INT_MAX = jnp.iinfo(jnp.int32).max
+    mind = jax.ops.segment_min(d, c, num_segments=m)
+    is_min = (d == mind[c]) & jnp.isfinite(d)
+    pm = jnp.where(is_min, jnp.arange(m, dtype=jnp.int32), INT_MAX)
+    minp = jax.ops.segment_min(pm, c, num_segments=m)
+    chosen = minp < INT_MAX  # per color id
+    sel = jnp.where(chosen, minp, 0)
+
+    src = sel.astype(jnp.int32)
+    dst = j[sel]
+    wv = d[sel]
+    rows = jnp.concatenate([jnp.where(chosen, src, m),
+                            jnp.where(chosen, dst, m)])
+    cols = jnp.concatenate([jnp.where(chosen, dst, 0),
+                            jnp.where(chosen, src, 0)])
+    vals = jnp.concatenate([jnp.where(chosen, wv, 0),
+                            jnp.where(chosen, wv, 0)])
+    return COO(rows, cols, vals, (m, m),
+               nnz=2 * jnp.sum(chosen.astype(jnp.int32)))

@@ -1,0 +1,169 @@
+"""Minimum spanning tree / forest: Borůvka via segment-min (no atomics).
+
+Reference: ``MST_solver`` (sparse/mst/mst_solver.cuh:42) with the
+``solve()`` loop (sparse/mst/detail/mst_solver_inl.cuh:111-219): weight
+``alteration`` for uniqueness (:127,258), ``min_edge_per_vertex`` (:148),
+``min_edge_per_supervertex`` (:156), cycle-break, ``label_prop``
+supervertex merge (:199); result ``Graph_COO`` (mst_solver.cuh:27).
+
+TPU design (SURVEY.md §7.7): the reference's atomicMin races are replaced
+by deterministic three-pass segment-mins (weight → canonical edge id →
+entry index), which also replaces the float ``alteration`` hack — the
+lexicographic (weight, edge-id) key *is* unique, so the MST is unique and
+per-component choices can never close a cycle longer than 2.  2-cycles
+(two components picking the same undirected edge) resolve by keeping the
+smaller color as root.  Colors merge by pointer-jumping inside the same
+``lax.while_loop`` — the whole solve is one XLA program with static
+shapes; edges are *marked* in an ``in_mst`` bitmap over the input entry
+list, and extracted/deduplicated at the end.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.formats import CSR
+
+
+class GraphCOO(NamedTuple):
+    """MST edge list (reference Graph_COO, mst_solver.cuh:27).
+
+    Fixed capacity; the first ``n_edges`` entries are valid (already
+    compacted), the rest carry src == -1.
+    """
+
+    src: jnp.ndarray
+    dst: jnp.ndarray
+    weights: jnp.ndarray
+    n_edges: jnp.ndarray
+
+
+def _pointer_jump(parent: jnp.ndarray) -> jnp.ndarray:
+    """Compress parent pointers to roots (label_prop analog)."""
+
+    def cond(p):
+        return jnp.any(p[p] != p)
+
+    def body(p):
+        return p[p]
+
+    return jax.lax.while_loop(cond, body, parent)
+
+
+def mst(csr: CSR,
+        colors: Optional[jnp.ndarray] = None,
+        max_iterations: int = 0):
+    """Borůvka MST/MSF over a symmetric weighted CSR adjacency.
+
+    Parameters
+    ----------
+    csr:
+        Symmetric graph (both edge directions present), weights = data.
+    colors:
+        Optional initial component labels (restart path, reference
+        ``initialize_colors_`` = false in detail/mst.cuh:95-104); defaults
+        to ``arange(V)``.
+    max_iterations:
+        Safety cap on Borůvka rounds (0 = until convergence, like the
+        reference's ``iterations_`` default).
+
+    Returns
+    -------
+    (GraphCOO, colors): marked + compacted edge list (capacity = V-1,
+    undirected — one entry per tree edge) and final component labels
+    (connected components of the input graph).
+    """
+    V = csr.n_rows
+    E = csr.capacity
+    rows = csr.row_ids()
+    cols = csr.indices
+    w = csr.data
+    valid = rows < V
+    safe_rows = jnp.where(valid, rows, 0)
+    safe_cols = jnp.where(valid, cols, 0)
+
+    with jax.enable_x64(True):
+        minuv = jnp.minimum(safe_rows, safe_cols).astype(jnp.int64)
+        maxuv = jnp.maximum(safe_rows, safe_cols).astype(jnp.int64)
+        eid = minuv * V + maxuv  # canonical undirected edge id
+        EID_MAX = jnp.iinfo(jnp.int64).max
+        eid = jnp.where(valid, eid, EID_MAX)
+
+    if colors is None:
+        colors0 = jnp.arange(V, dtype=jnp.int32)
+    else:
+        colors0 = jnp.asarray(colors, dtype=jnp.int32)
+
+    INT_MAX = jnp.iinfo(jnp.int32).max
+    vidx = jnp.arange(V, dtype=jnp.int32)
+    eidx = jnp.arange(E, dtype=jnp.int32)
+
+    def round_(state):
+        color, in_mst, it, _ = state
+        csrc = color[safe_rows]
+        cross = valid & (csrc != color[safe_cols])
+
+        # pass 1: per-component min weight over outgoing cross edges
+        wm = jnp.where(cross, w, jnp.inf)
+        minw = jax.ops.segment_min(wm, csrc, num_segments=V)
+        is_minw = cross & (w == minw[csrc])
+
+        # pass 2: tie-break by canonical edge id (gives weight uniqueness —
+        # the role of the reference's alteration())
+        with jax.enable_x64(True):
+            em = jnp.where(is_minw, eid, EID_MAX)
+            mine = jax.ops.segment_min(em, csrc, num_segments=V)
+            is_mine = is_minw & (eid == mine[csrc])
+
+        # pass 3: tie-break duplicate entries by entry index
+        im = jnp.where(is_mine, eidx, INT_MAX)
+        mini = jax.ops.segment_min(im, csrc, num_segments=V)
+        chosen = mini < INT_MAX  # per color: has an outgoing edge
+        sel = jnp.where(chosen, mini, 0)
+
+        in_mst = in_mst.at[sel].max(chosen)
+
+        # merge components: each choosing color points at its target color
+        target = color[safe_cols[sel]]
+        parent = jnp.where(chosen, target, vidx)
+        # break 2-cycles: keep the smaller color as root
+        two_cycle = parent[parent] == vidx
+        parent = jnp.where(two_cycle, jnp.minimum(vidx, parent), parent)
+        parent = _pointer_jump(parent)
+        color = parent[color]
+        return color, in_mst, it + 1, jnp.any(cross)
+
+    def cond(state):
+        _, _, it, progressed = state
+        keep = progressed
+        if max_iterations:
+            keep = keep & (it < max_iterations)
+        return keep
+
+    state0 = (colors0, jnp.zeros((E,), bool), jnp.int32(0), jnp.bool_(True))
+    color, in_mst, _, _ = jax.lax.while_loop(cond, round_, state0)
+
+    # extract + dedup: among marked entries keep the first per canonical id
+    with jax.enable_x64(True):
+        key = jnp.where(in_mst & valid, eid, EID_MAX)
+        order = jnp.argsort(key)
+        k_sorted = key[order]
+        first = jnp.concatenate([jnp.array([True]),
+                                 k_sorted[1:] != k_sorted[:-1]])
+        keep = first & (k_sorted < EID_MAX)
+    # compact kept entries to the front, capacity V-1
+    pack = jnp.argsort(~keep, stable=True)
+    take = order[pack][: max(V - 1, 1)]
+    kept = keep[pack][: max(V - 1, 1)]
+    src = jnp.where(kept, safe_rows[take], -1).astype(jnp.int32)
+    dst = jnp.where(kept, safe_cols[take], -1).astype(jnp.int32)
+    ww = jnp.where(kept, w[take], 0)
+    n_edges = jnp.sum(kept.astype(jnp.int32))
+    return GraphCOO(src, dst, ww, n_edges), color
+
+
+def mst_weight(g: GraphCOO) -> jnp.ndarray:
+    return jnp.sum(jnp.where(g.src >= 0, g.weights, 0))

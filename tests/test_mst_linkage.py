@@ -1,0 +1,123 @@
+"""MST / connect_components / single_linkage tests vs scipy ground truth.
+
+Mirrors cpp/test/mst.cu (known graphs + weight-sum checks) and
+cpp/test/sparse/linkage.cu (end-to-end labels vs expected clusters).
+"""
+
+import numpy as np
+import pytest
+import scipy.cluster.hierarchy as sch
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csg
+
+from raft_tpu.sparse import CSR
+from raft_tpu.sparse.hierarchy import single_linkage
+from raft_tpu.sparse.linkage import connect_components, cross_color_nn
+from raft_tpu.sparse.mst import mst, mst_weight
+
+
+def random_sym_graph(rng, n, density=0.3):
+    d = rng.random((n, n)) * (rng.random((n, n)) < density)
+    d = np.triu(d, 1)
+    d = d + d.T
+    return d.astype(np.float32)
+
+
+def ref_mst_weight(adj):
+    return csg.minimum_spanning_tree(sp.csr_matrix(adj)).sum()
+
+
+class TestMST:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("n", [10, 40])
+    def test_weight_matches_scipy(self, seed, n):
+        rng = np.random.default_rng(seed)
+        adj = random_sym_graph(rng, n, density=0.5)
+        ncomp, _ = csg.connected_components(sp.csr_matrix(adj), directed=False)
+        g, colors = mst(CSR.from_dense(adj))
+        assert int(g.n_edges) == n - ncomp
+        np.testing.assert_allclose(float(mst_weight(g)),
+                                   float(ref_mst_weight(adj)), rtol=1e-5)
+        assert len(np.unique(np.asarray(colors))) == ncomp
+
+    def test_known_graph(self):
+        # classic 4-node diamond: MST = {0-1 (1), 1-2 (2), 1-3 (3)}
+        adj = np.zeros((4, 4), np.float32)
+        edges = [(0, 1, 1.0), (0, 2, 4.0), (1, 2, 2.0), (1, 3, 3.0),
+                 (2, 3, 5.0)]
+        for i, j, w in edges:
+            adj[i, j] = adj[j, i] = w
+        g, colors = mst(CSR.from_dense(adj))
+        assert int(g.n_edges) == 3
+        assert float(mst_weight(g)) == 6.0
+        assert len(np.unique(np.asarray(colors))) == 1
+
+    def test_forest_restart_with_colors(self):
+        # two disconnected pairs; restart with extra bridging edge
+        adj = np.zeros((4, 4), np.float32)
+        adj[0, 1] = adj[1, 0] = 1.0
+        adj[2, 3] = adj[3, 2] = 1.0
+        g, colors = mst(CSR.from_dense(adj))
+        assert int(g.n_edges) == 2
+        assert len(np.unique(np.asarray(colors))) == 2
+        bridge = np.zeros((4, 4), np.float32)
+        bridge[1, 2] = bridge[2, 1] = 5.0
+        g2, colors2 = mst(CSR.from_dense(bridge), colors=colors)
+        assert int(g2.n_edges) == 1
+        assert len(np.unique(np.asarray(colors2))) == 1
+
+
+class TestConnectComponents:
+    def test_cross_color_nn(self):
+        X = np.array([[0.0, 0], [1, 0], [10, 0], [11, 0]], np.float32)
+        colors = np.array([0, 0, 1, 1], np.int32)
+        d, j = cross_color_nn(X, colors)
+        np.testing.assert_allclose(np.asarray(d), [10, 9, 9, 10], rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(j), [2, 2, 1, 1])
+
+    def test_connects_components(self):
+        rng = np.random.default_rng(3)
+        X = np.concatenate([rng.random((10, 2)),
+                            rng.random((10, 2)) + 5]).astype(np.float32)
+        colors = np.array([0] * 10 + [1] * 10, np.int32)
+        fix = connect_components(X, colors)
+        dense = np.asarray(fix.to_dense())
+        # symmetric cross edges only
+        np.testing.assert_allclose(dense, dense.T)
+        assert (dense[:10, :10] == 0).all() and (dense[10:, 10:] == 0).all()
+        assert (dense > 0).sum() >= 2
+
+
+class TestSingleLinkage:
+    @pytest.mark.parametrize("linkage", ["knn", "pairwise"])
+    def test_matches_scipy_blobs(self, linkage):
+        rng = np.random.default_rng(11)
+        X = np.concatenate([
+            rng.normal(0, 0.3, (20, 3)),
+            rng.normal(4, 0.3, (25, 3)),
+            rng.normal((8, 0, 0), 0.3, (15, 3)),
+        ]).astype(np.float32)
+        res = single_linkage(X, n_clusters=3, linkage=linkage)
+        Z = sch.linkage(X, method="single")
+        ref = sch.fcluster(Z, t=3, criterion="maxclust")
+        # identical partitions modulo label permutation
+        for lab in np.unique(res.labels):
+            members = ref[res.labels == lab]
+            assert (members == members[0]).all()
+        assert len(np.unique(res.labels)) == 3
+        # dendrogram deltas match scipy's merge heights
+        # f32 device distances vs scipy f64
+        np.testing.assert_allclose(res.deltas, Z[:, 2], rtol=1e-3, atol=1e-4)
+
+    def test_n_clusters_one(self):
+        rng = np.random.default_rng(5)
+        X = rng.random((12, 2)).astype(np.float32)
+        res = single_linkage(X, n_clusters=1)
+        assert (res.labels == 0).all()
+
+    def test_sizes_and_children_shape(self):
+        rng = np.random.default_rng(6)
+        X = rng.random((16, 2)).astype(np.float32)
+        res = single_linkage(X, n_clusters=2)
+        assert res.children.shape == (15, 2)
+        assert res.sizes[-1] == 16
