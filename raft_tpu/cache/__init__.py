@@ -1,0 +1,3 @@
+"""Set-associative vector cache (reference cpp/include/raft/cache/)."""
+
+from raft_tpu.cache.cache import VecCache  # noqa: F401

@@ -1,0 +1,123 @@
+"""LRU set-associative device cache for feature vectors.
+
+Reference: cache/cache_util.cuh — ``get_vecs``/``get_cache_idx`` (:45),
+``store_vecs`` (:86), ``rank_set_entries`` (:205), ``assign_cache_idx``
+(:259) and the owning ``cache`` class (cache/cache.cuh).  The reference
+keeps an (n_vec × cache_size) column-major buffer, maps key → set =
+key % n_sets, and evicts the least-recently-used way per set.
+
+TPU design: the cache is a small pytree of device arrays (vectors, keys,
+timestamps); lookup is a vectorized equality scan over the key table (sets
+× ways is small), and eviction is an argmin over per-way timestamps — all
+branch-free gathers/scatters, jit-friendly.  State is carried functionally
+(each op returns the new cache), matching JAX's update-in-place donation
+model rather than the reference's mutable buffers.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CacheState(NamedTuple):
+    vectors: jnp.ndarray   # (n_sets, associativity, n_dim)
+    keys: jnp.ndarray      # (n_sets, associativity) int32, -1 = empty
+    time: jnp.ndarray      # (n_sets, associativity) int32 LRU stamps
+    clock: jnp.ndarray     # () int32 global counter
+
+
+class VecCache:
+    """Functional set-associative vector cache (reference cache.cuh:40).
+
+    Parameters
+    ----------
+    n_dim: vector dimensionality.
+    n_vecs: cache capacity in vectors (rounded down to a multiple of
+        ``associativity``; the reference uses cache_size in MiB — callers
+        can convert).
+    associativity: ways per set (reference ``associativity`` = 32).
+    """
+
+    def __init__(self, n_dim: int, n_vecs: int, associativity: int = 32,
+                 dtype=jnp.float32):
+        self.n_dim = n_dim
+        self.assoc = min(associativity, max(n_vecs, 1))
+        self.n_sets = max(n_vecs // self.assoc, 1)
+        self.dtype = dtype
+
+    def init(self) -> CacheState:
+        return CacheState(
+            vectors=jnp.zeros((self.n_sets, self.assoc, self.n_dim),
+                              self.dtype),
+            keys=jnp.full((self.n_sets, self.assoc), -1, jnp.int32),
+            time=jnp.zeros((self.n_sets, self.assoc), jnp.int32),
+            clock=jnp.int32(0),
+        )
+
+    # ------------------------------------------------------------------ #
+    def get_vecs(self, state: CacheState, keys: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, CacheState]:
+        """Fetch vectors for ``keys`` (reference get_vecs, cache_util.cuh:45).
+
+        Returns (vectors (m, n_dim), found (m,) bool, state with refreshed
+        LRU stamps).  Missing keys return zero vectors.
+        """
+        sets = (keys % self.n_sets).astype(jnp.int32)
+        set_keys = state.keys[sets]                      # (m, assoc)
+        hit = set_keys == keys[:, None].astype(jnp.int32)
+        way = jnp.argmax(hit, axis=1)
+        found = jnp.any(hit, axis=1)
+        vecs = state.vectors[sets, way]
+        vecs = jnp.where(found[:, None], vecs, 0)
+        # refresh LRU stamps of hits
+        new_clock = state.clock + 1
+        stamped = state.time.at[sets, way].max(
+            jnp.where(found, new_clock, 0))
+        return vecs, found, state._replace(time=stamped, clock=new_clock)
+
+    def store_vecs(self, state: CacheState, keys: jnp.ndarray,
+                   vecs: jnp.ndarray) -> CacheState:
+        """Insert vectors (reference assign_cache_idx + store_vecs,
+        cache_util.cuh:259,86): keys mapping to the same set within one
+        call take successive least-recently-used ways (the
+        ``rank_set_entries`` ranking, :205); an existing key updates its
+        own way.  Duplicate *keys* in one call: last write wins.
+        """
+        m = keys.shape[0]
+        sets = (keys % self.n_sets).astype(jnp.int32)
+        set_keys = state.keys[sets]
+        hit = set_keys == keys[:, None].astype(jnp.int32)
+        # rank of each key within its set group for this call
+        order = jnp.argsort(sets, stable=True)
+        sorted_sets = sets[order]
+        pos = jnp.arange(m, dtype=jnp.int32)
+        first = jnp.concatenate([jnp.array([True]),
+                                 sorted_sets[1:] != sorted_sets[:-1]])
+        group_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(first, pos, 0))
+        rank_sorted = pos - group_start
+        rank = jnp.zeros((m,), jnp.int32).at[order].set(rank_sorted)
+        # ways of each set ordered least-recently-used first; ways already
+        # claimed by hit keys in this call are marked most-recent so a new
+        # key can never collide with (or evict) an entry refreshed by the
+        # same store_vecs call
+        any_hit = jnp.any(hit, axis=1)
+        hit_way = jnp.argmax(hit, axis=1).astype(jnp.int32)
+        big = jnp.iinfo(jnp.int32).max
+        time_adj = state.time.at[sets, hit_way].max(
+            jnp.where(any_hit, big, -1))
+        lru_order = jnp.argsort(time_adj[sets], axis=1)
+        lru_way = jnp.take_along_axis(
+            lru_order, (rank % self.assoc)[:, None], axis=1)[:, 0]
+        way = jnp.where(jnp.any(hit, axis=1), jnp.argmax(hit, axis=1),
+                        lru_way).astype(jnp.int32)
+        new_clock = state.clock + 1
+        return CacheState(
+            vectors=state.vectors.at[sets, way].set(vecs.astype(self.dtype)),
+            keys=state.keys.at[sets, way].set(keys.astype(jnp.int32)),
+            time=state.time.at[sets, way].set(new_clock),
+            clock=new_clock,
+        )

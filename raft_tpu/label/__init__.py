@@ -1,0 +1,6 @@
+"""Label utilities (reference cpp/include/raft/label/)."""
+
+from raft_tpu.label.classlabels import (  # noqa: F401
+    get_unique_labels, make_monotonic, get_ovr_labels,
+)
+from raft_tpu.label.merge_labels import merge_labels  # noqa: F401

@@ -1,0 +1,65 @@
+"""Class-label utilities: unique labels, monotonic relabeling, one-vs-rest.
+
+Reference: label/classlabels.cuh — ``getUniquelabels`` (:40),
+``getOvrlabels`` (:99, map class idx → +1/-1), ``make_monotonic``
+(:159,192, relabel into a monotonically increasing set via the sorted
+unique array; values hit by ``filter_op`` pass through unchanged).
+
+TPU design: uniqueness via sort + first-occurrence mask (static capacity:
+the output is padded to ``max_labels``); the relabel map is one
+``searchsorted``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def get_unique_labels(labels: jnp.ndarray, max_labels: Optional[int] = None
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sorted unique labels, padded to ``max_labels`` (default: len(labels)).
+
+    Returns (unique (max_labels,), n_unique); padding slots repeat the
+    largest label (harmless for searchsorted-based mapping).
+    Reference: getUniquelabels (classlabels.cuh:40).
+    """
+    n = labels.shape[0]
+    cap = max_labels if max_labels is not None else n
+    s = jnp.sort(labels)
+    first = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
+    n_unique = jnp.sum(first.astype(jnp.int32))
+    # compact unique values to the front
+    order = jnp.argsort(~first, stable=True)
+    uniq = s[order][:cap]
+    idx = jnp.arange(cap)
+    uniq = jnp.where(idx < n_unique, uniq, s[-1])
+    return uniq, n_unique
+
+
+def make_monotonic(labels: jnp.ndarray,
+                   zero_based: bool = False,
+                   filter_op: Optional[Callable] = None,
+                   max_labels: Optional[int] = None) -> jnp.ndarray:
+    """Relabel into a monotonically increasing set (reference
+    make_monotonic, classlabels.cuh:159).
+
+    Each label becomes its rank in the sorted unique set (+1 unless
+    ``zero_based``); entries where ``filter_op(label)`` is True keep their
+    original value (the reference's noise-label passthrough).
+    """
+    uniq, n_unique = get_unique_labels(labels, max_labels)
+    ranks = jnp.searchsorted(uniq[: uniq.shape[0]], labels).astype(labels.dtype)
+    out = ranks if zero_based else ranks + 1
+    if filter_op is not None:
+        out = jnp.where(filter_op(labels), labels, out)
+    return out
+
+
+def get_ovr_labels(labels: jnp.ndarray, unique_labels: jnp.ndarray,
+                   idx: int) -> jnp.ndarray:
+    """One-vs-rest ±1 labels for class ``idx`` (reference getOvrlabels,
+    classlabels.cuh:99)."""
+    target = unique_labels[idx]
+    return jnp.where(labels == target, 1, -1).astype(labels.dtype)

@@ -1,0 +1,53 @@
+"""Merge two labellings connected through masked points.
+
+Reference: label/merge_labels.cuh:115 — builds the label-equivalence graph
+G with edges (labels_a[k], labels_b[k]) for masked k, finds its connected
+components by iterated min-propagation, and reassigns each point's label
+to its component representative (R relabel table).  Labels are 1-based
+(weak_cc convention); used to merge per-batch weak-CC results.
+
+TPU design: the reference's atomicMin propagation loop becomes segment-min
+over the edge list inside ``lax.while_loop`` — same fixpoint, no atomics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def merge_labels(labels_a: jnp.ndarray, labels_b: jnp.ndarray,
+                 mask: jnp.ndarray) -> jnp.ndarray:
+    """Merged labels (1-based): components of the equivalence graph take
+    their minimum member label.  Shapes: all (N,)."""
+    N = labels_a.shape[0]
+    big = jnp.iinfo(jnp.int32).max
+    la = labels_a.astype(jnp.int32)
+    lb = labels_b.astype(jnp.int32)
+
+    # R[l-1] = representative (minimum) label of l's equivalence class
+    R0 = jnp.arange(1, N + 1, dtype=jnp.int32)
+
+    a_idx = jnp.where(mask, la - 1, 0)
+    b_idx = jnp.where(mask, lb - 1, 0)
+
+    def relax(R):
+        ra, rb = R[a_idx], R[b_idx]
+        m = jnp.minimum(ra, rb)
+        upd_a = jax.ops.segment_min(jnp.where(mask, m, big), a_idx,
+                                    num_segments=N)
+        upd_b = jax.ops.segment_min(jnp.where(mask, m, big), b_idx,
+                                    num_segments=N)
+        R = jnp.minimum(R, jnp.minimum(upd_a, upd_b))
+        return jnp.minimum(R, R[R - 1])  # pointer jump
+
+    def cond(state):
+        R, prev = state
+        return jnp.any(R != prev)
+
+    def body(state):
+        R, _ = state
+        return relax(R), R
+
+    R, _ = jax.lax.while_loop(cond, body, (relax(R0), R0))
+    return R[la - 1]

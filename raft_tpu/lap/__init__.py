@@ -1,0 +1,3 @@
+"""Linear Assignment Problem solver (reference cpp/include/raft/lap/)."""
+
+from raft_tpu.lap.lap import LinearAssignmentProblem, solve_lap  # noqa: F401

@@ -1,0 +1,157 @@
+"""Linear Assignment Problem: min-cost perfect matching on a cost matrix.
+
+Reference: lap/lap.cuh:37 ``LinearAssignmentProblem`` — the Date–Nagi GPU
+Hungarian variant (state machine steps 0-6, :89-108; kernels in
+lap/lap_functions.cuh / lap_kernels.cuh), solving a batch of SP×N×N
+problems.
+
+TPU design: the Hungarian algorithm's augmenting-path machinery is
+pointer-chasing — hostile to XLA.  The **auction algorithm** (Bertsekas)
+computes the same optimal assignment through dense, vectorizable bidding
+rounds: every unassigned row bids for its best column (two-min reduction
+over a row — one (n, n) matrix op per round), prices rise, ε-scaling
+guarantees optimality for integer-scaled costs.  Batches vmap.  This keeps
+the whole solve inside one ``lax.while_loop`` of MXU/VPU-shaped ops.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class LapResult(NamedTuple):
+    row_assignment: jnp.ndarray  # (n,) col assigned to each row, -1 if none
+    col_assignment: jnp.ndarray  # (n,) row assigned to each col, -1 if none
+    obj_val: jnp.ndarray         # primal objective; inf when incomplete
+    row_duals: jnp.ndarray       # (n,)
+    col_duals: jnp.ndarray       # (n,) auction prices
+    complete: jnp.ndarray        # bool: every row assigned (False only if
+                                 # the round cap truncated the auction)
+
+
+def _auction_round(cost, eps, state):
+    """One synchronous bidding round (Gauss-Seidel-free, all rows bid)."""
+    row_of_col, price = state
+    assigned_col_of_row = _col_to_row_view(row_of_col, cost.shape[0])
+    unassigned = assigned_col_of_row < 0  # (n,) rows with no column
+
+    value = -(cost + price[None, :])  # row i's value for col j (maximize)
+    best_j = jnp.argmax(value, axis=1)
+    best_v = jnp.take_along_axis(value, best_j[:, None], axis=1)[:, 0]
+    masked = value.at[jnp.arange(cost.shape[0]), best_j].set(-jnp.inf)
+    second_v = jnp.max(masked, axis=1)
+    second_v = jnp.where(jnp.isfinite(second_v), second_v, best_v - eps)
+    bid = best_v - second_v + eps  # > 0
+
+    # per column: take the highest bid among unassigned rows
+    n = cost.shape[0]
+    bid_masked = jnp.where(unassigned, bid, -jnp.inf)
+    col_best_bid = jax.ops.segment_max(bid_masked, best_j, num_segments=n)
+    has_bid = jnp.isfinite(col_best_bid) & (col_best_bid > -jnp.inf)
+    # winning row per column: among rows bidding that column with the top
+    # bid, pick the smallest row id (deterministic)
+    is_winner = unassigned & (bid_masked == col_best_bid[best_j])
+    row_ids = jnp.where(is_winner, jnp.arange(n), n)
+    win_row = jax.ops.segment_min(row_ids, best_j, num_segments=n)
+    newly = (win_row < n) & has_bid
+
+    # displace previous owner of the column, update price
+    row_of_col = jnp.where(newly, win_row, row_of_col)
+    price = jnp.where(newly, price + col_best_bid, price)
+    return row_of_col.astype(jnp.int32), price
+
+
+def _col_to_row_view(row_of_col, n):
+    """(n,) col assigned to each row, -1 if none."""
+    out = jnp.full((n,), -1, jnp.int32)
+    cols = jnp.arange(n, dtype=jnp.int32)
+    valid = row_of_col >= 0
+    return out.at[jnp.where(valid, row_of_col, 0)].max(
+        jnp.where(valid, cols, -1))
+
+
+@functools.partial(jax.jit, static_argnames=("max_rounds",))
+def _solve_one(cost: jnp.ndarray, max_rounds: int = 0):
+    n = cost.shape[0]
+    spread = jnp.maximum(jnp.max(cost) - jnp.min(cost), 1.0)
+    # ε-scaling: auction is n·ε-suboptimal, so the last phase must run at
+    # ε small against the cost resolution; for f32 costs a 1e-6·spread
+    # floor leaves n·ε far below any meaningful objective gap
+    eps0 = spread / 2.0
+    eps_min = spread * 1e-6
+    cap = max_rounds if max_rounds else 200 * n + 2000
+
+    def phase_cond(state):
+        row_of_col, price, eps, rounds = state
+        return (eps >= eps_min * 0.99) & (rounds < cap)
+
+    def phase_body(state):
+        row_of_col, price, eps, rounds = state
+        # run bidding until complete at this ε
+        def cond(s):
+            roc, _, r = s
+            assigned = jnp.sum((roc >= 0).astype(jnp.int32))
+            return (assigned < n) & (r < cap)
+
+        def body(s):
+            roc, pr, r = s
+            roc, pr = _auction_round(cost, eps, (roc, pr))
+            return roc, pr, r + 1
+
+        row_of_col = jnp.full((n,), -1, jnp.int32)  # restart assignment
+        row_of_col, price, rounds = jax.lax.while_loop(
+            cond, body, (row_of_col, price, rounds))
+        return row_of_col, price, eps / 5.0, rounds
+
+    state0 = (jnp.full((n,), -1, jnp.int32), jnp.zeros((n,), cost.dtype),
+              jnp.asarray(eps0, cost.dtype), jnp.int32(0))
+    row_of_col, price, _, _ = jax.lax.while_loop(
+        phase_cond, phase_body, state0)
+
+    col_of_row = _col_to_row_view(row_of_col, n)
+    complete = jnp.all(col_of_row >= 0)
+    safe = jnp.where(col_of_row >= 0, col_of_row, 0)
+    obj = jnp.sum(jnp.take_along_axis(cost, safe[:, None], axis=1)[:, 0])
+    obj = jnp.where(complete, obj, jnp.inf)
+    # duals: col dual = -price; row dual = min_j (cost - col dual)
+    v = -price
+    u = jnp.min(cost - v[None, :], axis=1)
+    return col_of_row, row_of_col, obj, u, v, complete
+
+
+def solve_lap(cost: jnp.ndarray, max_rounds: int = 0) -> LapResult:
+    """Solve min-cost assignment for a square cost matrix (n, n).
+
+    Returns optimal (for ε-scaled auction, optimal when costs are
+    well-scaled floats) assignments both ways, objective, and dual prices
+    (reference ``LinearAssignmentProblem::solve`` + getters, lap.cuh:89-160).
+    """
+    cost = jnp.asarray(cost)
+    expects(cost.ndim == 2 and cost.shape[0] == cost.shape[1],
+            "solve_lap: square cost matrix required")
+    return LapResult(*_solve_one(cost, max_rounds=max_rounds))
+
+
+class LinearAssignmentProblem:
+    """Batch LAP solver facade (reference lap/lap.cuh:37 — SP subproblems).
+
+    ``solve(costs)`` accepts (batch, n, n) or (n, n).
+    """
+
+    def __init__(self, max_rounds: int = 0):
+        self.max_rounds = max_rounds
+
+    def solve(self, costs: jnp.ndarray) -> LapResult:
+        costs = jnp.asarray(costs)
+        if costs.ndim == 2:
+            return solve_lap(costs, self.max_rounds)
+        expects(costs.ndim == 3, "LinearAssignmentProblem: (SP, N, N) costs")
+        solve = jax.vmap(lambda c: _solve_one(c, max_rounds=self.max_rounds))
+        return LapResult(*solve(costs))

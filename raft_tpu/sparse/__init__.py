@@ -15,3 +15,4 @@ from raft_tpu.sparse.formats import COO, CSR  # noqa: F401
 from raft_tpu.sparse import convert, op, linalg  # noqa: F401
 from raft_tpu.sparse import distance, selection  # noqa: F401
 from raft_tpu.sparse import mst, linkage, hierarchy  # noqa: F401
+from raft_tpu.sparse import spectral  # noqa: F401

@@ -42,15 +42,23 @@ class GraphCOO(NamedTuple):
 
 
 def _pointer_jump(parent: jnp.ndarray) -> jnp.ndarray:
-    """Compress parent pointers to roots (label_prop analog)."""
+    """Compress parent pointers to roots (label_prop analog).
 
-    def cond(p):
-        return jnp.any(p[p] != p)
+    Bounded to ⌈log2(V)⌉+2 doublings: enough for any forest (valid —
+    i.e. symmetric — input yields a forest after 2-cycle breaking), and a
+    hard stop rather than a device hang if a caller feeds an asymmetric
+    adjacency whose choice pointers contain a longer cycle; unresolved
+    pointers are then cut to self, so the solve degrades to a forest
+    instead of spinning.
+    """
+    V = parent.shape[0]
+    jumps = max(int(V - 1).bit_length(), 1) + 2
 
-    def body(p):
+    def body(_, p):
         return p[p]
 
-    return jax.lax.while_loop(cond, body, parent)
+    p = jax.lax.fori_loop(0, jumps, body, parent)
+    return jnp.where(p[p] == p, p, jnp.arange(V, dtype=parent.dtype))
 
 
 def mst(csr: CSR,
@@ -67,8 +75,10 @@ def mst(csr: CSR,
         ``initialize_colors_`` = false in detail/mst.cuh:95-104); defaults
         to ``arange(V)``.
     max_iterations:
-        Safety cap on Borůvka rounds (0 = until convergence, like the
-        reference's ``iterations_`` default).
+        Safety cap on Borůvka rounds; 0 picks 2·⌈log2(V)⌉+4 — more than
+        any valid (symmetric) input needs, and a guaranteed stop on
+        malformed (asymmetric) input, which the reference would require
+        the caller to have symmetrized anyway (mst.cuh docs).
 
     Returns
     -------
@@ -136,12 +146,12 @@ def mst(csr: CSR,
         color = parent[color]
         return color, in_mst, it + 1, jnp.any(cross)
 
+    cap = max_iterations if max_iterations else \
+        2 * max(int(V - 1).bit_length(), 1) + 4
+
     def cond(state):
         _, _, it, progressed = state
-        keep = progressed
-        if max_iterations:
-            keep = keep & (it < max_iterations)
-        return keep
+        return progressed & (it < cap)
 
     state0 = (colors0, jnp.zeros((E,), bool), jnp.int32(0), jnp.bool_(True))
     color, in_mst, _, _ = jax.lax.while_loop(cond, round_, state0)

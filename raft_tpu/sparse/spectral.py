@@ -1,0 +1,38 @@
+"""Spectral embedding of a sparse graph.
+
+Reference: sparse/linalg/spectral.hpp:25 ``fit_embedding`` →
+detail/spectral.cuh:33-80: COO → CSR → Laplacian → (n_components+1)
+smallest eigenvectors via Lanczos (no-op cluster solver) → drop the
+trivial constant eigenvector → embedding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse import convert
+from raft_tpu.sparse.formats import COO
+from raft_tpu.spectral.eigen_solvers import EigenSolverConfig, LanczosSolver
+from raft_tpu.spectral.matrix_wrappers import LaplacianMatrix
+from raft_tpu.spectral.spectral_util import transform_eigen_matrix
+
+
+def fit_embedding(coo: COO, n_components: int,
+                  seed: int = 1234567, maxiter: int = 4000,
+                  tol: float = 0.01) -> jnp.ndarray:
+    """(n, n_components) spectral embedding of a symmetric COO graph.
+
+    Solver configuration mirrors the reference's cuGraph-derived defaults
+    (detail/spectral.cuh:68-74: maxiter=4000, tol=0.01,
+    restart_iter=15+neigvs).
+    """
+    n = coo.n_rows
+    neigvs = n_components + 1
+    csr = convert.coo_to_csr(coo)
+    L = LaplacianMatrix(csr)
+    solver = LanczosSolver(EigenSolverConfig(
+        n_eig_vecs=neigvs, max_iter=maxiter,
+        restart_iter=15 + neigvs, tol=tol, seed=seed))
+    _, vecs, _ = solver.solve_smallest_eigenvectors(L, n)
+    emb = transform_eigen_matrix(vecs)
+    return emb[:, 1:]

@@ -1,0 +1,20 @@
+"""Spectral graph analysis: implicit operators, eigen/cluster solvers,
+graph partitioning, modularity maximization.
+
+Reference: cpp/include/raft/spectral/ (2,794 LoC) — see SURVEY.md §2.7.
+"""
+
+from raft_tpu.spectral.matrix_wrappers import (  # noqa: F401
+    SparseMatrix, LaplacianMatrix, ModularityMatrix,
+)
+from raft_tpu.spectral.eigen_solvers import (  # noqa: F401
+    EigenSolverConfig, LanczosSolver,
+)
+from raft_tpu.spectral.kmeans import kmeans  # noqa: F401
+from raft_tpu.spectral.cluster_solvers import (  # noqa: F401
+    ClusterSolverConfig, KmeansSolver,
+)
+from raft_tpu.spectral.partition import partition, analyze_partition  # noqa: F401
+from raft_tpu.spectral.modularity_maximization import (  # noqa: F401
+    modularity_maximization, analyze_modularity,
+)

@@ -1,0 +1,38 @@
+"""Cluster solver facade (reference spectral/cluster_solvers.hpp).
+
+``cluster_solver_config_t`` (:28) + ``kmeans_solver_t`` (:38) — the
+pluggable clustering stage of spectral partition/modularity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.spectral.kmeans import kmeans
+
+
+@dataclass
+class ClusterSolverConfig:
+    """(reference cluster_solver_config_t, cluster_solvers.hpp:28)"""
+
+    n_clusters: int
+    max_iter: int = 300
+    tol: float = 1e-4
+    seed: int = 123456
+
+
+class KmeansSolver:
+    """(reference kmeans_solver_t, cluster_solvers.hpp:38)"""
+
+    def __init__(self, config: ClusterSolverConfig):
+        self.config = config
+
+    def solve(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Cluster rows of obs; returns (labels, residual, iters)."""
+        c = self.config
+        res = kmeans(obs, c.n_clusters, tol=c.tol, max_iter=c.max_iter,
+                     seed=c.seed)
+        return res.labels, res.residual, res.iters

@@ -1,0 +1,52 @@
+"""Eigen solver facade over the thick-restart Lanczos driver.
+
+Reference: spectral/eigen_solvers.hpp — ``eigen_solver_config_t`` (:27),
+``lanczos_solver_t`` (:42) delegating to linalg/lanczos.hpp.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.linalg.lanczos import (
+    compute_largest_eigenvectors,
+    compute_smallest_eigenvectors,
+)
+
+
+@dataclass
+class EigenSolverConfig:
+    """(reference eigen_solver_config_t, eigen_solvers.hpp:27)"""
+
+    n_eig_vecs: int
+    max_iter: int = 4000
+    restart_iter: int = 0
+    tol: float = 1e-9
+    reorthogonalize: bool = True  # thick-restart driver always does
+    seed: int = 1234567
+
+
+class LanczosSolver:
+    """(reference lanczos_solver_t, eigen_solvers.hpp:42)"""
+
+    def __init__(self, config: EigenSolverConfig):
+        self.config = config
+
+    def solve_smallest_eigenvectors(self, op, n: int
+                                    ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+        c = self.config
+        mv = op.mv if hasattr(op, "mv") else op
+        return compute_smallest_eigenvectors(
+            mv, n, c.n_eig_vecs, maxiter=c.max_iter,
+            restart_iter=c.restart_iter, tol=c.tol, seed=c.seed)
+
+    def solve_largest_eigenvectors(self, op, n: int
+                                   ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+        c = self.config
+        mv = op.mv if hasattr(op, "mv") else op
+        return compute_largest_eigenvectors(
+            mv, n, c.n_eig_vecs, maxiter=c.max_iter,
+            restart_iter=c.restart_iter, tol=c.tol, seed=c.seed)

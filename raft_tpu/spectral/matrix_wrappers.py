@@ -1,0 +1,75 @@
+"""Implicit matrix operators for spectral methods.
+
+Reference: spectral/matrix_wrappers.hpp — ``sparse_matrix_t`` with cuSPARSE
+``mv()`` (:126,180), ``laplacian_matrix_t`` (D−A as an implicit operator,
+:300), ``modularity_matrix_t`` (A − d dᵀ/2E, :372).
+
+TPU design: operators are lightweight pytrees exposing ``mv(x)``; the SpMV
+is the gather + segment-sum kernel (sparse/linalg.py), and the Laplacian /
+modularity corrections are rank-1 vector updates fused by XLA.  Everything
+stays functional so an operator can be closed over inside ``jit`` (the
+Lanczos driver takes ``mv`` as a callable).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.formats import CSR
+from raft_tpu.sparse.linalg import csr_spmv
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseMatrix:
+    """CSR operator with ``mv`` (reference sparse_matrix_t, :126)."""
+
+    def __init__(self, csr: CSR):
+        self.csr = csr
+
+    def tree_flatten(self):
+        return (self.csr,), ()
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    @property
+    def n_rows(self) -> int:
+        return self.csr.n_rows
+
+    def mv(self, x: jnp.ndarray) -> jnp.ndarray:
+        return csr_spmv(self.csr, x)
+
+
+@jax.tree_util.register_pytree_node_class
+class LaplacianMatrix(SparseMatrix):
+    """Implicit graph Laplacian L = D − A (reference laplacian_matrix_t,
+    :300); ``diagonal`` is the weighted degree vector."""
+
+    def __init__(self, csr: CSR, diagonal: jnp.ndarray | None = None):
+        super().__init__(csr)
+        if diagonal is None:
+            ones = jnp.ones((csr.n_cols,), dtype=csr.data.dtype)
+            diagonal = csr_spmv(csr, ones)
+        self.diagonal = diagonal
+
+    def tree_flatten(self):
+        return (self.csr, self.diagonal), ()
+
+    def mv(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.diagonal * x - csr_spmv(self.csr, x)
+
+
+@jax.tree_util.register_pytree_node_class
+class ModularityMatrix(LaplacianMatrix):
+    """Implicit modularity matrix B = A − d dᵀ / (2E) (reference
+    modularity_matrix_t, :372); ``edge_sum`` = ‖d‖₁ = 2E (:382)."""
+
+    def __init__(self, csr: CSR, diagonal: jnp.ndarray | None = None):
+        super().__init__(csr, diagonal)
+        self.edge_sum = jnp.sum(jnp.abs(self.diagonal))
+
+    def mv(self, x: jnp.ndarray) -> jnp.ndarray:
+        d = self.diagonal
+        return csr_spmv(self.csr, x) - d * (jnp.dot(d, x) / self.edge_sum)

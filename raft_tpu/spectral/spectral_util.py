@@ -1,0 +1,39 @@
+"""Spectral utilities: eigenvector whitening, cluster indicators.
+
+Reference: spectral/spectral_util.hpp — ``transform_eigen_matrix`` (:109,
+per-column mean-center + scale to std·√n = 1) and ``construct_indicator``
+(:44, normalized cluster indicator vector + quadratic form).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def transform_eigen_matrix(eig_vecs: jnp.ndarray) -> jnp.ndarray:
+    """Whiten eigenvector columns: subtract the column mean, divide by
+    (column norm / √n) (reference transform_eigen_matrix,
+    spectral_util.hpp:118-145; the trailing transpose is a cuBLAS layout
+    detail we don't need)."""
+    n = eig_vecs.shape[0]
+    centered = eig_vecs - jnp.mean(eig_vecs, axis=0, keepdims=True)
+    norms = jnp.linalg.norm(centered, axis=0, keepdims=True)
+    scale = norms / jnp.sqrt(jnp.asarray(n, eig_vecs.dtype))
+    return centered / jnp.where(scale == 0, 1.0, scale)
+
+
+def construct_indicator(cluster_id: int, labels: jnp.ndarray, op
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """0/1 indicator x_c of one cluster + its quadratic form xᵀ(op)x
+    (reference construct_indicator, spectral_util.hpp:195-225 — the
+    indicator is *unnormalized*; partStats = part_iᵀ B part_i).
+
+    Returns (cluster_size, quad_form, valid) — valid False for an empty
+    cluster (the reference returns false and warns).
+    """
+    part = (labels == cluster_id).astype(jnp.float32)
+    size = jnp.sum(part)
+    quad = jnp.dot(part, op.mv(part))
+    return size, quad, size > 0

@@ -1,0 +1,131 @@
+"""Label utilities, vector cache, and LAP solver tests.
+
+Mirrors cpp/test/label/label.cu, cpp/test/label/merge_labels.cu,
+cpp/test/cache/*.cu, cpp/test/lap/lap.cu (vs scipy ground truth).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from raft_tpu.cache import VecCache
+from raft_tpu.label import (
+    get_ovr_labels,
+    get_unique_labels,
+    make_monotonic,
+    merge_labels,
+)
+from raft_tpu.lap import LinearAssignmentProblem, solve_lap
+
+
+class TestLabels:
+    def test_unique(self):
+        labels = jnp.asarray([5, 3, 5, 9, 3, 3], jnp.int32)
+        uniq, n = get_unique_labels(labels)
+        assert int(n) == 3
+        np.testing.assert_array_equal(np.asarray(uniq)[:3], [3, 5, 9])
+
+    def test_make_monotonic(self):
+        labels = jnp.asarray([10, 20, 10, 30], jnp.int32)
+        out = np.asarray(make_monotonic(labels))
+        np.testing.assert_array_equal(out, [1, 2, 1, 3])
+        out0 = np.asarray(make_monotonic(labels, zero_based=True))
+        np.testing.assert_array_equal(out0, [0, 1, 0, 2])
+
+    def test_make_monotonic_filter(self):
+        labels = jnp.asarray([-1, 7, 7, 2], jnp.int32)
+        out = np.asarray(make_monotonic(
+            labels, zero_based=True, filter_op=lambda v: v == -1))
+        assert out[0] == -1
+        # remaining labels relabeled by rank in unique {-1, 2, 7}
+        assert out[3] < out[1] and out[1] == out[2]
+
+    def test_ovr(self):
+        labels = jnp.asarray([1, 2, 1, 3], jnp.int32)
+        uniq, _ = get_unique_labels(labels)
+        out = np.asarray(get_ovr_labels(labels, uniq, 0))
+        np.testing.assert_array_equal(out, [1, -1, 1, -1])
+
+    def test_merge_labels(self):
+        # batch A says {1,1,3,3,5}; batch B says {1,2,2,4,4}; masked points
+        # connect label groups: expect min-label components
+        la = jnp.asarray([1, 1, 3, 3, 5], jnp.int32)
+        lb = jnp.asarray([1, 2, 2, 4, 4], jnp.int32)
+        mask = jnp.asarray([True, True, True, False, False])
+        out = np.asarray(merge_labels(la, lb, mask))
+        # groups {1,2,3} merge into 1; 5 stays (mask False on its links)
+        np.testing.assert_array_equal(out, [1, 1, 1, 1, 5])
+
+
+class TestCache:
+    def test_store_and_get(self):
+        rng = np.random.default_rng(0)
+        cache = VecCache(n_dim=4, n_vecs=16, associativity=4)
+        st = cache.init()
+        keys = jnp.asarray([3, 7, 11], jnp.int32)
+        vecs = jnp.asarray(rng.random((3, 4)), jnp.float32)
+        st = cache.store_vecs(st, keys, vecs)
+        got, found, st = cache.get_vecs(st, keys)
+        assert bool(found.all())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(vecs))
+        _, found2, _ = cache.get_vecs(st, jnp.asarray([99], jnp.int32))
+        assert not bool(found2.any())
+
+    def test_lru_eviction(self):
+        cache = VecCache(n_dim=2, n_vecs=4, associativity=2)  # 2 sets × 2
+        st = cache.init()
+        # keys 0, 2, 4 all map to set 0; capacity 2 → oldest evicted
+        for k in [0, 2]:
+            st = cache.store_vecs(st, jnp.asarray([k], jnp.int32),
+                                  jnp.full((1, 2), float(k), jnp.float32))
+        _, f, st = cache.get_vecs(st, jnp.asarray([0], jnp.int32))  # touch 0
+        st = cache.store_vecs(st, jnp.asarray([4], jnp.int32),
+                              jnp.full((1, 2), 4.0, jnp.float32))
+        _, f0, st = cache.get_vecs(st, jnp.asarray([0], jnp.int32))
+        _, f2, st = cache.get_vecs(st, jnp.asarray([2], jnp.int32))
+        assert bool(f0.all())        # recently used → kept
+        assert not bool(f2.any())    # LRU → evicted
+
+    def test_update_existing(self):
+        cache = VecCache(n_dim=2, n_vecs=8, associativity=2)
+        st = cache.init()
+        st = cache.store_vecs(st, jnp.asarray([5], jnp.int32),
+                              jnp.ones((1, 2), jnp.float32))
+        st = cache.store_vecs(st, jnp.asarray([5], jnp.int32),
+                              2 * jnp.ones((1, 2), jnp.float32))
+        got, found, _ = cache.get_vecs(st, jnp.asarray([5], jnp.int32))
+        assert bool(found.all())
+        np.testing.assert_allclose(np.asarray(got), 2.0)
+
+
+class TestLAP:
+    @pytest.mark.parametrize("n", [4, 16, 48])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_matches_scipy(self, n, seed):
+        rng = np.random.default_rng(seed)
+        cost = rng.random((n, n)).astype(np.float32)
+        res = solve_lap(jnp.asarray(cost))
+        rows, cols = linear_sum_assignment(cost)
+        ref_obj = cost[rows, cols].sum()
+        got = np.asarray(res.row_assignment)
+        assert sorted(got) == list(range(n)), "not a permutation"
+        np.testing.assert_allclose(float(res.obj_val), ref_obj,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_known(self):
+        cost = jnp.asarray([[4.0, 1, 3], [2, 0, 5], [3, 2, 2]])
+        res = solve_lap(cost)
+        np.testing.assert_array_equal(np.asarray(res.row_assignment),
+                                      [1, 0, 2])
+        assert float(res.obj_val) == 5.0
+
+    def test_batched(self):
+        rng = np.random.default_rng(2)
+        costs = rng.random((3, 8, 8)).astype(np.float32)
+        res = LinearAssignmentProblem().solve(jnp.asarray(costs))
+        for b in range(3):
+            r, c = linear_sum_assignment(costs[b])
+            np.testing.assert_allclose(float(res.obj_val[b]),
+                                       costs[b][r, c].sum(),
+                                       rtol=1e-4, atol=1e-4)

@@ -1,0 +1,158 @@
+"""Spectral module tests: operators vs dense numpy, k-means quality,
+partition/modularity on planted graphs.
+
+Mirrors cpp/test/eigen_solvers.cu (eigenvalue assertions),
+cpp/test/cluster_solvers.cu (k-means cost sanity), cpp/test/spectral_matrix.cu.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from raft_tpu.sparse import COO, CSR
+from raft_tpu.sparse.spectral import fit_embedding
+from raft_tpu.spectral import (
+    ClusterSolverConfig,
+    EigenSolverConfig,
+    KmeansSolver,
+    LanczosSolver,
+    LaplacianMatrix,
+    ModularityMatrix,
+    SparseMatrix,
+    analyze_modularity,
+    analyze_partition,
+    kmeans,
+    modularity_maximization,
+    partition,
+)
+
+
+def planted_two_blocks(rng, n_per=15, p_in=0.7, p_out=0.05):
+    n = 2 * n_per
+    adj = np.zeros((n, n), np.float32)
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i < n_per) == (j < n_per)
+            p = p_in if same else p_out
+            if rng.random() < p:
+                adj[i, j] = adj[j, i] = 1.0
+    return adj
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(17)
+
+
+class TestOperators:
+    def test_sparse_mv(self, rng):
+        d = (rng.random((12, 12)) * (rng.random((12, 12)) < 0.4)).astype(np.float32)
+        x = rng.random(12).astype(np.float32)
+        got = SparseMatrix(CSR.from_dense(d, capacity=80)).mv(x)
+        np.testing.assert_allclose(np.asarray(got), d @ x, rtol=1e-5)
+
+    def test_laplacian_mv(self, rng):
+        adj = planted_two_blocks(rng, 8)
+        L_ref = np.diag(adj.sum(1)) - adj
+        x = rng.random(16).astype(np.float32)
+        L = LaplacianMatrix(CSR.from_dense(adj))
+        np.testing.assert_allclose(np.asarray(L.mv(x)), L_ref @ x, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(L.diagonal), adj.sum(1), rtol=1e-6)
+
+    def test_modularity_mv(self, rng):
+        adj = planted_two_blocks(rng, 8)
+        d = adj.sum(1)
+        B_ref = adj - np.outer(d, d) / d.sum()
+        x = rng.random(16).astype(np.float32)
+        B = ModularityMatrix(CSR.from_dense(adj))
+        np.testing.assert_allclose(np.asarray(B.mv(x)), B_ref @ x, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestKmeans:
+    def test_blobs(self, rng):
+        X = np.concatenate([
+            rng.normal(0, 0.2, (30, 2)),
+            rng.normal(3, 0.2, (30, 2)),
+            rng.normal((6, 0), 0.2, (30, 2)),
+        ]).astype(np.float32)
+        res = kmeans(X, 3, seed=7)
+        # perfect separation: each blob uniform label
+        for s in range(0, 90, 30):
+            blob = np.asarray(res.labels[s:s + 30])
+            assert (blob == blob[0]).all()
+        assert float(res.residual) < 30 * 3 * 0.2 ** 2 * 4
+
+    def test_k_equals_n(self, rng):
+        X = rng.random((5, 2)).astype(np.float32)
+        res = kmeans(X, 5, seed=3)
+        assert len(np.unique(np.asarray(res.labels))) == 5
+        assert float(res.residual) < 1e-6
+
+    def test_solver_facade(self, rng):
+        X = rng.random((20, 3)).astype(np.float32)
+        labels, residual, iters = KmeansSolver(
+            ClusterSolverConfig(n_clusters=4)).solve(jnp.asarray(X))
+        assert labels.shape == (20,)
+        assert float(residual) >= 0
+
+
+class TestEigenSolver:
+    def test_laplacian_smallest(self, rng):
+        adj = planted_two_blocks(rng, 10)
+        L_ref = np.diag(adj.sum(1)) - adj
+        ref_vals = np.linalg.eigvalsh(L_ref)
+        L = LaplacianMatrix(CSR.from_dense(adj))
+        solver = LanczosSolver(EigenSolverConfig(n_eig_vecs=3, tol=1e-9))
+        vals, vecs, _ = solver.solve_smallest_eigenvectors(L, 20)
+        np.testing.assert_allclose(np.asarray(vals), ref_vals[:3], atol=1e-3)
+        assert vecs.shape == (20, 3)
+
+
+class TestPartition:
+    def test_two_blocks(self, rng):
+        adj = planted_two_blocks(rng)
+        res = partition(CSR.from_dense(adj), n_clusters=2)
+        labels = np.asarray(res.clusters)
+        # the two planted blocks separate
+        assert (labels[:15] == labels[0]).all()
+        assert (labels[15:] == labels[15]).all()
+        assert labels[0] != labels[15]
+
+        edge_cut, cost = analyze_partition(CSR.from_dense(adj), 2, res.clusters)
+        # cut of planted partition == cross-block edges
+        ref_cut = adj[:15, 15:].sum()
+        np.testing.assert_allclose(float(edge_cut), ref_cut, rtol=1e-4)
+        assert float(cost) > 0
+
+    def test_modularity_two_blocks(self, rng):
+        adj = planted_two_blocks(rng)
+        res = modularity_maximization(CSR.from_dense(adj), n_clusters=2)
+        labels = np.asarray(res.clusters)
+        assert (labels[:15] == labels[0]).all()
+        assert (labels[15:] == labels[15]).all()
+        assert labels[0] != labels[15]
+
+        q = analyze_modularity(CSR.from_dense(adj), 2, res.clusters)
+        # reference formula vs dense computation
+        d = adj.sum(1)
+        B_ref = adj - np.outer(d, d) / d.sum()
+        q_ref = sum(
+            (labels == c).astype(float) @ B_ref @ (labels == c).astype(float)
+            for c in range(2)) / d.sum()
+        np.testing.assert_allclose(float(q), q_ref, atol=1e-4)
+        assert float(q) > 0.2  # strong community structure
+
+
+class TestFitEmbedding:
+    def test_embedding_separates_components(self, rng):
+        adj = planted_two_blocks(rng, 12, p_in=0.8, p_out=0.02)
+        coo = COO.from_dense(adj)
+        emb = np.asarray(fit_embedding(coo, n_components=2))
+        assert emb.shape == (24, 2)
+        # fiedler coordinate separates the blocks
+        f = emb[:, 0]
+        assert (np.sign(f[:12]) == np.sign(f[0])).all() or \
+               (np.sign(f[12:]) == np.sign(f[12])).all()
