@@ -90,21 +90,25 @@ class VecCache:
         sets = (keys % self.n_sets).astype(jnp.int32)
         set_keys = state.keys[sets]
         hit = set_keys == keys[:, None].astype(jnp.int32)
-        # rank of each key within its set group for this call
+        # rank of each *miss* key within its set group for this call (hit
+        # keys use their own way and must not consume LRU slots)
+        any_hit_pre = jnp.any(hit, axis=1)
         order = jnp.argsort(sets, stable=True)
         sorted_sets = sets[order]
-        pos = jnp.arange(m, dtype=jnp.int32)
+        miss_sorted = (~any_hit_pre[order]).astype(jnp.int32)
         first = jnp.concatenate([jnp.array([True]),
                                  sorted_sets[1:] != sorted_sets[:-1]])
-        group_start = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(first, pos, 0))
-        rank_sorted = pos - group_start
+        incl = jnp.cumsum(miss_sorted)
+        # exclusive miss-count at each group start, propagated forward
+        base = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(first, incl - miss_sorted, 0))
+        rank_sorted = (incl - miss_sorted - base).astype(jnp.int32)
         rank = jnp.zeros((m,), jnp.int32).at[order].set(rank_sorted)
         # ways of each set ordered least-recently-used first; ways already
         # claimed by hit keys in this call are marked most-recent so a new
         # key can never collide with (or evict) an entry refreshed by the
         # same store_vecs call
-        any_hit = jnp.any(hit, axis=1)
+        any_hit = any_hit_pre
         hit_way = jnp.argmax(hit, axis=1).astype(jnp.int32)
         big = jnp.iinfo(jnp.int32).max
         time_adj = state.time.at[sets, hit_way].max(

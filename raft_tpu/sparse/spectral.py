@@ -12,9 +12,6 @@ import jax.numpy as jnp
 
 from raft_tpu.sparse import convert
 from raft_tpu.sparse.formats import COO
-from raft_tpu.spectral.eigen_solvers import EigenSolverConfig, LanczosSolver
-from raft_tpu.spectral.matrix_wrappers import LaplacianMatrix
-from raft_tpu.spectral.spectral_util import transform_eigen_matrix
 
 
 def fit_embedding(coo: COO, n_components: int,
@@ -26,6 +23,13 @@ def fit_embedding(coo: COO, n_components: int,
     (detail/spectral.cuh:68-74: maxiter=4000, tol=0.01,
     restart_iter=15+neigvs).
     """
+    # deferred: raft_tpu.spectral imports raft_tpu.sparse at package-init
+    # time, so importing it at module scope here would be circular
+    from raft_tpu.spectral.eigen_solvers import (
+        EigenSolverConfig, LanczosSolver)
+    from raft_tpu.spectral.matrix_wrappers import LaplacianMatrix
+    from raft_tpu.spectral.spectral_util import transform_eigen_matrix
+
     n = coo.n_rows
     neigvs = n_components + 1
     csr = convert.coo_to_csr(coo)

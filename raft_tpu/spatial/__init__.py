@@ -11,3 +11,13 @@ from raft_tpu.spatial.fused_l2_knn import fused_l2_knn  # noqa: F401
 from raft_tpu.spatial.haversine import haversine_distances, haversine_knn  # noqa: F401
 from raft_tpu.spatial.knn import brute_force_knn, knn_merge_parts  # noqa: F401
 from raft_tpu.spatial.processing import create_processor  # noqa: F401
+from raft_tpu.spatial.ann import (  # noqa: F401
+    IVFFlatParams, IVFPQParams, IVFSQParams,
+    approx_knn_build_index, approx_knn_search,
+    ivf_flat_build, ivf_flat_search,
+    ivf_pq_build, ivf_pq_search,
+    ivf_sq_build, ivf_sq_search,
+)
+from raft_tpu.spatial.ball_cover import (  # noqa: F401
+    BallCoverIndex, rbc_build_index, rbc_knn_query, rbc_all_knn_query,
+)

@@ -1,0 +1,380 @@
+"""Approximate nearest neighbors: IVF-Flat, IVF-PQ, IVF-SQ — native.
+
+Reference: spatial/knn/ann.hpp:45,71 (``approx_knn_build_index`` /
+``approx_knn_search``) with params ``IVFParam``/``IVFPQParam``/``IVFSQParam``
+(ann_common.h:42-72).  The reference delegates build+search entirely to
+FAISS GPU (detail/ann_quantized_faiss.cuh:75+); the TPU build implements
+the quantizers natively (SURVEY.md §7.8):
+
+- **IVF-Flat**: k-means coarse quantizer (reusing spectral/kmeans) +
+  padded per-list storage.  Lists are a dense (nlist, max_len, d) tensor —
+  scanning ``nprobe`` lists per query is a batched matmul on the MXU, the
+  TPU-shaped substitute for FAISS's warp-level list scans.
+- **IVF-PQ**: product quantization of residuals (M subspaces × 2^n_bits
+  codes, k-means codebooks); search = per-query ADC lookup tables, code
+  gathers, segment sums.
+- **IVF-SQ**: per-dimension 8-bit scalar quantization of residuals (the
+  QT_8bit family) scanned like IVF-Flat after dequantization.
+
+All searches return (distances, ids) best-first like brute_force_knn.
+L2 metrics are supported (reference FAISS path likewise restricts the
+metric set, ann_quantized_faiss.cuh:94-118).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.spatial.select_k import select_k
+from raft_tpu.spectral.kmeans import kmeans
+
+D = DistanceType
+
+
+# --------------------------------------------------------------------- #
+# params (reference ann_common.h:42-72)
+# --------------------------------------------------------------------- #
+@dataclass
+class IVFFlatParams:
+    nlist: int
+    nprobe: int = 8
+
+
+@dataclass
+class IVFPQParams:
+    nlist: int
+    nprobe: int = 8
+    M: int = 8           # subquantizers
+    n_bits: int = 8      # log2 codebook size
+
+
+@dataclass
+class IVFSQParams:
+    nlist: int
+    nprobe: int = 8
+    qtype: str = "QT_8bit"
+    encode_residual: bool = True
+
+
+class IVFFlatIndex(NamedTuple):
+    centroids: jnp.ndarray   # (nlist, d)
+    lists: jnp.ndarray       # (nlist, max_len, d) padded vectors
+    list_ids: jnp.ndarray    # (nlist, max_len) original row ids, -1 pad
+    list_sizes: jnp.ndarray  # (nlist,)
+    metric: DistanceType
+    nprobe: int              # default probe count from build params
+
+
+class IVFPQIndex(NamedTuple):
+    centroids: jnp.ndarray    # (nlist, d) coarse
+    codebooks: jnp.ndarray    # (M, ksub, dsub) per-subspace codewords
+    codes: jnp.ndarray        # (nlist, max_len, M) uint8/int32 codes
+    list_ids: jnp.ndarray     # (nlist, max_len)
+    list_sizes: jnp.ndarray
+    metric: DistanceType
+    nprobe: int
+
+
+class IVFSQIndex(NamedTuple):
+    centroids: jnp.ndarray
+    q_data: jnp.ndarray       # (nlist, max_len, d) quantized residuals
+    scale: jnp.ndarray        # (d,) dequant scale
+    offset: jnp.ndarray       # (d,) dequant offset
+    list_ids: jnp.ndarray
+    list_sizes: jnp.ndarray
+    metric: DistanceType
+    nprobe: int
+    encode_residual: bool     # build-time setting, honored by search
+
+
+# --------------------------------------------------------------------- #
+# shared coarse quantizer plumbing
+# --------------------------------------------------------------------- #
+def _coarse_assign(X, nlist, seed):
+    """k-means coarse quantizer + list assignment."""
+    res = kmeans(X, nlist, seed=seed, max_iter=25)
+    return res.centroids, res.labels
+
+
+def _build_lists(labels: np.ndarray, nlist: int,
+                 max_len: Optional[int]) -> Tuple[np.ndarray, int]:
+    """Host: (nlist, max_len) row-id table, -1 padded."""
+    labels = np.asarray(labels)
+    counts = np.bincount(labels, minlength=nlist)
+    ml = int(counts.max()) if max_len is None else max_len
+    ml = max(ml, 1)
+    table = np.full((nlist, ml), -1, np.int32)
+    fill = np.zeros(nlist, np.int64)
+    for i, l in enumerate(labels):
+        if fill[l] < ml:
+            table[l, fill[l]] = i
+            fill[l] += 1
+    return table, ml
+
+
+_L2_METRICS = (D.L2Expanded, D.L2SqrtExpanded, D.L2Unexpanded,
+               D.L2SqrtUnexpanded)
+
+
+def _check_metric(name, metric):
+    expects(metric in _L2_METRICS,
+            "%s: unsupported metric %d — the IVF quantizers are L2-only "
+            "(the reference FAISS path likewise restricts the metric set, "
+            "ann_quantized_faiss.cuh:94-118)", name, int(metric))
+
+
+def _search_lists(q, centroids, list_vecs, list_ids, k, nprobe, metric):
+    """Shared IVF search driver: probe → gather → distance → select.
+
+    q: (nq, d).  list_vecs: (nlist, max_len, d).  Returns (dists, ids).
+    """
+    nlist, max_len, d = list_vecs.shape
+    nprobe = min(nprobe, nlist)
+    # (nq, nlist) query-centroid distances → top-nprobe lists
+    qc = (jnp.sum(q * q, 1)[:, None] + jnp.sum(centroids * centroids, 1)[None, :]
+          - 2.0 * jnp.matmul(q, centroids.T, precision="highest"))
+    _, probes = select_k(qc, nprobe, select_min=True)         # (nq, nprobe)
+
+    cand_vecs = list_vecs[probes]          # (nq, nprobe, max_len, d)
+    cand_ids = list_ids[probes]            # (nq, nprobe, max_len)
+    nq = q.shape[0]
+    cand_vecs = cand_vecs.reshape(nq, nprobe * max_len, d)
+    cand_ids = cand_ids.reshape(nq, nprobe * max_len)
+
+    dist = (jnp.sum(q * q, 1)[:, None]
+            + jnp.sum(cand_vecs * cand_vecs, -1)
+            - 2.0 * jnp.einsum("nd,nmd->nm", q, cand_vecs,
+                               precision="highest"))
+    dist = jnp.maximum(dist, 0.0)
+    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+        dist = jnp.sqrt(dist)
+    dist = jnp.where(cand_ids >= 0, dist, jnp.inf)
+    dd, ii = select_k(dist, k, select_min=True, values=cand_ids)
+    return dd, ii
+
+
+# --------------------------------------------------------------------- #
+# IVF-Flat
+# --------------------------------------------------------------------- #
+def ivf_flat_build(X, params: IVFFlatParams,
+                   metric: DistanceType = D.L2Expanded,
+                   seed: int = 1234) -> IVFFlatIndex:
+    """Build an IVF-Flat index (reference approx_knn_build_index IVFFlat
+    path, ann_quantized_faiss.cuh:129-141)."""
+    X = jnp.asarray(X)
+    m, d = X.shape
+    expects(params.nlist <= m, "ivf_flat_build: nlist > n_vectors")
+    _check_metric("ivf_flat_build", metric)
+    centroids, labels = _coarse_assign(X, params.nlist, seed)
+    table, max_len = _build_lists(np.asarray(labels), params.nlist, None)
+    table_j = jnp.asarray(table)
+    gather = jnp.where(table_j >= 0, table_j, 0)
+    lists = X[gather] * (table_j >= 0)[..., None]
+    return IVFFlatIndex(centroids, lists, table_j,
+                        jnp.asarray((table >= 0).sum(1), jnp.int32), metric,
+                        params.nprobe)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric"))
+def _ivf_flat_search_jit(centroids, lists, list_ids, q, k, nprobe, metric):
+    return _search_lists(q, centroids, lists, list_ids, k, nprobe, metric)
+
+
+def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
+                    nprobe: Optional[int] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search an IVF-Flat index (reference approx_knn_search, ann.hpp:71);
+    ``nprobe`` defaults to the build params' value."""
+    q = jnp.asarray(queries)
+    return _ivf_flat_search_jit(index.centroids, index.lists, index.list_ids,
+                                q, k, nprobe or index.nprobe,
+                                DistanceType(int(index.metric)))
+
+
+# --------------------------------------------------------------------- #
+# IVF-PQ
+# --------------------------------------------------------------------- #
+def ivf_pq_build(X, params: IVFPQParams,
+                 metric: DistanceType = D.L2Expanded,
+                 seed: int = 1234) -> IVFPQIndex:
+    """Build IVF-PQ: coarse quantize, then per-subspace k-means codebooks
+    over residuals (reference IVFPQ path, ann_quantized_faiss.cuh:143-160)."""
+    X = jnp.asarray(X)
+    m, d = X.shape
+    M, ksub = params.M, 2 ** params.n_bits
+    expects(d % M == 0, "ivf_pq_build: dim %d not divisible by M=%d", d, M)
+    _check_metric("ivf_pq_build", metric)
+    dsub = d // M
+    centroids, labels = _coarse_assign(X, params.nlist, seed)
+    resid = X - centroids[labels]
+
+    codebooks = []
+    codes_flat = []
+    for mi in range(M):
+        sub = resid[:, mi * dsub:(mi + 1) * dsub]
+        kk = min(ksub, m)
+        res = kmeans(sub, kk, seed=seed + mi, max_iter=20)
+        cb = res.centroids
+        if kk < ksub:  # pad codebook
+            cb = jnp.concatenate(
+                [cb, jnp.full((ksub - kk, dsub), jnp.inf, cb.dtype)])
+        codebooks.append(cb)
+        codes_flat.append(res.labels)
+    codebooks = jnp.stack(codebooks)                  # (M, ksub, dsub)
+    codes_flat = jnp.stack(codes_flat, axis=1)        # (m, M)
+
+    table, max_len = _build_lists(np.asarray(labels), params.nlist, None)
+    table_j = jnp.asarray(table)
+    gather = jnp.where(table_j >= 0, table_j, 0)
+    codes = codes_flat[gather]                        # (nlist, max_len, M)
+    return IVFPQIndex(centroids, codebooks, codes, table_j,
+                      jnp.asarray((table >= 0).sum(1), jnp.int32), metric,
+                      params.nprobe)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric"))
+def _ivf_pq_search_jit(centroids, codebooks, all_codes, list_ids, q, k,
+                       nprobe, metric):
+    nlist, max_len, M = all_codes.shape
+    _, ksub, dsub = codebooks.shape
+    nq, d = q.shape
+    nprobe = min(nprobe, nlist)
+
+    qc = (jnp.sum(q * q, 1)[:, None]
+          + jnp.sum(centroids * centroids, 1)[None, :]
+          - 2.0 * jnp.matmul(q, centroids.T, precision="highest"))
+    qc = jnp.maximum(qc, 0.0)
+    _, probes = select_k(qc, nprobe, select_min=True)   # (nq, nprobe)
+
+    # ADC tables per (query, probed list): residual = q - centroid, so the
+    # lookup table depends on the probe; table[nq, nprobe, M, ksub] =
+    # ||resid_sub - codeword||^2
+    resid = q[:, None, :] - centroids[probes]           # (nq, nprobe, d)
+    rs = resid.reshape(nq, nprobe, M, dsub)
+    cb = codebooks                                      # (M, ksub, dsub)
+    lut = (jnp.sum(rs * rs, -1)[..., None]
+           + jnp.sum(cb * cb, -1)[None, None]
+           - 2.0 * jnp.einsum("npmd,mkd->npmk", rs, cb,
+                              precision="highest"))     # (nq,nprobe,M,ksub)
+
+    codes = all_codes[probes]                           # (nq,nprobe,max_len,M)
+    ids = list_ids[probes].reshape(nq, nprobe * max_len)
+    # gather LUT entries: dist = sum_m lut[m, code_m]; align code axis with
+    # the LUT's ksub axis to gather without materializing a ksub-sized copy
+    codes_t = jnp.transpose(codes, (0, 1, 3, 2)).astype(jnp.int32)
+    dist = jnp.take_along_axis(lut, codes_t, axis=-1)   # (nq,np,M,max_len)
+    dist = jnp.sum(dist, axis=2).reshape(nq, nprobe * max_len)
+    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+        dist = jnp.sqrt(jnp.maximum(dist, 0.0))
+    dist = jnp.where(ids >= 0, dist, jnp.inf)
+    return select_k(dist, k, select_min=True, values=ids)
+
+
+def ivf_pq_search(index: IVFPQIndex, queries, k: int,
+                  nprobe: Optional[int] = None):
+    q = jnp.asarray(queries)
+    return _ivf_pq_search_jit(index.centroids, index.codebooks, index.codes,
+                              index.list_ids, q, k, nprobe or index.nprobe,
+                              DistanceType(int(index.metric)))
+
+
+# --------------------------------------------------------------------- #
+# IVF-SQ
+# --------------------------------------------------------------------- #
+def ivf_sq_build(X, params: IVFSQParams,
+                 metric: DistanceType = D.L2Expanded,
+                 seed: int = 1234) -> IVFSQIndex:
+    """8-bit scalar quantization of residuals (QT_8bit; reference IVFSQ
+    path, ann_quantized_faiss.cuh:162-176)."""
+    expects(params.qtype in ("QT_8bit", "QT_8bit_uniform"),
+            "ivf_sq_build: unsupported qtype %s", params.qtype)
+    _check_metric("ivf_sq_build", metric)
+    X = jnp.asarray(X)
+    centroids, labels = _coarse_assign(X, params.nlist, seed)
+    resid = X - centroids[labels] if params.encode_residual else X
+    lo = jnp.min(resid, axis=0)
+    hi = jnp.max(resid, axis=0)
+    if params.qtype == "QT_8bit_uniform":
+        lo = jnp.full_like(lo, jnp.min(lo))
+        hi = jnp.full_like(hi, jnp.max(hi))
+    scale = (hi - lo) / 255.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q_all = jnp.clip(jnp.round((resid - lo) / scale), 0, 255).astype(jnp.uint8)
+
+    table, _ = _build_lists(np.asarray(labels), params.nlist, None)
+    table_j = jnp.asarray(table)
+    gather = jnp.where(table_j >= 0, table_j, 0)
+    q_data = q_all[gather]
+    return IVFSQIndex(centroids, q_data, scale, lo, table_j,
+                      jnp.asarray((table >= 0).sum(1), jnp.int32), metric,
+                      params.nprobe, params.encode_residual)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe",
+                                             "encode_residual", "metric"))
+def _ivf_sq_search_jit(centroids, q_data, scale, offset, list_ids,
+                       q, k, nprobe, encode_residual, metric):
+    nlist, max_len, d = q_data.shape
+    nq = q.shape[0]
+    nprobe = min(nprobe, nlist)
+    # probe, then dequantize only the probed lists (the whole store stays
+    # uint8 in HBM — the memory point of scalar quantization)
+    qc = (jnp.sum(q * q, 1)[:, None]
+          + jnp.sum(centroids * centroids, 1)[None, :]
+          - 2.0 * jnp.matmul(q, centroids.T, precision="highest"))
+    _, probes = select_k(qc, nprobe, select_min=True)       # (nq, nprobe)
+    deq = (q_data[probes].astype(jnp.float32) * scale + offset)
+    if encode_residual:
+        deq = deq + centroids[probes][:, :, None, :]
+    cand = deq.reshape(nq, nprobe * max_len, d)
+    ids = list_ids[probes].reshape(nq, nprobe * max_len)
+    dist = (jnp.sum(q * q, 1)[:, None] + jnp.sum(cand * cand, -1)
+            - 2.0 * jnp.einsum("nd,nmd->nm", q, cand, precision="highest"))
+    dist = jnp.maximum(dist, 0.0)
+    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+        dist = jnp.sqrt(dist)
+    dist = jnp.where(ids >= 0, dist, jnp.inf)
+    return select_k(dist, k, select_min=True, values=ids)
+
+
+def ivf_sq_search(index: IVFSQIndex, queries, k: int,
+                  nprobe: Optional[int] = None):
+    """Search; honors the build-time ``encode_residual`` setting."""
+    q = jnp.asarray(queries)
+    return _ivf_sq_search_jit(index.centroids, index.q_data, index.scale,
+                              index.offset, index.list_ids,
+                              q, k, nprobe or index.nprobe,
+                              bool(index.encode_residual),
+                              DistanceType(int(index.metric)))
+
+
+# --------------------------------------------------------------------- #
+# dispatcher (reference ann.hpp:45,71)
+# --------------------------------------------------------------------- #
+def approx_knn_build_index(X, params, metric: DistanceType = D.L2Expanded,
+                           seed: int = 1234):
+    if isinstance(params, IVFPQParams):
+        return ivf_pq_build(X, params, metric, seed)
+    if isinstance(params, IVFSQParams):
+        return ivf_sq_build(X, params, metric, seed)
+    if isinstance(params, IVFFlatParams):
+        return ivf_flat_build(X, params, metric, seed)
+    raise TypeError(f"unknown ANN params {type(params)}")
+
+
+def approx_knn_search(index, queries, k: int, nprobe: Optional[int] = None):
+    if isinstance(index, IVFPQIndex):
+        return ivf_pq_search(index, queries, k, nprobe)
+    if isinstance(index, IVFSQIndex):
+        return ivf_sq_search(index, queries, k, nprobe)
+    if isinstance(index, IVFFlatIndex):
+        return ivf_flat_search(index, queries, k, nprobe)
+    raise TypeError(f"unknown ANN index {type(index)}")

@@ -1,0 +1,178 @@
+"""Random ball cover: exact kNN for low-dim data via landmark pruning.
+
+Reference: spatial/knn/ball_cover.hpp:32,77,142 (``rbc_build_index``,
+``rbc_all_knn_query``, ``rbc_knn_query``) and detail/ball_cover.cuh — index
+= √m sampled landmarks, every point 1-NN-assigned to a landmark, members
+sorted by landmark with per-landmark radius (:64-318); query = k closest
+landmarks first, then triangle-inequality-pruned passes over remaining
+landmarks (:218-260).
+
+TPU design: the per-thread heap + early-exit register kernels
+(detail/ball_cover/registers.cuh) become **ranked dense group scans**: each
+query orders landmarks by distance once; a ``lax.while_loop`` scans one
+ranked group per step (a padded (nq, group_max, d) gather + batched
+distance + running top-k merge) and stops as soon as the triangle
+inequality ``d(q, landmark) − radius > kth_bound`` prunes every remaining
+landmark for every query — the same exactness argument as the reference,
+with dynamic trip count instead of per-thread early exit.
+
+Supported metrics: L2 family and Haversine (reference restricts to the
+same, ball_cover.hpp docs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.spatial.haversine import haversine_distances
+from raft_tpu.spatial.knn import knn_merge_parts
+from raft_tpu.spatial.select_k import select_k
+
+D = DistanceType
+_SUPPORTED = (D.L2Expanded, D.L2SqrtExpanded, D.L2Unexpanded,
+              D.L2SqrtUnexpanded, D.Haversine)
+
+
+class BallCoverIndex(NamedTuple):
+    """(reference BallCoverIndex, ball_cover_common.h:38)"""
+
+    X: jnp.ndarray            # (m, d) original data
+    landmarks: jnp.ndarray    # (L, d) sampled landmark coordinates
+    groups: jnp.ndarray       # (L, gmax) member row ids, -1 pad
+    radius: jnp.ndarray       # (L,) max member distance per landmark
+    metric: DistanceType
+
+
+def _dists(x, y, metric):
+    """(m, n) distances in the metric's *pruning* space (root form so the
+    triangle inequality holds; L2 results are squared on report if the
+    caller's metric is the squared form)."""
+    if metric == D.Haversine:
+        return haversine_distances(x, y)
+    d2 = (jnp.sum(x * x, 1)[:, None] + jnp.sum(y * y, 1)[None, :]
+          - 2.0 * jnp.matmul(x, y.T, precision="highest"))
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+def rbc_build_index(X, metric: DistanceType = D.L2SqrtExpanded,
+                    n_landmarks: int | None = None,
+                    seed: int = 0) -> BallCoverIndex:
+    """Build the ball cover (reference rbc_build_index, ball_cover.hpp:32;
+    n_landmarks defaults to √m, ball_cover_common.h:55)."""
+    X = jnp.asarray(X)
+    m, dim = X.shape
+    expects(metric in _SUPPORTED,
+            "rbc_build_index: unsupported metric %d", int(metric))
+    if metric == D.Haversine:
+        expects(dim == 2, "haversine ball cover requires 2-d lat/lon")
+    L = n_landmarks or max(int(np.sqrt(m)), 1)
+
+    rng = np.random.default_rng(seed)
+    lm_ids = rng.choice(m, size=L, replace=False)
+    landmarks = X[jnp.asarray(lm_ids)]
+
+    # 1-NN assign every point to a landmark (m × L dense — L = √m)
+    dl = _dists(X, landmarks, metric)
+    owner = np.asarray(jnp.argmin(dl, axis=1))
+    dist_own = np.asarray(jnp.min(dl, axis=1))
+
+    counts = np.bincount(owner, minlength=L)
+    gmax = max(int(counts.max()), 1)
+    groups = np.full((L, gmax), -1, np.int32)
+    fill = np.zeros(L, np.int64)
+    order = np.argsort(dist_own)[::-1]  # reference sorts members by dist
+    for i in order:
+        l = owner[i]
+        groups[l, fill[l]] = i
+        fill[l] += 1
+    radius = np.zeros(L, np.float32)
+    np.maximum.at(radius, owner, dist_own)
+    return BallCoverIndex(X, landmarks, jnp.asarray(groups),
+                          jnp.asarray(radius), metric)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _rbc_query_jit(X, landmarks, groups, radius, q, k, metric):
+    nq = q.shape[0]
+    L, gmax = groups.shape
+    m = X.shape[0]
+
+    ql = _dists(q, landmarks, metric)                 # (nq, L)
+    rank_d, rank_l = select_k(ql, L, select_min=True)  # full ordering
+    # suffix min over ranked landmarks of (d - radius): if this exceeds the
+    # current kth bound, no remaining landmark can improve the result
+    slack = rank_d - radius[rank_l]
+    suffix_min = jax.lax.associative_scan(jnp.minimum, slack, reverse=True,
+                                          axis=1)
+
+    worst = jnp.inf
+    best_d0 = jnp.full((nq, k), worst, jnp.float32)
+    best_i0 = jnp.full((nq, k), -1, jnp.int32)
+
+    def cond(state):
+        r, best_d, best_i, _ = state
+        bound = best_d[:, -1]
+        # landmark ranked < r already scanned; prune the rest?
+        more = r < L
+        alive = jnp.any(suffix_min[:, jnp.minimum(r, L - 1)] <= bound)
+        return more & alive
+
+    def body(state):
+        r, best_d, best_i, steps = state
+        lm = rank_l[:, jnp.minimum(r, L - 1)]          # (nq,) landmark ids
+        gids = groups[lm]                              # (nq, gmax)
+        vecs = X[jnp.where(gids >= 0, gids, 0)]        # (nq, gmax, d)
+        if metric == D.Haversine:
+            sin_lat = jnp.sin(0.5 * (q[:, None, 0] - vecs[..., 0]))
+            sin_lon = jnp.sin(0.5 * (q[:, None, 1] - vecs[..., 1]))
+            rdist = sin_lat**2 + (jnp.cos(q[:, None, 0]) *
+                                  jnp.cos(vecs[..., 0]) * sin_lon**2)
+            dd = 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(rdist, 0.0, 1.0)))
+        else:
+            dd = (jnp.sum(q * q, 1)[:, None] + jnp.sum(vecs * vecs, -1)
+                  - 2.0 * jnp.einsum("nd,ngd->ng", q, vecs,
+                                     precision="highest"))
+            dd = jnp.sqrt(jnp.maximum(dd, 0.0))
+        dd = jnp.where(gids >= 0, dd, worst)
+        bd, bl = select_k(dd, min(k, gmax), select_min=True)
+        bi = jnp.take_along_axis(gids, bl, axis=1)
+        if bd.shape[1] < k:
+            pad = k - bd.shape[1]
+            bd = jnp.pad(bd, ((0, 0), (0, pad)), constant_values=worst)
+            bi = jnp.pad(bi, ((0, 0), (0, pad)), constant_values=-1)
+        cand_d = jnp.stack([best_d, bd])
+        cand_i = jnp.stack([best_i, bi])
+        best_d, best_i = knn_merge_parts(cand_d, cand_i, k)
+        return r + 1, best_d, best_i, steps + 1
+
+    _, best_d, best_i, steps = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), best_d0, best_i0, jnp.int32(0)))
+
+    if metric in (D.L2Expanded, D.L2Unexpanded):
+        best_d = best_d * best_d
+    return best_d, best_i, steps
+
+
+def rbc_knn_query(index: BallCoverIndex, k: int, queries
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact kNN against the indexed set (reference rbc_knn_query,
+    ball_cover.hpp:142)."""
+    q = jnp.asarray(queries)
+    d, i, _ = _rbc_query_jit(index.X, index.landmarks, index.groups,
+                             index.radius, q, k,
+                             DistanceType(int(index.metric)))
+    return d, i
+
+
+def rbc_all_knn_query(index: BallCoverIndex, k: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All-points kNN (X vs X, self included — reference
+    rbc_all_knn_query, ball_cover.hpp:77)."""
+    return rbc_knn_query(index, k, index.X)
