@@ -1,0 +1,98 @@
+/*
+ * Host memory arena: aligned allocations with pooling.
+ *
+ * The role of the reference's mr/ layer (base_allocator mr/allocator.hpp:35,
+ * buffer_base mr/buffer_base.hpp:39) for the TPU build's host side: staging
+ * buffers handed to PJRT host-to-device transfers want 64-byte alignment
+ * and reuse; free blocks are kept in power-of-two size classes.
+ */
+#pragma once
+
+#include <cstddef>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "error.hpp"
+
+namespace raft_tpu {
+
+class host_arena {
+ public:
+  static constexpr std::size_t kAlignment = 64;
+
+  void* allocate(std::size_t n)
+  {
+    if (n == 0) n = 1;
+    std::size_t cls = size_class(n);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      auto& pool = free_[cls];
+      if (!pool.empty()) {
+        void* p = pool.back();
+        pool.pop_back();
+        in_use_ += cls;
+        return p;
+      }
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, kAlignment, cls) != 0 || p == nullptr) {
+      RAFT_TPU_FAIL("host_arena: allocation of %zu bytes failed", cls);
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    total_ += cls;
+    in_use_ += cls;
+    size_of_[p] = cls;
+    return p;
+  }
+
+  void deallocate(void* p)
+  {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = size_of_.find(p);
+    RAFT_TPU_EXPECTS(it != size_of_.end(),
+                     "host_arena: deallocate of unknown pointer");
+    in_use_ -= it->second;
+    free_[it->second].push_back(p);
+  }
+
+  /** Release all pooled blocks back to the OS. */
+  void trim()
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& kv : free_) {
+      for (void* p : kv.second) {
+        total_ -= kv.first;
+        size_of_.erase(p);
+        std::free(p);
+      }
+      kv.second.clear();
+    }
+  }
+
+  std::size_t total_bytes() const { return total_; }
+  std::size_t in_use_bytes() const { return in_use_; }
+
+  ~host_arena()
+  {
+    for (auto& kv : size_of_) std::free(kv.first);
+  }
+
+ private:
+  static std::size_t size_class(std::size_t n)
+  {
+    std::size_t c = kAlignment;
+    while (c < n) c <<= 1;
+    return c;
+  }
+
+  std::mutex mu_;
+  std::map<std::size_t, std::vector<void*>> free_;
+  std::map<void*, std::size_t> size_of_;
+  std::size_t total_ = 0;
+  std::size_t in_use_ = 0;
+};
+
+}  // namespace raft_tpu

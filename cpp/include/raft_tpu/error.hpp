@@ -1,0 +1,77 @@
+/*
+ * Host-side error types for the raft_tpu native runtime.
+ *
+ * Mirrors the reference's raft::exception with collected stack trace and
+ * the THROW / RAFT_EXPECTS / RAFT_FAIL macro family
+ * (reference: cpp/include/raft/error.hpp:28,94-148) for the TPU build's
+ * C++ host layer.  Device errors surface through XLA/PJRT on the Python
+ * side; this covers the native host components (arena, packers).
+ */
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <exception>
+#include <execinfo.h>
+#include <sstream>
+#include <string>
+
+namespace raft_tpu {
+
+/** Exception carrying a message and a collected call stack. */
+class exception : public std::exception {
+ public:
+  explicit exception(std::string const& message) : msg_(message)
+  {
+    collect_call_stack();
+  }
+
+  char const* what() const noexcept override { return msg_.c_str(); }
+
+ private:
+  std::string msg_;
+
+  /** Append the current call stack to the message (reference
+   * error.hpp:57-87 collectCallStack). */
+  void collect_call_stack()
+  {
+#ifdef __GNUC__
+    constexpr int kMaxStackDepth = 64;
+    void* stack[kMaxStackDepth];
+    int depth = backtrace(stack, kMaxStackDepth);
+    std::ostringstream oss;
+    oss << std::endl << "Obtained " << depth << " stack frames" << std::endl;
+    char** strings = backtrace_symbols(stack, depth);
+    if (strings == nullptr) return;
+    for (int i = 0; i < depth; ++i) {
+      oss << "#" << i << " in " << strings[i] << std::endl;
+    }
+    free(strings);
+    msg_ += oss.str();
+#endif
+  }
+};
+
+}  // namespace raft_tpu
+
+/** Macro family (reference error.hpp:94-148). */
+#define RAFT_TPU_STRINGIFY_DETAIL(x) #x
+#define RAFT_TPU_STRINGIFY(x) RAFT_TPU_STRINGIFY_DETAIL(x)
+
+#define RAFT_TPU_THROW(fmt, ...)                                          \
+  do {                                                                    \
+    char msg[2048];                                                       \
+    std::snprintf(msg, sizeof(msg),                                       \
+                  "exception occurred! file=" __FILE__                    \
+                  " line=" RAFT_TPU_STRINGIFY(__LINE__) ": " fmt,         \
+                  ##__VA_ARGS__);                                         \
+    throw raft_tpu::exception(msg);                                       \
+  } while (0)
+
+#define RAFT_TPU_EXPECTS(cond, fmt, ...)                                  \
+  do {                                                                    \
+    if (!(cond)) { RAFT_TPU_THROW(fmt, ##__VA_ARGS__); }                  \
+  } while (0)
+
+#define RAFT_TPU_FAIL(fmt, ...) RAFT_TPU_THROW(fmt, ##__VA_ARGS__)

@@ -1,0 +1,222 @@
+/*
+ * raft_tpu native host runtime: C ABI exported to Python via ctypes.
+ *
+ * The TPU build's analog of the reference's precompiled native layer
+ * (cpp/src/ → libraft_distance.so / libraft_nn.so): device math lives in
+ * XLA/Pallas, so what earns native code on a TPU host is the genuinely
+ * sequential host-side work the Python layer would otherwise do in
+ * interpreted loops:
+ *
+ *  - union-find dendrogram construction (reference build_dendrogram_host,
+ *    sparse/hierarchy/detail/agglomerative.cuh:101) and flattened-cluster
+ *    extraction (:237);
+ *  - inverted-list packing for the IVF index builders (the role of FAISS's
+ *    list assignment);
+ *  - ball-cover group packing sorted by owner distance
+ *    (reference detail/ball_cover.cuh:113-191 sort-by-landmark stage);
+ *  - an aligned pooling host arena (reference mr/ layer).
+ *
+ * All functions use a plain C ABI (int64/double buffers the caller owns) so
+ * the Python side binds with ctypes — no pybind11 dependency needed.
+ */
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+#include "raft_tpu/arena.hpp"
+#include "raft_tpu/error.hpp"
+
+extern "C" {
+
+// ------------------------------------------------------------------ //
+// version / arena
+// ------------------------------------------------------------------ //
+const char* rt_version() { return "raft_tpu_host 0.1.0"; }
+
+static raft_tpu::host_arena g_arena;
+
+void* rt_alloc(std::size_t n)
+{
+  try {
+    return g_arena.allocate(n);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void rt_free(void* p) { g_arena.deallocate(p); }
+void rt_trim() { g_arena.trim(); }
+std::size_t rt_arena_total() { return g_arena.total_bytes(); }
+std::size_t rt_arena_in_use() { return g_arena.in_use_bytes(); }
+
+// ------------------------------------------------------------------ //
+// union-find dendrogram (agglomerative.cuh:101 analog)
+// ------------------------------------------------------------------ //
+namespace {
+
+struct UnionFind {
+  std::vector<int64_t> parent;
+  std::vector<int64_t> size;
+  int64_t next_id;
+
+  explicit UnionFind(int64_t n)
+    : parent(2 * n - 1, -1), size(2 * n - 1, 0), next_id(n)
+  {
+    std::fill(size.begin(), size.begin() + n, 1);
+  }
+
+  int64_t find(int64_t x)
+  {
+    int64_t root = x;
+    while (parent[root] != -1) root = parent[root];
+    while (parent[x] != -1) {  // path compression
+      int64_t next = parent[x];
+      parent[x] = root;
+      x = next;
+    }
+    return root;
+  }
+
+  void unite(int64_t a, int64_t b)
+  {
+    parent[a] = next_id;
+    parent[b] = next_id;
+    size[next_id] = size[a] + size[b];
+    ++next_id;
+  }
+};
+
+}  // namespace
+
+/**
+ * Build a scipy-convention dendrogram from m-1 MST edges.
+ * Inputs: src/dst (m-1), weights (m-1), m.  The function sorts by weight
+ * (stable) internally.  Outputs (caller-allocated): children (2*(m-1)),
+ * out_delta (m-1), out_size (m-1).  Returns 0 on success.
+ */
+int rt_build_dendrogram(const int64_t* src, const int64_t* dst,
+                        const double* weights, int64_t m,
+                        int64_t* children, double* out_delta,
+                        int64_t* out_size)
+{
+  if (m < 2) return 1;
+  int64_t n_edges = m - 1;
+  std::vector<int64_t> order(n_edges);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return weights[a] < weights[b]; });
+
+  UnionFind uf(m);
+  for (int64_t i = 0; i < n_edges; ++i) {
+    int64_t e = order[i];
+    int64_t aa = uf.find(src[e]);
+    int64_t bb = uf.find(dst[e]);
+    children[2 * i] = aa;
+    children[2 * i + 1] = bb;
+    out_delta[i] = weights[e];
+    out_size[i] = uf.size[aa] + uf.size[bb];
+    uf.unite(aa, bb);
+  }
+  return 0;
+}
+
+/**
+ * Cut a dendrogram into n_clusters monotonic labels
+ * (agglomerative.cuh:237 analog).  labels: caller-allocated (n_leaves).
+ */
+int rt_extract_clusters(const int64_t* children, int64_t n_clusters,
+                        int64_t n_leaves, int64_t* labels)
+{
+  if (n_leaves < 1 || n_clusters < 1 || n_clusters > n_leaves) return 1;
+  if (n_clusters == 1) {
+    std::fill(labels, labels + n_leaves, 0);
+    return 0;
+  }
+  std::vector<int64_t> parent(2 * n_leaves - 1, -1);
+  for (int64_t i = 0; i < n_leaves - n_clusters; ++i) {
+    int64_t nid = n_leaves + i;
+    parent[children[2 * i]] = nid;
+    parent[children[2 * i + 1]] = nid;
+  }
+  // root per leaf, then monotonic relabel by first appearance of sorted
+  // root ids (matches np.unique(..., return_inverse=True))
+  std::vector<int64_t> roots(n_leaves);
+  for (int64_t i = 0; i < n_leaves; ++i) {
+    int64_t x = roots[i] = [&] {
+      int64_t r = i;
+      while (parent[r] != -1) r = parent[r];
+      return r;
+    }();
+    (void)x;
+  }
+  std::vector<int64_t> uniq(roots);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  for (int64_t i = 0; i < n_leaves; ++i) {
+    labels[i] = std::lower_bound(uniq.begin(), uniq.end(), roots[i]) -
+                uniq.begin();
+  }
+  return 0;
+}
+
+// ------------------------------------------------------------------ //
+// inverted-list packing (IVF builders)
+// ------------------------------------------------------------------ //
+/**
+ * Pack per-row list assignments into a padded (nlist, max_len) table of
+ * row ids (-1 pad).  max_len == 0 → computed from the largest list and
+ * written back through *out_max_len.  table must hold nlist * max_len
+ * entries (call once with max_len==0 and table==nullptr to size it).
+ */
+int rt_build_lists(const int64_t* labels, int64_t m, int64_t nlist,
+                   int64_t* table, int64_t max_len, int64_t* out_max_len)
+{
+  std::vector<int64_t> counts(nlist, 0);
+  for (int64_t i = 0; i < m; ++i) {
+    if (labels[i] < 0 || labels[i] >= nlist) return 1;
+    ++counts[labels[i]];
+  }
+  int64_t widest = *std::max_element(counts.begin(), counts.end());
+  if (widest < 1) widest = 1;
+  if (out_max_len != nullptr) *out_max_len = (max_len == 0) ? widest : max_len;
+  if (table == nullptr) return 0;
+  int64_t ml = (max_len == 0) ? widest : max_len;
+
+  std::fill(table, table + nlist * ml, int64_t{-1});
+  std::vector<int64_t> fill(nlist, 0);
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t l = labels[i];
+    if (fill[l] < ml) table[l * ml + fill[l]++] = i;
+  }
+  return 0;
+}
+
+/**
+ * Ball-cover group packing: members of each landmark ordered by descending
+ * owner distance (reference sorts 1-NN members by distance,
+ * detail/ball_cover.cuh:113-191).  groups: (L, gmax) int64, -1 pad;
+ * radius: (L,) double out.
+ */
+int rt_pack_groups(const int64_t* owner, const double* dist, int64_t m,
+                   int64_t L, int64_t* groups, int64_t gmax, double* radius)
+{
+  std::vector<int64_t> order(m);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return dist[a] > dist[b]; });
+  std::fill(groups, groups + L * gmax, int64_t{-1});
+  std::fill(radius, radius + L, 0.0);
+  std::vector<int64_t> fill(L, 0);
+  for (int64_t idx : order) {
+    int64_t l = owner[idx];
+    if (l < 0 || l >= L) return 1;
+    if (fill[l] < gmax) groups[l * gmax + fill[l]++] = idx;
+    radius[l] = std::max(radius[l], dist[idx]);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -105,17 +105,23 @@ class VecCache:
         rank_sorted = (incl - miss_sorted - base).astype(jnp.int32)
         rank = jnp.zeros((m,), jnp.int32).at[order].set(rank_sorted)
         # ways of each set ordered least-recently-used first; ways already
-        # claimed by hit keys in this call are marked most-recent so a new
-        # key can never collide with (or evict) an entry refreshed by the
-        # same store_vecs call
+        # claimed by hit keys in this call are marked most-recent (sorted
+        # last) and misses wrap only among the remaining free ways, so a
+        # new key never collides with — or, at overcapacity, evicts — an
+        # entry refreshed by the same store_vecs call unless every way of
+        # the set was hit
         any_hit = any_hit_pre
         hit_way = jnp.argmax(hit, axis=1).astype(jnp.int32)
         big = jnp.iinfo(jnp.int32).max
         time_adj = state.time.at[sets, hit_way].max(
             jnp.where(any_hit, big, -1))
+        # hits per set in this call (count each hit key once)
+        hits_per_set = jax.ops.segment_sum(
+            any_hit.astype(jnp.int32), sets, num_segments=self.n_sets)
+        free_ways = jnp.maximum(self.assoc - hits_per_set[sets], 1)
         lru_order = jnp.argsort(time_adj[sets], axis=1)
         lru_way = jnp.take_along_axis(
-            lru_order, (rank % self.assoc)[:, None], axis=1)[:, 0]
+            lru_order, (rank % free_ways)[:, None], axis=1)[:, 0]
         way = jnp.where(jnp.any(hit, axis=1), jnp.argmax(hit, axis=1),
                         lru_way).astype(jnp.int32)
         new_clock = state.clock + 1

@@ -1,0 +1,185 @@
+"""ctypes bindings to the native host runtime (cpp/src/host_runtime.cpp).
+
+The TPU analog of the reference's Cython layer (python/raft/common/*.pyx):
+the C++ side exports a plain C ABI, and this module compiles (if needed),
+loads, and wraps it.  Every wrapper has a pure-Python fallback, so the
+package works without a toolchain; ``native_available()`` reports which
+path is active.
+
+Build strategy: look for a prebuilt ``libraft_tpu_host.so`` (cmake install
+or earlier lazy build), else compile once with g++ into
+``cpp/build/`` — a few hundred ms, cached across sessions.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_CPP = os.path.join(_ROOT, "cpp")
+_BUILD = os.path.join(_CPP, "build")
+_SO = os.path.join(_BUILD, "libraft_tpu_host.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[str]:
+    src = os.path.join(_CPP, "src", "host_runtime.cpp")
+    if not os.path.exists(src):
+        return None
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-I", os.path.join(_CPP, "include"), src, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def _stale() -> bool:
+    """True when the cached .so predates the C++ source."""
+    src = os.path.join(_CPP, "src", "host_runtime.cpp")
+    try:
+        return os.path.getmtime(_SO) < os.path.getmtime(src)
+    except OSError:
+        return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _SO if (os.path.exists(_SO) and not _stale()) else _compile()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            _bind(lib)
+        except (OSError, AttributeError):
+            # load failure or missing symbol (stale ABI) → Python fallback
+            return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.rt_version.restype = ctypes.c_char_p
+    lib.rt_alloc.restype = ctypes.c_void_p
+    lib.rt_alloc.argtypes = [ctypes.c_size_t]
+    lib.rt_free.argtypes = [ctypes.c_void_p]
+    lib.rt_arena_total.restype = ctypes.c_size_t
+    lib.rt_arena_in_use.restype = ctypes.c_size_t
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.rt_build_dendrogram.restype = ctypes.c_int
+    lib.rt_build_dendrogram.argtypes = [
+        i64p, i64p, f64p, ctypes.c_int64, i64p, f64p, i64p]
+    lib.rt_extract_clusters.restype = ctypes.c_int
+    lib.rt_extract_clusters.argtypes = [
+        i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+    lib.rt_build_lists.restype = ctypes.c_int
+    lib.rt_build_lists.argtypes = [
+        i64p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.rt_pack_groups.restype = ctypes.c_int
+    lib.rt_pack_groups.argtypes = [
+        i64p, f64p, ctypes.c_int64, ctypes.c_int64, i64p,
+        ctypes.c_int64, f64p]
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_version() -> Optional[str]:
+    lib = _load()
+    return lib.rt_version().decode() if lib else None
+
+
+def arena_stats() -> Tuple[int, int]:
+    """(total_bytes, in_use_bytes) of the native host arena (0, 0 if the
+    native layer is unavailable)."""
+    lib = _load()
+    if lib is None:
+        return (0, 0)
+    return int(lib.rt_arena_total()), int(lib.rt_arena_in_use())
+
+
+# --------------------------------------------------------------------- #
+# wrapped algorithms (native with Python fallback)
+# --------------------------------------------------------------------- #
+def build_dendrogram(src, dst, weights, m: int
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Native union-find dendrogram; None → caller should use the Python
+    path (raft_tpu.sparse.hierarchy.build_dendrogram_host)."""
+    lib = _load()
+    if lib is None or m < 2:
+        return None
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    w = np.ascontiguousarray(weights, np.float64)
+    children = np.empty(2 * (m - 1), np.int64)
+    delta = np.empty(m - 1, np.float64)
+    sizes = np.empty(m - 1, np.int64)
+    rc = lib.rt_build_dendrogram(src, dst, w, m, children, delta, sizes)
+    if rc != 0:
+        return None
+    return children.reshape(m - 1, 2), delta, sizes
+
+
+def extract_clusters(children, n_clusters: int, n_leaves: int
+                     ) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    ch = np.ascontiguousarray(np.asarray(children).reshape(-1), np.int64)
+    labels = np.empty(n_leaves, np.int64)
+    rc = lib.rt_extract_clusters(ch, n_clusters, n_leaves, labels)
+    return labels if rc == 0 else None
+
+
+def build_lists(labels, nlist: int) -> Optional[Tuple[np.ndarray, int]]:
+    """Native padded inverted-list packing; None → Python fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    lab = np.ascontiguousarray(labels, np.int64)
+    m = len(lab)
+    ml = ctypes.c_int64(0)
+    if lib.rt_build_lists(lab, m, nlist, None, 0, ctypes.byref(ml)) != 0:
+        return None
+    max_len = max(int(ml.value), 1)
+    table = np.empty(nlist * max_len, np.int64)
+    rc = lib.rt_build_lists(
+        lab, m, nlist, table.ctypes.data_as(ctypes.c_void_p), max_len, None)
+    if rc != 0:
+        return None
+    return table.reshape(nlist, max_len), max_len
+
+
+def pack_groups(owner, dist, L: int, gmax: int
+                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native ball-cover group packing; None → Python fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    o = np.ascontiguousarray(owner, np.int64)
+    d = np.ascontiguousarray(dist, np.float64)
+    groups = np.empty(L * gmax, np.int64)
+    radius = np.empty(L, np.float64)
+    rc = lib.rt_pack_groups(o, d, len(o), L, groups, gmax, radius)
+    if rc != 0:
+        return None
+    return groups.reshape(L, gmax), radius

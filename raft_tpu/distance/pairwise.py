@@ -66,11 +66,19 @@ def _mm(a, b):
 # --------------------------------------------------------------------- #
 # expanded (matmul-backed) metrics
 # --------------------------------------------------------------------- #
-def _l2_expanded(x, y, sqrt: bool):
+def expanded_sq_dists(x, y, precision: str = "highest") -> jnp.ndarray:
+    """(m, n) clamped squared L2 distances, expanded MXU form
+    ``xn + yn − 2·x@yᵀ`` — the single shared implementation every
+    matmul-backed consumer (IVF probes, ball cover, fused NN) builds on."""
     xn = jnp.sum(x * x, axis=1)
     yn = jnp.sum(y * y, axis=1)
-    d = xn[:, None] + yn[None, :] - 2.0 * _mm(x, y.T)
-    d = jnp.maximum(d, 0.0)
+    d = xn[:, None] + yn[None, :] - 2.0 * jnp.matmul(x, y.T,
+                                                     precision=precision)
+    return jnp.maximum(d, 0.0)
+
+
+def _l2_expanded(x, y, sqrt: bool):
+    d = expanded_sq_dists(x, y, _DEFAULT_PRECISION)
     return jnp.sqrt(d) if sqrt else d
 
 

@@ -82,10 +82,20 @@ def build_dendrogram_host(src, dst, weights, m: int,
 
     ``assume_sorted`` skips the weight sort when the caller already sorted
     (build_sorted_mst's contract).
+
+    Uses the native C++ union-find (cpp/src/host_runtime.cpp
+    rt_build_dendrogram) when available — this loop is the pipeline's one
+    inherently sequential host stage; falls back to Python.
     """
     src = np.asarray(src)[: m - 1]
     dst = np.asarray(dst)[: m - 1]
     weights = np.asarray(weights)[: m - 1]
+
+    from raft_tpu.core import native
+    nat = native.build_dendrogram(src, dst, weights, m)
+    if nat is not None:
+        return nat
+
     if not assume_sorted:
         order = np.argsort(weights, kind="stable")
         src, dst, weights = src[order], dst[order], weights[order]
@@ -108,6 +118,11 @@ def extract_flattened_clusters(children: np.ndarray, n_clusters: int,
     m = n_leaves
     if n_clusters == 1:
         return np.zeros(m, dtype=np.int64)
+
+    from raft_tpu.core import native
+    nat = native.extract_clusters(children, n_clusters, m)
+    if nat is not None:
+        return nat
     # undo the last (n_clusters - 1) merges: union over the first
     # m - n_clusters merges only
     parent = np.full(2 * m - 1, -1, dtype=np.int64)

@@ -33,6 +33,7 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pairwise import expanded_sq_dists
 from raft_tpu.spatial.select_k import select_k
 from raft_tpu.spectral.kmeans import kmeans
 
@@ -104,13 +105,21 @@ def _coarse_assign(X, nlist, seed):
     return res.centroids, res.labels
 
 
-def _build_lists(labels: np.ndarray, nlist: int,
-                 max_len: Optional[int]) -> Tuple[np.ndarray, int]:
-    """Host: (nlist, max_len) row-id table, -1 padded."""
+def _build_lists(labels: np.ndarray, nlist: int) -> Tuple[np.ndarray, int]:
+    """Host: (nlist, max_len) row-id table, -1 padded; max_len is sized to
+    the largest list so nothing is ever truncated.
+
+    Native path: cpp/src/host_runtime.cpp rt_build_lists (the sequential
+    packing loop); Python fallback below.
+    """
     labels = np.asarray(labels)
+    from raft_tpu.core import native
+    nat = native.build_lists(labels, nlist)
+    if nat is not None:
+        table64, ml = nat
+        return table64.astype(np.int32), ml
     counts = np.bincount(labels, minlength=nlist)
-    ml = int(counts.max()) if max_len is None else max_len
-    ml = max(ml, 1)
+    ml = max(int(counts.max()), 1)
     table = np.full((nlist, ml), -1, np.int32)
     fill = np.zeros(nlist, np.int64)
     for i, l in enumerate(labels):
@@ -139,8 +148,7 @@ def _search_lists(q, centroids, list_vecs, list_ids, k, nprobe, metric):
     nlist, max_len, d = list_vecs.shape
     nprobe = min(nprobe, nlist)
     # (nq, nlist) query-centroid distances → top-nprobe lists
-    qc = (jnp.sum(q * q, 1)[:, None] + jnp.sum(centroids * centroids, 1)[None, :]
-          - 2.0 * jnp.matmul(q, centroids.T, precision="highest"))
+    qc = expanded_sq_dists(q, centroids)
     _, probes = select_k(qc, nprobe, select_min=True)         # (nq, nprobe)
 
     cand_vecs = list_vecs[probes]          # (nq, nprobe, max_len, d)
@@ -174,7 +182,7 @@ def ivf_flat_build(X, params: IVFFlatParams,
     expects(params.nlist <= m, "ivf_flat_build: nlist > n_vectors")
     _check_metric("ivf_flat_build", metric)
     centroids, labels = _coarse_assign(X, params.nlist, seed)
-    table, max_len = _build_lists(np.asarray(labels), params.nlist, None)
+    table, max_len = _build_lists(np.asarray(labels), params.nlist)
     table_j = jnp.asarray(table)
     gather = jnp.where(table_j >= 0, table_j, 0)
     lists = X[gather] * (table_j >= 0)[..., None]
@@ -194,8 +202,10 @@ def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
     """Search an IVF-Flat index (reference approx_knn_search, ann.hpp:71);
     ``nprobe`` defaults to the build params' value."""
     q = jnp.asarray(queries)
+    nprobe = index.nprobe if nprobe is None else nprobe
+    expects(nprobe >= 1, "ivf_flat_search: nprobe must be >= 1")
     return _ivf_flat_search_jit(index.centroids, index.lists, index.list_ids,
-                                q, k, nprobe or index.nprobe,
+                                q, k, nprobe,
                                 DistanceType(int(index.metric)))
 
 
@@ -231,7 +241,7 @@ def ivf_pq_build(X, params: IVFPQParams,
     codebooks = jnp.stack(codebooks)                  # (M, ksub, dsub)
     codes_flat = jnp.stack(codes_flat, axis=1)        # (m, M)
 
-    table, max_len = _build_lists(np.asarray(labels), params.nlist, None)
+    table, max_len = _build_lists(np.asarray(labels), params.nlist)
     table_j = jnp.asarray(table)
     gather = jnp.where(table_j >= 0, table_j, 0)
     codes = codes_flat[gather]                        # (nlist, max_len, M)
@@ -248,10 +258,7 @@ def _ivf_pq_search_jit(centroids, codebooks, all_codes, list_ids, q, k,
     nq, d = q.shape
     nprobe = min(nprobe, nlist)
 
-    qc = (jnp.sum(q * q, 1)[:, None]
-          + jnp.sum(centroids * centroids, 1)[None, :]
-          - 2.0 * jnp.matmul(q, centroids.T, precision="highest"))
-    qc = jnp.maximum(qc, 0.0)
+    qc = expanded_sq_dists(q, centroids)
     _, probes = select_k(qc, nprobe, select_min=True)   # (nq, nprobe)
 
     # ADC tables per (query, probed list): residual = q - centroid, so the
@@ -281,8 +288,10 @@ def _ivf_pq_search_jit(centroids, codebooks, all_codes, list_ids, q, k,
 def ivf_pq_search(index: IVFPQIndex, queries, k: int,
                   nprobe: Optional[int] = None):
     q = jnp.asarray(queries)
+    nprobe = index.nprobe if nprobe is None else nprobe
+    expects(nprobe >= 1, "ivf_pq_search: nprobe must be >= 1")
     return _ivf_pq_search_jit(index.centroids, index.codebooks, index.codes,
-                              index.list_ids, q, k, nprobe or index.nprobe,
+                              index.list_ids, q, k, nprobe,
                               DistanceType(int(index.metric)))
 
 
@@ -309,7 +318,7 @@ def ivf_sq_build(X, params: IVFSQParams,
     scale = jnp.where(scale == 0, 1.0, scale)
     q_all = jnp.clip(jnp.round((resid - lo) / scale), 0, 255).astype(jnp.uint8)
 
-    table, _ = _build_lists(np.asarray(labels), params.nlist, None)
+    table, _ = _build_lists(np.asarray(labels), params.nlist)
     table_j = jnp.asarray(table)
     gather = jnp.where(table_j >= 0, table_j, 0)
     q_data = q_all[gather]
@@ -327,9 +336,7 @@ def _ivf_sq_search_jit(centroids, q_data, scale, offset, list_ids,
     nprobe = min(nprobe, nlist)
     # probe, then dequantize only the probed lists (the whole store stays
     # uint8 in HBM — the memory point of scalar quantization)
-    qc = (jnp.sum(q * q, 1)[:, None]
-          + jnp.sum(centroids * centroids, 1)[None, :]
-          - 2.0 * jnp.matmul(q, centroids.T, precision="highest"))
+    qc = expanded_sq_dists(q, centroids)
     _, probes = select_k(qc, nprobe, select_min=True)       # (nq, nprobe)
     deq = (q_data[probes].astype(jnp.float32) * scale + offset)
     if encode_residual:
@@ -349,9 +356,11 @@ def ivf_sq_search(index: IVFSQIndex, queries, k: int,
                   nprobe: Optional[int] = None):
     """Search; honors the build-time ``encode_residual`` setting."""
     q = jnp.asarray(queries)
+    nprobe = index.nprobe if nprobe is None else nprobe
+    expects(nprobe >= 1, "ivf_sq_search: nprobe must be >= 1")
     return _ivf_sq_search_jit(index.centroids, index.q_data, index.scale,
                               index.offset, index.list_ids,
-                              q, k, nprobe or index.nprobe,
+                              q, k, nprobe,
                               bool(index.encode_residual),
                               DistanceType(int(index.metric)))
 

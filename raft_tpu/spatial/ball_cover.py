@@ -31,6 +31,7 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pairwise import expanded_sq_dists
 from raft_tpu.spatial.haversine import haversine_distances
 from raft_tpu.spatial.knn import knn_merge_parts
 from raft_tpu.spatial.select_k import select_k
@@ -56,9 +57,7 @@ def _dists(x, y, metric):
     caller's metric is the squared form)."""
     if metric == D.Haversine:
         return haversine_distances(x, y)
-    d2 = (jnp.sum(x * x, 1)[:, None] + jnp.sum(y * y, 1)[None, :]
-          - 2.0 * jnp.matmul(x, y.T, precision="highest"))
-    return jnp.sqrt(jnp.maximum(d2, 0.0))
+    return jnp.sqrt(expanded_sq_dists(x, y))
 
 
 def rbc_build_index(X, metric: DistanceType = D.L2SqrtExpanded,
@@ -85,15 +84,23 @@ def rbc_build_index(X, metric: DistanceType = D.L2SqrtExpanded,
 
     counts = np.bincount(owner, minlength=L)
     gmax = max(int(counts.max()), 1)
-    groups = np.full((L, gmax), -1, np.int32)
-    fill = np.zeros(L, np.int64)
-    order = np.argsort(dist_own)[::-1]  # reference sorts members by dist
-    for i in order:
-        l = owner[i]
-        groups[l, fill[l]] = i
-        fill[l] += 1
-    radius = np.zeros(L, np.float32)
-    np.maximum.at(radius, owner, dist_own)
+
+    from raft_tpu.core import native
+    nat = native.pack_groups(owner, dist_own, L, gmax)
+    if nat is not None:
+        groups64, radius64 = nat
+        groups = groups64.astype(np.int32)
+        radius = radius64.astype(np.float32)
+    else:
+        groups = np.full((L, gmax), -1, np.int32)
+        fill = np.zeros(L, np.int64)
+        order = np.argsort(dist_own)[::-1]  # reference sorts members by dist
+        for i in order:
+            l = owner[i]
+            groups[l, fill[l]] = i
+            fill[l] += 1
+        radius = np.zeros(L, np.float32)
+        np.maximum.at(radius, owner, dist_own)
     return BallCoverIndex(X, landmarks, jnp.asarray(groups),
                           jnp.asarray(radius), metric)
 

@@ -1,0 +1,129 @@
+"""Native host runtime tests: C++ results must equal the Python fallbacks.
+
+The toolchain is part of the image, so these tests require the native
+layer to load (a silent fallback would mask build regressions).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    assert native.native_available(), "native host runtime failed to build/load"
+    assert native.native_version().startswith("raft_tpu_host")
+
+
+class TestDendrogram:
+    def test_matches_python(self):
+        rng = np.random.default_rng(0)
+        m = 40
+        # random spanning tree edges
+        src = np.arange(1, m)
+        dst = np.asarray([rng.integers(0, i) for i in range(1, m)])
+        w = rng.random(m - 1)
+
+        nat = native.build_dendrogram(src, dst, w, m)
+        assert nat is not None
+        children, delta, sizes = nat
+
+        # python reference (force fallback by calling the internals)
+        from raft_tpu.sparse.hierarchy import _UnionFind
+        order = np.argsort(w, kind="stable")
+        s, d, ww = src[order], dst[order], w[order]
+        uf = _UnionFind(m)
+        ref_children = np.zeros((m - 1, 2), np.int64)
+        ref_sizes = np.zeros(m - 1, np.int64)
+        for i in range(m - 1):
+            aa, bb = uf.find(int(s[i])), uf.find(int(d[i]))
+            ref_children[i] = (aa, bb)
+            ref_sizes[i] = uf.size[aa] + uf.size[bb]
+            uf.union(aa, bb)
+        np.testing.assert_array_equal(children, ref_children)
+        np.testing.assert_allclose(delta, ww)
+        np.testing.assert_array_equal(sizes, ref_sizes)
+
+    def test_extract_matches_python(self):
+        rng = np.random.default_rng(1)
+        m = 30
+        src = np.arange(1, m)
+        dst = np.asarray([rng.integers(0, i) for i in range(1, m)])
+        w = rng.random(m - 1)
+        children, _, _ = native.build_dendrogram(src, dst, w, m)
+        for k in [2, 3, 7]:
+            nat = native.extract_clusters(children, k, m)
+            # python path: replicate inline (avoid the native short-circuit)
+            parent = np.full(2 * m - 1, -1, np.int64)
+            for i in range(m - k):
+                nid = m + i
+                parent[children[i, 0]] = nid
+                parent[children[i, 1]] = nid
+
+            def find(x):
+                while parent[x] != -1:
+                    x = parent[x]
+                return x
+
+            roots = np.array([find(i) for i in range(m)])
+            _, ref = np.unique(roots, return_inverse=True)
+            np.testing.assert_array_equal(nat, ref)
+
+
+class TestPacking:
+    def test_build_lists(self):
+        rng = np.random.default_rng(2)
+        labels = rng.integers(0, 7, 100)
+        table, ml = native.build_lists(labels, 7)
+        assert table.shape == (7, ml)
+        # every row id appears exactly once, in its own list
+        flat = table[table >= 0]
+        assert sorted(flat) == list(range(100))
+        for l in range(7):
+            members = table[l][table[l] >= 0]
+            assert (labels[members] == l).all()
+
+    def test_pack_groups(self):
+        rng = np.random.default_rng(3)
+        m, L = 50, 5
+        owner = rng.integers(0, L, m)
+        dist = rng.random(m)
+        gmax = int(np.bincount(owner, minlength=L).max())
+        groups, radius = native.pack_groups(owner, dist, L, gmax)
+        for l in range(L):
+            members = groups[l][groups[l] >= 0]
+            assert (owner[members] == l).all()
+            # descending distance order
+            dd = dist[members]
+            assert (np.diff(dd) <= 1e-12).all()
+            if len(members):
+                np.testing.assert_allclose(radius[l], dist[owner == l].max())
+
+
+class TestArena:
+    def test_alloc_stats(self):
+        import ctypes
+        from raft_tpu.core.native import _load
+        lib = _load()
+        before_total, before_use = native.arena_stats()
+        p = lib.rt_alloc(1000)
+        assert p is not None and p % 64 == 0  # 64-byte aligned
+        total, in_use = native.arena_stats()
+        assert in_use >= before_use + 1024  # pow2 size class
+        lib.rt_free(ctypes.c_void_p(p))
+        _, after = native.arena_stats()
+        assert after == before_use
+
+
+class TestIntegration:
+    def test_single_linkage_uses_native(self):
+        # end-to-end single_linkage gives identical labels with native on
+        rng = np.random.default_rng(4)
+        X = np.concatenate([rng.normal(0, 0.3, (15, 2)),
+                            rng.normal(5, 0.3, (15, 2))]).astype(np.float32)
+        from raft_tpu.sparse.hierarchy import single_linkage
+        res = single_linkage(X, n_clusters=2)
+        assert (res.labels[:15] == res.labels[0]).all()
+        assert (res.labels[15:] == res.labels[15]).all()
+        assert res.labels[0] != res.labels[15]
