@@ -32,6 +32,8 @@ class host_arena {
       if (!pool.empty()) {
         void* p = pool.back();
         pool.pop_back();
+        pooled_size_.erase(p);
+        size_of_[p] = cls;
         in_use_ += cls;
         return p;
       }
@@ -47,15 +49,22 @@ class host_arena {
     return p;
   }
 
+  /** Return a block to the pool.  Throws on unknown pointers AND on
+   * double-free: a live block is tracked in size_of_, a pooled one only
+   * in pooled_size_, so freeing twice cannot re-pool the same block. */
   void deallocate(void* p)
   {
     if (p == nullptr) return;
     std::lock_guard<std::mutex> lock(mu_);
     auto it = size_of_.find(p);
     RAFT_TPU_EXPECTS(it != size_of_.end(),
-                     "host_arena: deallocate of unknown pointer");
-    in_use_ -= it->second;
-    free_[it->second].push_back(p);
+                     "host_arena: deallocate of unknown or already-freed "
+                     "pointer");
+    std::size_t cls = it->second;
+    size_of_.erase(it);
+    pooled_size_[p] = cls;
+    in_use_ -= cls;
+    free_[cls].push_back(p);
   }
 
   /** Release all pooled blocks back to the OS. */
@@ -65,7 +74,7 @@ class host_arena {
     for (auto& kv : free_) {
       for (void* p : kv.second) {
         total_ -= kv.first;
-        size_of_.erase(p);
+        pooled_size_.erase(p);
         std::free(p);
       }
       kv.second.clear();
@@ -78,6 +87,7 @@ class host_arena {
   ~host_arena()
   {
     for (auto& kv : size_of_) std::free(kv.first);
+    for (auto& kv : pooled_size_) std::free(kv.first);
   }
 
  private:
@@ -90,7 +100,8 @@ class host_arena {
 
   std::mutex mu_;
   std::map<std::size_t, std::vector<void*>> free_;
-  std::map<void*, std::size_t> size_of_;
+  std::map<void*, std::size_t> size_of_;       // live blocks
+  std::map<void*, std::size_t> pooled_size_;   // pooled (freed) blocks
   std::size_t total_ = 0;
   std::size_t in_use_ = 0;
 };

@@ -47,8 +47,25 @@ void* rt_alloc(std::size_t n)
   }
 }
 
-void rt_free(void* p) { g_arena.deallocate(p); }
-void rt_trim() { g_arena.trim(); }
+/** 0 on success, 1 on unknown pointer / double-free — exceptions must not
+ * cross the C ABI into ctypes (std::terminate otherwise). */
+int rt_free(void* p)
+{
+  try {
+    g_arena.deallocate(p);
+    return 0;
+  } catch (...) {
+    return 1;
+  }
+}
+
+void rt_trim()
+{
+  try {
+    g_arena.trim();
+  } catch (...) {
+  }
+}
 std::size_t rt_arena_total() { return g_arena.total_bytes(); }
 std::size_t rt_arena_in_use() { return g_arena.in_use_bytes(); }
 
@@ -104,6 +121,9 @@ int rt_build_dendrogram(const int64_t* src, const int64_t* dst,
 {
   if (m < 2) return 1;
   int64_t n_edges = m - 1;
+  for (int64_t e = 0; e < n_edges; ++e) {  // leaf ids must be in [0, m)
+    if (src[e] < 0 || src[e] >= m || dst[e] < 0 || dst[e] >= m) return 1;
+  }
   std::vector<int64_t> order(n_edges);
   std::iota(order.begin(), order.end(), 0);
   std::stable_sort(order.begin(), order.end(),

@@ -115,9 +115,11 @@ class VecCache:
         big = jnp.iinfo(jnp.int32).max
         time_adj = state.time.at[sets, hit_way].max(
             jnp.where(any_hit, big, -1))
-        # hits per set in this call (count each hit key once)
-        hits_per_set = jax.ops.segment_sum(
-            any_hit.astype(jnp.int32), sets, num_segments=self.n_sets)
+        # hits per set in this call = number of *distinct ways* hit (a
+        # duplicate hit key must not be double-counted)
+        hit_mark = jnp.zeros((self.n_sets, self.assoc), jnp.int32).at[
+            sets, hit_way].max(any_hit.astype(jnp.int32))
+        hits_per_set = jnp.sum(hit_mark, axis=1)
         free_ways = jnp.maximum(self.assoc - hits_per_set[sets], 1)
         lru_order = jnp.argsort(time_adj[sets], axis=1)
         lru_way = jnp.take_along_axis(

@@ -36,12 +36,20 @@ def _compile() -> Optional[str]:
     if not os.path.exists(src):
         return None
     os.makedirs(_BUILD, exist_ok=True)
+    # compile to a per-pid temp path and atomically rename: concurrent
+    # first-use processes must never load a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-I", os.path.join(_CPP, "include"), src, "-o", _SO]
+           "-I", os.path.join(_CPP, "include"), src, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return _SO
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 

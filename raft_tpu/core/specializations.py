@@ -1,0 +1,101 @@
+"""Precompiled specializations: persistent compile cache + AOT warmup.
+
+Reference: cpp/src/ pre-instantiates the hot templates into
+``libraft_distance.so`` / ``libraft_nn.so`` (cpp/CMakeLists.txt:122-156) so
+consumers skip template compilation.  The XLA analog has two layers:
+
+- a **persistent compilation cache**: every jit executable is serialized to
+  disk keyed by (HLO, flags, platform), so any process on the machine skips
+  recompilation of previously-seen programs (the .so role, but automatic
+  and covering every shape actually used);
+- **AOT warmup**: ``jax.jit(...).lower(...).compile()`` for the known-hot
+  configurations (README-example pairwise shapes, fused kNN tiles), run
+  once at deploy time to populate the cache before first use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "raft_tpu", "xla_cache")
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
+    """Turn on the on-disk executable cache (idempotent).
+
+    Returns the cache directory.  Safe to call before or after other jax
+    use; programs compiled afterwards are cached.
+    """
+    global _enabled_dir
+    path = path or _DEFAULT_CACHE
+    if _enabled_dir == path:
+        return path
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every program regardless of compile time / size
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled_dir = path
+    return path
+
+
+def aot_compile(fn, *example_args):
+    """Ahead-of-time lower + compile ``fn`` for the example arguments'
+    shapes/dtypes; returns the compiled executable (callable).  Static
+    configuration (k, metric, …) should be closed over in ``fn``."""
+    shaped = [jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
+              if not isinstance(a, jax.ShapeDtypeStruct) else a
+              for a in example_args]
+    return jax.jit(fn).lower(*shaped).compile()
+
+
+# --------------------------------------------------------------------- #
+# hot-config registry (the role of cpp/src/*/specializations lists)
+# --------------------------------------------------------------------- #
+def default_specializations() -> Dict[str, Tuple[Any, Tuple]]:
+    """Name → (fn, example_args) for the configurations worth prebuilding:
+    the README pairwise example, the bench pairwise shape, and the fused
+    kNN step (reference cpp/src/distance/specializations + cpp/src/nn)."""
+    from raft_tpu.distance import DistanceType, pairwise_distance
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    f32 = jnp.float32
+    specs: Dict[str, Tuple[Any, Tuple]] = {}
+
+    def pw(metric):
+        return lambda x, y: pairwise_distance(x, y, metric)
+
+    readme = (jax.ShapeDtypeStruct((1024, 64), f32),
+              jax.ShapeDtypeStruct((1024, 64), f32))
+    bench = (jax.ShapeDtypeStruct((8192, 128), f32),
+             jax.ShapeDtypeStruct((8192, 128), f32))
+    specs["pairwise_l2sqrt_1k_64"] = (pw(DistanceType.L2SqrtExpanded), readme)
+    specs["pairwise_l2_8k_128"] = (pw(DistanceType.L2Expanded), bench)
+    specs["pairwise_cosine_8k_128"] = (pw(DistanceType.CosineExpanded), bench)
+    specs["pairwise_l1_1k_64"] = (pw(DistanceType.L1), readme)
+
+    knn_fn = lambda ix, q: fused_l2_knn(ix, q, 100)
+    specs["fused_l2_knn_100"] = (
+        knn_fn, (jax.ShapeDtypeStruct((65536, 128), f32),
+                 jax.ShapeDtypeStruct((1024, 128), f32)))
+    return specs
+
+
+def warmup(names: Optional[Sequence[str]] = None,
+           cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Compile the named specializations (all by default) into the
+    persistent cache; returns name → compiled executable."""
+    enable_persistent_cache(cache_dir)
+    registry = default_specializations()
+    out = {}
+    for name in (names or registry.keys()):
+        fn, args = registry[name]
+        out[name] = aot_compile(fn, *args)
+    return out

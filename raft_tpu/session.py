@@ -1,0 +1,133 @@
+"""Cluster session orchestration: the Dask/`Comms` lifecycle, TPU-native.
+
+Reference: python/raft/dask/common/comms.py — the ``Comms`` session object
+(:37) generates an NCCL unique id (:136-169), runs ``_func_init_all`` on
+every Dask worker (:414-460) to init NCCL/UCX and
+``inject_comms_on_handle``, keeps a per-worker state dict
+(``get_raft_comm_state`` :266), and tears everything down in ``destroy``;
+``local_handle(sessionId)`` (:247) fetches a worker's injected handle.
+
+TPU-native mapping: JAX is SPMD-single-controller, so "workers" are mesh
+devices driven by one process (or one process per host with
+``jax.distributed.initialize`` playing the NCCL-uid bootstrap role —
+coordinator address instead of out-of-band uid exchange).  The session
+object keeps the reference's lifecycle and lookup API so consumer code
+(cuML-style) ports unchanged.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, Optional
+
+import jax
+
+from raft_tpu.comms import HostComms, default_mesh
+from raft_tpu.core.error import expects
+from raft_tpu.core.handle import Handle
+
+# module-level session registry (the reference keeps worker-local state
+# dicts keyed by sessionId, comms.py:266)
+_sessions: Dict[str, "Comms"] = {}
+
+
+def inject_comms_on_handle(handle: Handle, comms: HostComms) -> None:
+    """Attach an initialized communicator to a handle (reference
+    comms_utils.pyx inject_comms_on_handle → helper.hpp:39)."""
+    handle.set_comms(comms)
+    handle.mesh = comms.mesh
+
+
+class Comms:
+    """Communicator session over a device mesh (reference Comms,
+    python/raft/dask/common/comms.py:37).
+
+    Parameters
+    ----------
+    comms_p2p:
+        Whether tagged p2p will be used (the reference's UCX flag; here
+        p2p rides the same XLA collectives, so this is informational).
+    mesh:
+        Device mesh to span; defaults to all local devices on a 1-D mesh.
+    coordinator_address / num_processes / process_id:
+        Multi-host bootstrap via ``jax.distributed.initialize`` — the
+        NCCL-unique-id exchange analog.  Leave None for single-process.
+    """
+
+    def __init__(self, comms_p2p: bool = False, mesh=None,
+                 coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 verbose: bool = False):
+        self.comms_p2p = comms_p2p
+        self.sessionId = uuid.uuid4().hex
+        self._mesh = mesh
+        self._coordinator = coordinator_address
+        self._num_processes = num_processes
+        self._process_id = process_id
+        self.verbose = verbose
+        self.initialized = False
+        self.comms: Optional[HostComms] = None
+        self.handle: Optional[Handle] = None
+        self._owns_distributed = False
+
+    # -- lifecycle (reference init/destroy, comms.py:171,228) ---------- #
+    def init(self) -> "Comms":
+        if self.initialized:
+            return self
+        if self._coordinator is not None:
+            # multi-host bring-up: coordination service replaces the
+            # out-of-band NCCL uid exchange (SURVEY.md §3.3)
+            jax.distributed.initialize(
+                coordinator_address=self._coordinator,
+                num_processes=self._num_processes,
+                process_id=self._process_id)
+            self._owns_distributed = True
+        mesh = self._mesh if self._mesh is not None else default_mesh()
+        self.comms = HostComms(mesh)
+        self.handle = Handle(mesh=mesh)
+        inject_comms_on_handle(self.handle, self.comms)
+        _sessions[self.sessionId] = self
+        self.initialized = True
+        if self.verbose:
+            print(f"Initialized comms session {self.sessionId} over "
+                  f"{mesh.devices.size} devices")
+        return self
+
+    def destroy(self) -> None:
+        """Tear down and deregister (reference destroy, comms.py:228 —
+        which shuts down NCCL/UCX; here the coordination service)."""
+        _sessions.pop(self.sessionId, None)
+        self.comms = None
+        self.handle = None
+        if self._owns_distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            self._owns_distributed = False
+        self.initialized = False
+
+    def __enter__(self) -> "Comms":
+        return self.init()
+
+    def __exit__(self, *exc) -> None:
+        self.destroy()
+
+
+def get_raft_comm_state(session_id: str) -> Dict:
+    """Session state dict (reference get_raft_comm_state, comms.py:266)."""
+    s = _sessions.get(session_id)
+    if s is None:
+        return {}
+    return {"sessionId": s.sessionId, "comms": s.comms,
+            "handle": s.handle, "nworkers": s.comms.get_size()}
+
+
+def local_handle(session_id: str) -> Handle:
+    """Fetch the session's injected handle (reference local_handle,
+    comms.py:247)."""
+    s = _sessions.get(session_id)
+    expects(s is not None and s.initialized,
+            "local_handle: no initialized session %s", session_id)
+    return s.handle
