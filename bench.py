@@ -1,9 +1,20 @@
 #!/usr/bin/env python
-"""Headline benchmark — prints ONE JSON line.
+"""Headline benchmark — prints ONE JSON line, always.
 
-Measures the BASELINE.md configs that exist so far, and reports the
-north-star metric: brute-force kNN QPS at 1M x 128d k=100 when the spatial
-module is available, else pairwise-L2 Gpairs/sec/chip.
+Measures the BASELINE.md configs: the north-star brute-force kNN QPS at
+1M x 128d k=100 (config #3) as the headline metric, with pairwise-L2
+Gpairs/s (config #1/#2 family) and a small spectral-partition run
+(config #4) in ``detail``.
+
+Robustness (round-1 postmortem: the TPU backend failed to initialize and
+the bench emitted nothing):
+
+- the backend is probed in a SUBPROCESS with a timeout + retries before
+  any in-process JAX work, so a hung PJRT init cannot hang the bench;
+- if the probe fails, the bench re-execs itself pinned to CPU with
+  scaled-down shapes and reports honestly (``fallback`` in detail);
+- every section and the whole main are wrapped so any failure still
+  prints a JSON line (with an ``error`` field) and exits 0.
 
 Timing methodology: the device may sit behind a high-latency transport
 where per-call host timing (and even block_until_ready) is unreliable, so
@@ -14,28 +25,68 @@ single-iteration run to cancel fixed dispatch/fetch latency.
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 baseline constant is an A100 estimate for the same op derived from the
 north-star target ("within 1.5x of A100 wall-clock"):
-- pairwise L2 f32: A100 sustains ~50 Gpairs/s at k=128 (19.5 TF/s fp32 FMA
-  with the fused kernel ~65% efficient).  vs_baseline = ours / 50.
 - brute-force kNN 1M x 128 k=100: FAISS-class A100 throughput ~20k QPS.
   vs_baseline = ours / 20000.
+- pairwise L2 f32: A100 sustains ~50 Gpairs/s at k=128.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+KNN_BASELINE_QPS = 20000.0
+PAIRWISE_BASELINE_GPAIRS = 50.0
+_FALLBACK_ENV = "RAFT_TPU_BENCH_CPU_FALLBACK"
+
+PROBE_SRC = """
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.float32)
+v = float((x @ x)[0, 0])
+assert v == 128.0, v
+print("PROBE_OK", jax.devices()[0].device_kind)
+"""
+
+
+def probe_backend(timeout=180, attempts=2):
+    """Run a tiny matmul in a subprocess; returns (ok, info-string).
+
+    A subprocess is the only safe way to test PJRT init: round 1 showed
+    it can either raise UNAVAILABLE or hang indefinitely, and a hang in
+    the bench process itself would produce no JSON at all.  Worst case
+    here is ~6 min of probing before the CPU fallback kicks in — kept
+    well under any plausible harness timeout.
+    """
+    last = ""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            out = (r.stdout or "") + (r.stderr or "")
+            if r.returncode == 0 and "PROBE_OK" in r.stdout:
+                kind = r.stdout.split("PROBE_OK", 1)[1].strip()
+                return True, kind
+            last = out[-500:]
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out after {timeout}s"
+        if i + 1 < attempts:
+            time.sleep(5)
+    return False, last
 
 
 def time_chained(step, x, iters):
     """Seconds per call of ``step(x) -> array``, measured by chaining
     ``iters`` data-dependent calls in one jit and differencing against a
     1-iteration run to cancel fixed latency."""
+    import jax
+    import jax.numpy as jnp
 
     def chained(n):
         @jax.jit
@@ -43,10 +94,8 @@ def time_chained(step, x, iters):
             def body(carry, _):
                 out = step(carry)
                 # data dependency without changing the value: adds 0.0
-                # derived from a FULL reduction of the output — every
-                # element feeds the carry, so XLA cannot slice-narrow the
-                # benchmarked op to a sub-computation (and the sum is not
-                # constant-foldable since the output could be non-finite)
+                # derived from a FULL reduction of the output, so XLA
+                # cannot slice-narrow the benchmarked op
                 return carry + jnp.sum(out) * 0.0, None
 
             final, _ = jax.lax.scan(body, x0, None, length=n)
@@ -67,63 +116,150 @@ def time_chained(step, x, iters):
     return max((t_n - t_1) / (iters - 1), 1e-9)
 
 
-def bench_knn():
+def bench_knn(fallback):
+    """North star (BASELINE.md config #3): brute-force kNN 1M x 128 k=100."""
+    import jax.numpy as jnp
+    import numpy as np
+
     from raft_tpu.spatial import brute_force_knn
 
-    n_index, n_query, k_dim, k = 1_000_000, 10_000, 128, 100
+    if fallback:  # CPU can't sustain the 2.56-TFLOP batch; scale honestly
+        n_index, n_query, dim, k, iters = 100_000, 512, 128, 100, 2
+    else:
+        n_index, n_query, dim, k, iters = 1_000_000, 10_000, 128, 100, 4
     rng = np.random.default_rng(42)
-    index = jnp.array(rng.standard_normal((n_index, k_dim)), dtype=jnp.float32)
-    queries = jnp.array(rng.standard_normal((n_query, k_dim)), dtype=jnp.float32)
+    index = jnp.array(rng.standard_normal((n_index, dim)), dtype=jnp.float32)
+    queries = jnp.array(rng.standard_normal((n_query, dim)), dtype=jnp.float32)
 
     def step(q):
-        dists, idx = brute_force_knn([index], q, k)
+        dists, _ = brute_force_knn([index], q, k)
         return dists
 
-    dt = time_chained(step, queries, iters=4)
+    dt = time_chained(step, queries, iters=iters)
     qps = n_query / dt
-    return {
-        "metric": "knn_qps_1M_128d_k100",
-        "value": round(qps, 1),
-        "unit": "queries/s",
-        "vs_baseline": round(qps / 20000.0, 3),
-        "detail": {"seconds_per_batch": round(dt, 4), "n_query": n_query},
+    # per-query work scales with n_index, so normalize the scaled-down
+    # fallback config to its 1M-index equivalent before comparing against
+    # the 1M-config A100 baseline constant
+    qps_1m_equiv = qps * (n_index / 1_000_000)
+    return qps, qps_1m_equiv, {
+        "seconds_per_batch": round(dt, 4),
+        "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
     }
 
 
-def bench_pairwise():
+def bench_pairwise(fallback):
+    """BASELINE.md config #1 family: pairwise L2 throughput."""
+    import jax.numpy as jnp
+    import numpy as np
+
     from raft_tpu.distance import DistanceType, pairwise_distance
 
-    m = n = 8192
-    k = 128
+    m = n = 2048 if fallback else 8192
+    dim = 128
     rng = np.random.default_rng(42)
-    x = jnp.array(rng.standard_normal((m, k)), dtype=jnp.float32)
-    y = jnp.array(rng.standard_normal((n, k)), dtype=jnp.float32)
+    x = jnp.array(rng.standard_normal((m, dim)), dtype=jnp.float32)
+    y = jnp.array(rng.standard_normal((n, dim)), dtype=jnp.float32)
 
     def step(a):
         return pairwise_distance(a, y, DistanceType.L2Expanded)
 
-    dt = time_chained(step, x, iters=16)
+    dt = time_chained(step, x, iters=4 if fallback else 16)
     gpairs = m * n / dt / 1e9
     return {
-        "metric": "pairwise_l2_gpairs_per_sec",
-        "value": round(gpairs, 2),
-        "unit": "Gpairs/s (m=n=8192, k=128, f32)",
-        "vs_baseline": round(gpairs / 50.0, 3),
+        "gpairs_per_sec": round(gpairs, 2),
+        "shape": [m, n, dim],
+        "vs_a100_estimate": round(gpairs / PAIRWISE_BASELINE_GPAIRS, 3),
+    }
+
+
+def bench_spectral(fallback):
+    """BASELINE.md config #4: Lanczos -> spectral partition on a CSR graph."""
+    import numpy as np
+
+    from raft_tpu.sparse.formats import COO
+    from raft_tpu.sparse.spectral import fit_embedding
+
+    n = 512 if fallback else 2048
+    rng = np.random.default_rng(0)
+    # ring + random chords: connected, sparse
+    src = np.arange(n, dtype=np.int64)
+    dst = (src + 1) % n
+    extra = rng.integers(0, n, size=(2 * n, 2), dtype=np.int64)
+    extra = extra[extra[:, 0] != extra[:, 1]]
+    rows = np.concatenate([src, dst, extra[:, 0], extra[:, 1]])
+    cols = np.concatenate([dst, src, extra[:, 1], extra[:, 0]])
+    vals = np.ones(rows.shape[0], dtype=np.float32)
+    coo = COO(rows.astype(np.int32), cols.astype(np.int32), vals, shape=(n, n))
+    t0 = time.perf_counter()
+    emb = fit_embedding(coo, n_components=4)
+    np.asarray(emb)
+    dt = time.perf_counter() - t0
+    return {"seconds": round(dt, 3), "n_vertices": n, "n_components": 4}
+
+
+def run_benches(fallback, device_kind):
+    detail = {"fallback": "cpu" if fallback else None, "device": device_kind}
+    errors = {}
+
+    qps = qps_1m_equiv = 0.0
+    try:
+        qps, qps_1m_equiv, knn_detail = bench_knn(fallback)
+        detail["knn"] = knn_detail
+    except Exception:
+        errors["knn"] = traceback.format_exc()[-800:]
+    for name, fn in (("pairwise", bench_pairwise), ("spectral", bench_spectral)):
+        try:
+            detail[name] = fn(fallback)
+        except Exception:
+            errors[name] = traceback.format_exc()[-800:]
+    if errors:
+        detail["errors"] = errors
+
+    return {
+        "metric": "knn_qps_1M_128d_k100" if not fallback
+        else "knn_qps_100k_128d_k100_cpu_fallback",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps_1m_equiv / KNN_BASELINE_QPS, 4),
+        "detail": detail,
     }
 
 
 def main():
-    import importlib.util
-
-    # explicit existence check: a broken import inside raft_tpu.spatial must
-    # surface as an error, not silently fall back to the wrong metric
-    if importlib.util.find_spec("raft_tpu.spatial") is not None:
-        result = bench_knn()
+    fallback = os.environ.get(_FALLBACK_ENV) == "1"
+    if not fallback:
+        ok, info = probe_backend()
+        if not ok:
+            # backend dead: re-exec pinned to CPU so this process never
+            # touches the broken backend (in-process platform switching
+            # after a failed init is not reliable)
+            env = dict(os.environ)
+            env[_FALLBACK_ENV] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            env["RAFT_TPU_PROBE_ERROR"] = info[-400:]
+            os.execve(sys.executable, [sys.executable, __file__], env)
     else:
-        result = bench_pairwise()
-    result["device"] = str(jax.devices()[0].device_kind)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or None)
+    device_kind = str(jax.devices()[0].device_kind)
+    result = run_benches(fallback, device_kind)
+    if fallback and os.environ.get("RAFT_TPU_PROBE_ERROR"):
+        result["detail"]["probe_error"] = os.environ["RAFT_TPU_PROBE_ERROR"]
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        print(json.dumps({
+            "metric": "knn_qps_1M_128d_k100",
+            "value": 0.0,
+            "unit": "queries/s",
+            "vs_baseline": 0.0,
+            "error": traceback.format_exc()[-1500:],
+        }))
+        sys.exit(0)

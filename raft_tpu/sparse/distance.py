@@ -94,12 +94,26 @@ def pairwise_distance(a: CSR, b: CSR,
     n_tiles_b = -(-n // bn)
 
     out = jnp.zeros((n_tiles_a * bm, n_tiles_b * bn), dtype=jnp.float32)
-    # densify each b-tile once, not once per a-tile
-    b_tiles = [densify_rows(b, ib * bn, bn) for ib in range(n_tiles_b)]
-    for ia in range(n_tiles_a):
+    # densify each b-tile once, not once per a-tile; lax.map keeps the HLO
+    # a single block program instead of n_tiles_b inlined scatters
+    b_tiles = jax.lax.map(lambda ib: densify_rows(b, ib * bn, bn),
+                          jnp.arange(n_tiles_b))
+
+    # The reference engine is one load-balanced kernel over all blocks
+    # (detail/coo_spmv.cuh:49); the analog here is a single doubly-nested
+    # fori_loop whose body is ONE densify + ONE dense-metric block, so HLO
+    # size is O(1) in tile count (a Python loop would inline
+    # n_tiles_a * n_tiles_b block programs and explode compile time).
+    def a_tile_step(ia, out):
         xa = densify_rows(a, ia * bm, bm)
-        for ib, xb in enumerate(b_tiles):
+
+        def b_tile_step(ib, out):
+            xb = jax.lax.dynamic_index_in_dim(b_tiles, ib, 0, keepdims=False)
             blk = block_pairwise(xa, xb, metric, metric_arg)
-            out = jax.lax.dynamic_update_slice(out, blk.astype(jnp.float32),
-                                               (ia * bm, ib * bn))
+            return jax.lax.dynamic_update_slice(
+                out, blk.astype(jnp.float32), (ia * bm, ib * bn))
+
+        return jax.lax.fori_loop(0, n_tiles_b, b_tile_step, out)
+
+    out = jax.lax.fori_loop(0, n_tiles_a, a_tile_step, out)
     return out[:m, :n]

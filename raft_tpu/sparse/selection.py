@@ -43,7 +43,11 @@ def brute_force_knn(idx: CSR, query: CSR, k: int,
     Returns (distances, indices) of shape (n_query, k), best-first.
     Reference: sparse/selection/knn.hpp:52.
     """
+    from raft_tpu.core.error import expects
+
     m, nq = idx.n_rows, query.n_rows
+    expects(0 < k <= m, "sparse brute_force_knn: k=%d out of range for "
+            "n_index=%d", k, m)
     select_min = metric != D.InnerProduct
     bi = min(batch_size_index, m)
     bq = min(batch_size_query, nq)
@@ -51,33 +55,42 @@ def brute_force_knn(idx: CSR, query: CSR, k: int,
     n_tiles_q = -(-nq // bq)
 
     worst = jnp.inf if select_min else -jnp.inf
-    all_d = []
-    all_i = []
-    # densify each index tile once, not once per query tile
-    idx_tiles = [densify_rows(idx, ii * bi, bi) for ii in range(n_tiles_i)]
-    for iq in range(n_tiles_q):
+    # densify each index tile once, not once per query tile; lax.map /
+    # fori_loop keep the HLO O(1) in tile count (one block program, like
+    # the reference's single batched engine, selection/detail/knn.cuh:117)
+    idx_tiles = jax.lax.map(lambda ii: densify_rows(idx, ii * bi, bi),
+                            jnp.arange(n_tiles_i))
+
+    def index_tile_step(xq, ii, carry):
+        run_d, run_i = carry
+        xi = jax.lax.dynamic_index_in_dim(idx_tiles, ii, 0, keepdims=False)
+        blk = block_pairwise(xq, xi, metric, metric_arg).astype(jnp.float32)
+        # mask out padding index rows of the last tile
+        col_ids = ii * bi + jnp.arange(bi)
+        blk = jnp.where(col_ids[None, :] < m, blk, worst)
+        bd, bi_local = select_k(blk, min(k, bi), select_min=select_min)
+        if bd.shape[1] < k:  # pad block result up to k candidates
+            pad = k - bd.shape[1]
+            bd = jnp.pad(bd, ((0, 0), (0, pad)), constant_values=worst)
+            bi_local = jnp.pad(bi_local, ((0, 0), (0, pad)),
+                               constant_values=-1)
+        # translate only valid entries: pads stay -1 instead of becoming
+        # plausible-looking ids like ii*bi - 1
+        bi_glob = jnp.where(bi_local >= 0, bi_local + ii * bi, -1)
+        cand_d = jnp.stack([run_d, bd])
+        cand_i = jnp.stack([run_i, bi_glob])
+        return knn_merge_parts(cand_d, cand_i, k, select_min=select_min)
+
+    def query_tile(iq):
         xq = densify_rows(query, iq * bq, bq)
-        run_d = jnp.full((bq, k), worst, dtype=jnp.float32)
-        run_i = jnp.full((bq, k), -1, dtype=jnp.int32)
-        for ii, xi in enumerate(idx_tiles):
-            blk = block_pairwise(xq, xi, metric, metric_arg).astype(jnp.float32)
-            # mask out padding index rows of the last tile
-            col_ids = ii * bi + jnp.arange(bi)
-            blk = jnp.where(col_ids[None, :] < m, blk, worst)
-            bd, bi_local = select_k(blk, min(k, bi), select_min=select_min)
-            if bd.shape[1] < k:  # pad block result up to k candidates
-                pad = k - bd.shape[1]
-                bd = jnp.pad(bd, ((0, 0), (0, pad)), constant_values=worst)
-                bi_local = jnp.pad(bi_local, ((0, 0), (0, pad)),
-                                   constant_values=-1)
-            cand_d = jnp.stack([run_d, bd])
-            cand_i = jnp.stack([run_i, bi_local + ii * bi])
-            run_d, run_i = knn_merge_parts(cand_d, cand_i, k,
-                                           select_min=select_min)
-        all_d.append(run_d)
-        all_i.append(run_i)
-    out_d = jnp.concatenate(all_d, axis=0)[:nq]
-    out_i = jnp.concatenate(all_i, axis=0)[:nq]
+        init = (jnp.full((bq, k), worst, dtype=jnp.float32),
+                jnp.full((bq, k), -1, dtype=jnp.int32))
+        return jax.lax.fori_loop(
+            0, n_tiles_i, functools.partial(index_tile_step, xq), init)
+
+    out_d, out_i = jax.lax.map(query_tile, jnp.arange(n_tiles_q))
+    out_d = out_d.reshape(n_tiles_q * bq, k)[:nq]
+    out_i = out_i.reshape(n_tiles_q * bq, k)[:nq]
     return out_d, out_i
 
 

@@ -83,3 +83,29 @@ def test_knn_graph_symmetric():
     np.testing.assert_allclose(dense, dense.T, atol=1e-6)
     # every vertex keeps at least k-1 neighbors (self edge has weight 0)
     assert ((dense > 0).sum(axis=1) >= 3).all()
+
+
+def test_sparse_pairwise_hlo_size_constant_in_tiles():
+    """Compile-time scaling: the batched driver must emit O(1) HLO in the
+    number of tiles (one fori_loop block program), not inline every
+    (a-tile, b-tile) pair — reference engine is likewise a single kernel
+    (detail/coo_spmv.cuh:49).  At 100k x 100k with 1k batches that is the
+    difference between seconds and hours of compile."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    dense = (rng.random((256, 8)) * (rng.random((256, 8)) < 0.3)).astype(
+        np.float32)
+    c = CSR.from_dense(dense, capacity=1024)
+
+    def hlo_len(batch):
+        jaxpr = jax.make_jaxpr(
+            lambda x, y: pairwise_distance(x, y, D.L2Expanded,
+                                           batch_size_a=batch,
+                                           batch_size_b=batch)
+        )(c, c)
+        return len(str(jaxpr))
+
+    few_tiles = hlo_len(128)   # 2x2 tiles
+    many_tiles = hlo_len(16)   # 16x16 tiles = 64x the block count
+    assert many_tiles < 2 * few_tiles, (few_tiles, many_tiles)
