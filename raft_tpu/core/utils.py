@@ -13,6 +13,27 @@ from __future__ import annotations
 from raft_tpu.core.error import expects
 
 
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend is TPU hardware.
+
+    The platform name is not always ``"tpu"``: tunneled/proxied PJRT
+    plugins register under their own name (e.g. ``axon``) while still
+    driving a real TPU and canonicalizing to the ``tpu`` lowering path,
+    so checking ``jax.default_backend() == "tpu"`` alone would silently
+    route hot paths (compiled Pallas kernels) to their interpret/XLA
+    fallbacks on exactly the hardware they were built for.
+    """
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return "tpu" in (getattr(dev, "device_kind", "") or "").lower()
+
+
 def ceildiv(a: int, b: int) -> int:
     """Ceiling division (reference cuda_utils.cuh:109 ``raft::ceildiv``)."""
     return -(-a // b)

@@ -1,0 +1,232 @@
+"""Fused L2-distance + top-k selection Pallas kernel.
+
+TPU-native re-design of the reference's crown-jewel selection path:
+``fusedL2kNN`` (cpp/include/raft/spatial/knn/detail/fused_l2_knn.cuh:196)
++ the forked-FAISS warp/block select heaps
+(detail/warp_select_faiss.cuh, detail/block_select_faiss.cuh).  One CUDA
+kernel there computes a distance tile and immediately runs warp-select
+over it so the (n_queries, n_index) matrix never reaches global memory.
+
+There are no warp shuffles or per-thread heaps on a systolic machine, so
+the selection is redesigned around what the VPU does well — full-width
+vector compares and lane permutations:
+
+- grid = (query_tiles, index_tiles), index innermost; the running top-k
+  for the current query tile lives in VMEM scratch across index tiles
+  (the Pallas matmul-accumulator pattern), so the distance tile is
+  consumed in VMEM and never round-trips HBM.
+- each index tile: MXU computes the expanded-form distance tile
+  ``qn + xn - 2 q@xT``; a *threshold gate* (any distance below the
+  current k-th best?) drives a while-loop that usually runs ZERO
+  iterations once the top-k warms up — the analog of the reference
+  warp-select's early-out compare against the heap limit
+  (warp_select_faiss.cuh thread-queue insert check).
+- each while-loop round extracts at most one candidate per lane group
+  via a strided group-min (a (bm, g, kpad) reshape keeps kpad on the
+  128-lane axis), merges the kpad candidates into the sorted running
+  top-k with a bitonic sort over 2*kpad lanes, masks the extracted
+  elements, and re-checks the gate.  Each group loses one element per
+  round, so the loop is bounded by g rounds; expected rounds after
+  warm-up ~0.  Exactness: the loop only exits when no remaining
+  distance beats the k-th best, so the final buffer is exactly the
+  top-kpad set.
+- the bitonic compare-exchange is lane-parallel: partner values are
+  obtained with two circular lane rolls and an XOR-bit select, payload
+  indices ride along with strict-inequality "take partner" predicates
+  (equal keys keep their own payload, so no id is duplicated or lost).
+
+The running buffer is kept sorted ascending at all times, so the output
+needs no final sort.  Distances returned are squared L2 (the sqrt fixup
+is the caller's postprocess, knn_brute_force_faiss.cuh:367-380).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.utils import ceildiv, is_tpu_backend
+
+_INF = float("inf")
+
+
+def _roll_lanes(x: jnp.ndarray, shift: int, interpret: bool) -> jnp.ndarray:
+    """Circular shift along the lane (last) axis."""
+    if interpret:
+        return jnp.roll(x, shift, axis=1)
+    return pltpu.roll(x, shift, axis=1)
+
+
+def _bitonic_sort_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
+                        interpret: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sort each row ascending by key, carrying an int payload.
+
+    Classic bitonic network over the lane axis (width W = power of two).
+    Stage (size, stride): partner lane = lane XOR stride; ascending
+    blocks where (lane & size) == 0.  Partner fetch = two lane rolls +
+    bit select; O(log^2 W) full-width VPU stages, no scalar loops.
+    """
+    bm, w = keys.shape
+    assert w & (w - 1) == 0, f"bitonic width {w} not a power of two"
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    size = 2
+    while size <= w:
+        stride = size // 2
+        while stride >= 1:
+            fwd_k = _roll_lanes(keys, -stride, interpret)
+            bwd_k = _roll_lanes(keys, stride, interpret)
+            fwd_v = _roll_lanes(vals, -stride, interpret)
+            bwd_v = _roll_lanes(vals, stride, interpret)
+            upper = (lane & stride) != 0          # partner is lane - stride
+            pk = jnp.where(upper, bwd_k, fwd_k)
+            pv = jnp.where(upper, bwd_v, fwd_v)
+            # ascending block → lower lane keeps the min
+            want_min = ((lane & size) == 0) != upper
+            take = jnp.where(want_min, pk < keys, pk > keys)
+            keys = jnp.where(want_min, jnp.minimum(keys, pk),
+                             jnp.maximum(keys, pk))
+            vals = jnp.where(take, pv, vals)
+            stride //= 2
+        size *= 2
+    return keys, vals
+
+
+def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
+                bd_ref, bi_ref, *, kpad, bn, n_index, n_j_tiles, g,
+                precision, interpret):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        bd_ref[:] = jnp.full_like(bd_ref, _INF)
+        bi_ref[:] = jnp.full_like(bi_ref, -1)
+
+    # distance tile on the MXU: qn + xn - 2 q@xT (euclidean.cuh expanded
+    # form); clamp tiny negatives from cancellation
+    acc = jax.lax.dot_general(
+        q_ref[:], x_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dist = qn_ref[:] + xn_ref[:] - 2.0 * acc
+    dist = jnp.maximum(dist, 0.0)
+    # mask padded index rows of the final tile
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    dist = jnp.where(j * bn + col < n_index, dist, _INF)
+
+    bm = dist.shape[0]
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
+    gg_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, g, kpad), 1)
+
+    def gate(state):
+        d, bd, _ = state
+        worst = bd[:, kpad - 1:kpad]
+        return jnp.any(d < worst)
+
+    def extract_merge(state):
+        d, bd, bi = state
+        d3 = d.reshape(bm, g, kpad)
+        gmin = jnp.min(d3, axis=1)                        # (bm, kpad)
+        is_min = d3 == jnp.expand_dims(gmin, 1)
+        gg_star = jnp.min(jnp.where(is_min, gg_iota, g), axis=1)
+        # candidate global id: strided grouping → column = gg*kpad + r
+        cand_i = j * bn + gg_star * kpad + r_iota
+        cand_i = jnp.where(gmin < _INF, cand_i, -1)
+        # mask the extracted element of each group (exactly one: the
+        # lowest-gg argmin)
+        picked = gg_iota == jnp.expand_dims(gg_star, 1)
+        d = jnp.where(picked, _INF, d3).reshape(bm, g * kpad)
+        # merge candidates into the sorted running top-k
+        md = jnp.concatenate([bd, gmin], axis=1)          # (bm, 2*kpad)
+        mi = jnp.concatenate([bi, cand_i], axis=1)
+        md, mi = _bitonic_sort_lanes(md, mi, interpret)
+        return d, md[:, :kpad], mi[:, :kpad]
+
+    _, bd, bi = jax.lax.while_loop(
+        gate, extract_merge, (dist, bd_ref[:], bi_ref[:]))
+    bd_ref[:] = bd
+    bi_ref[:] = bi
+
+    @pl.when(j == n_j_tiles - 1)
+    def _emit():
+        od_ref[:] = bd_ref[:]
+        oi_ref[:] = bi_ref[:]
+
+
+def fused_knn_tile(
+    index: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    block_q: int = 256,
+    block_n: int = 1024,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest index rows per query under squared L2, fused on-chip.
+
+    Returns (distances, indices): (n_queries, k) ascending squared-L2
+    and int32 ids; exact (matches a full-sort reference on distinct
+    distances; ties may resolve to different ids of equal distance).
+    """
+    expects(index.ndim == 2 and queries.ndim == 2
+            and index.shape[1] == queries.shape[1],
+            "fused_knn_tile: shape mismatch")
+    n, d = index.shape
+    nq = queries.shape[0]
+    expects(0 < k <= n, "fused_knn_tile: k=%d out of range for n=%d", k, n)
+    if interpret is None:
+        interpret = not is_tpu_backend()
+
+    # next power of two >= max(k, 128): the bitonic merge width 2*kpad
+    # must be a power of two, and kpad must stay a lane multiple
+    kpad = 128
+    while kpad < k:
+        kpad *= 2
+    bn = max(block_n // kpad, 2) * kpad if block_n >= 2 * kpad else 2 * kpad
+    bn = min(bn, ceildiv(n, kpad) * kpad)
+    g = bn // kpad
+    bm = max(8, min(block_q, ceildiv(nq, 8) * 8) // 8 * 8)
+    dp = ceildiv(d, 128) * 128 if d > 128 else d
+    np_, mp = ceildiv(n, bn) * bn, ceildiv(nq, bm) * bm
+
+    xf = jnp.pad(index.astype(jnp.float32), ((0, np_ - n), (0, dp - d)))
+    qf = jnp.pad(queries.astype(jnp.float32), ((0, mp - nq), (0, dp - d)))
+    xn = jnp.sum(xf * xf, axis=1)[None, :]               # (1, np_)
+    qn = jnp.sum(qf * qf, axis=1)[:, None]               # (mp, 1)
+
+    grid = (mp // bm, np_ // bn)
+    kern = functools.partial(
+        _knn_kernel, kpad=kpad, bn=bn, n_index=n, n_j_tiles=grid[1], g=g,
+        precision=jax.lax.Precision(precision) if precision else None,
+        interpret=interpret)
+    out_d, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, dp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, dp), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mp, kpad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bm, kpad), jnp.float32),
+            pltpu.VMEM((bm, kpad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, xf, qn, xn)
+    return out_d[:nq, :k], out_i[:nq, :k]

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.core.utils import ceildiv
+from raft_tpu.core.utils import ceildiv, is_tpu_backend
 
 
 def _kernel(x_ref, yt_ref, o_ref, acc_ref, *, combine, reduce_kind, epilog,
@@ -84,7 +84,7 @@ def pairwise_tile(
         # Canberra ratios): never truncate back to an integer dtype
         out_dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
 
     # Mosaic requires the last block dim to be 128-divisible or span the
     # whole array, and the second-to-last to be 8-divisible or span it.

@@ -8,11 +8,20 @@ so the (n_queries, n_index) distance matrix never exists in memory.
 It is the fast path of ``brute_force_knn`` for k ≤ 64 / L2 / row-major
 (detail/knn_brute_force_faiss.cuh:297-313).
 
-TPU re-design: the shared tile-scan driver
-(:mod:`raft_tpu.spatial.tiled_knn`) with an MXU-matmul distance tile in
-the expanded ``qn + xn − 2·q@xᵀ`` form.  The reference's smem-merge
-becomes a (k + k)-wide re-selection per tile; high-water memory is
-(n_queries, tile_n).
+TPU re-design, two implementations sharing the same contract:
+
+- ``impl="xla"``: the shared tile-scan driver
+  (:mod:`raft_tpu.spatial.tiled_knn`) with an MXU-matmul distance tile
+  in the expanded ``qn + xn − 2·q@xᵀ`` form.  The reference's
+  smem-merge becomes a (k + k)-wide re-selection per tile; high-water
+  memory is (n_queries, tile_n), which round-trips HBM per tile.
+- ``impl="pallas"``: the fully fused kernel
+  (:mod:`raft_tpu.ops.knn_tile`) — distance tile and running top-k both
+  VMEM-resident, threshold-gated bitonic merge, the true analog of the
+  reference's one-kernel design.
+- ``impl=None`` (default): "pallas" on a real TPU backend, "xla"
+  elsewhere (the Pallas interpreter is orders of magnitude slower than
+  XLA CPU, so interpret-mode is for tests only).
 
 Like the reference kernel, returned distances are *squared* L2; the sqrt
 fixup for L2Sqrt metrics is the caller's postprocess step
@@ -21,11 +30,14 @@ fixup for L2Sqrt metrics is the caller's postprocess step
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.spatial.tiled_knn import tiled_knn
 
 
@@ -35,6 +47,7 @@ def fused_l2_knn(
     k: int,
     tile_n: int = 8192,
     precision: str = "highest",
+    impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest index rows per query under squared L2.
 
@@ -48,7 +61,10 @@ def fused_l2_knn(
         Neighbors per query (k <= n_index).
     tile_n:
         Index rows per scan step; bounds the live distance tile to
-        (n_queries, tile_n).
+        (n_queries, tile_n) (xla impl) / the kernel index-block (pallas).
+    impl:
+        "xla", "pallas", or None = pick per backend (see module doc).
+        Env override: RAFT_TPU_FUSED_KNN_IMPL.
 
     Returns
     -------
@@ -58,6 +74,17 @@ def fused_l2_knn(
     expects(index.ndim == 2 and queries.ndim == 2
             and index.shape[1] == queries.shape[1],
             "fused_l2_knn: shape mismatch")
+    if impl is None:
+        impl = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL") or (
+            "pallas" if is_tpu_backend() else "xla")
+    expects(impl in ("xla", "pallas"),
+            "fused_l2_knn: unknown impl %s", impl)
+    if impl == "pallas":
+        from raft_tpu.ops.knn_tile import fused_knn_tile
+
+        return fused_knn_tile(index, queries, k,
+                              block_n=min(tile_n, 1024),
+                              precision=precision)
     qn = jnp.sum(queries * queries, axis=1)
 
     def tile_dist(q, x_t):

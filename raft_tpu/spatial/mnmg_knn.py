@@ -1,0 +1,165 @@
+"""Multi-node multi-device brute-force kNN over a mesh axis.
+
+Reference: the MNMG mode of ``brute_force_knn`` — each rank searches its
+row partition of the index locally, then results are merged through the
+injected communicator (``comms_t``, cpp/include/raft/comms/comms.hpp:193;
+partition merge ``knn_merge_parts``, detail/knn_brute_force_faiss.cuh:55;
+the Dask orchestration lives consumer-side in cuML).  This is BASELINE.md
+config #5 as a callable library function.
+
+TPU re-design: the reference is multi-controller (one process per GPU,
+explicit NCCL verbs); here the whole computation is ONE SPMD program over
+a ``jax.sharding.Mesh`` axis:
+
+- the index is row-sharded over ``axis`` (the reference's per-rank
+  partitions), queries are replicated — or sharded over an optional
+  second ``query_axis``, the 2-D sub-communicator pattern of the
+  reference's ``handle.set_subcomm`` (handle.hpp:237);
+- each shard runs the local fused distance + top-k;
+- local ids are translated to global ids with the shard offset
+  (reference id_ranges, knn_brute_force_faiss.cuh:241-255);
+- candidates ride ICI via ``all_gather`` along the axis and are
+  re-selected to the global top-k (the ``knn_merge_parts`` heap-merge
+  becomes one wide re-selection) — so the merge compiles to a single
+  XLA collective instead of eager NCCL calls.
+
+The communicator is resolved from (in order) an explicit ``comms``, the
+``handle``'s injected comms (reference ``handle.get_comms()`` idiom),
+an explicit ``mesh``/``axis`` pair, or the handle's mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.host_comms import shard_map
+from raft_tpu.core.error import expects
+from raft_tpu.core.utils import ceildiv
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.spatial.knn import _IP_FAMILY, _search_one_partition
+from raft_tpu.spatial.select_k import select_k
+
+D = DistanceType
+
+
+def _resolve_comms(handle, comms, mesh, axis):
+    """(mesh, axis) from the strongest available source."""
+    if comms is not None:
+        return comms.mesh, comms.axis
+    if handle is not None and handle.comms_initialized():
+        c = handle.get_comms()
+        return c.mesh, c.axis
+    if mesh is not None:
+        expects(axis is not None and axis in mesh.axis_names,
+                "mnmg_knn: axis must name an axis of the given mesh")
+        return mesh, axis
+    if handle is not None and handle.mesh is not None:
+        m = handle.mesh
+        if axis is None:
+            return m, m.axis_names[0]
+        expects(axis in m.axis_names,
+                "mnmg_knn: axis %s not in the handle's mesh", axis)
+        return m, axis
+    from raft_tpu.comms.host_comms import default_mesh
+
+    m = default_mesh()
+    if axis is not None:
+        expects(axis in m.axis_names,
+                "mnmg_knn: axis %s given without a mesh that has it", axis)
+    return m, m.axis_names[0]
+
+
+def mnmg_knn(
+    index: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    metric: DistanceType = D.L2Expanded,
+    metric_arg: float = 2.0,
+    handle=None,
+    comms=None,
+    mesh=None,
+    axis: Optional[str] = None,
+    query_axis: Optional[str] = None,
+    tile_n: int = 8192,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact kNN with the index row-sharded across a mesh axis.
+
+    Parameters
+    ----------
+    index:
+        (n, d) global index rows (sharded over ``axis`` by the program).
+    queries:
+        (nq, d) queries, replicated (or sharded over ``query_axis``).
+    k:
+        Neighbors per query (k <= n).
+    metric, metric_arg:
+        Distance metric; same dispatch as ``brute_force_knn``.
+    handle / comms / mesh+axis:
+        Communicator resolution, strongest first (see module doc).
+    query_axis:
+        Optional second mesh axis to shard queries over; nq must divide
+        by its size.
+
+    Returns
+    -------
+    (distances, indices): (nq, k) global results, best-first, int32
+    global ids; replicated along ``axis`` (and sharded along
+    ``query_axis`` when given).
+    """
+    mesh_, axis_ = _resolve_comms(handle, comms, mesh, axis)
+    expects(index.ndim == 2 and queries.ndim == 2
+            and index.shape[1] == queries.shape[1],
+            "mnmg_knn: index/query dimensionality mismatch")
+    n, d = index.shape
+    nq = queries.shape[0]
+    expects(0 < k <= n, "mnmg_knn: k=%d out of range for n=%d", k, n)
+    size = mesh_.shape[axis_]
+    if query_axis is not None:
+        expects(query_axis in mesh_.axis_names,
+                "mnmg_knn: query_axis %s not in mesh", query_axis)
+        expects(nq % mesh_.shape[query_axis] == 0,
+                "mnmg_knn: nq=%d not divisible by query_axis size %d",
+                nq, mesh_.shape[query_axis])
+
+    rows = ceildiv(n, size)
+    n_pad = rows * size
+    index_p = jnp.pad(index, ((0, n_pad - n), (0, 0)))
+    select_min = metric not in _IP_FAMILY
+    worst = jnp.inf if select_min else -jnp.inf
+    # widen the local k by the pad count: a zero pad row can *beat* real
+    # rows under any metric (its L2 distance is just ||q||^2), so pads may
+    # occupy local top-k slots — the widening guarantees >= k real
+    # candidates survive the post-search mask
+    k_local = min(k + (n_pad - n), rows)
+
+    def shard_fn(ix, q):
+        # local partition search (reference per-partition stream search)
+        d_loc, i_loc = _search_one_partition(ix, q, k_local, metric,
+                                             metric_arg, tile_n)
+        # translate to global ids; mask this shard's padding rows
+        base = lax.axis_index(axis_) * rows
+        gid = (i_loc + base).astype(jnp.int32)
+        invalid = gid >= n
+        d_loc = jnp.where(invalid, worst, d_loc)
+        gid = jnp.where(invalid, -1, gid)
+        # merge across the axis: allgather candidates, one re-selection
+        all_d = lax.all_gather(d_loc, axis_, axis=1, tiled=True)
+        all_i = lax.all_gather(gid, axis_, axis=1, tiled=True)
+        return select_k(all_d, k, select_min=select_min, values=all_i)
+
+    q_spec = P(query_axis, None) if query_axis is not None else P(None, None)
+    fn = shard_map(
+        shard_fn, mesh=mesh_,
+        in_specs=(P(axis_, None), q_spec),
+        out_specs=(q_spec, q_spec),
+        check_rep=False)
+    dist, idx = jax.jit(fn)(index_p, queries)
+
+    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+        dist = jnp.sqrt(jnp.maximum(dist, 0.0))
+    return dist, idx

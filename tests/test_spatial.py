@@ -192,3 +192,57 @@ def test_brute_force_knn_grouped_labels(rng):
     _, idx = brute_force_knn(jnp.asarray(pts), jnp.asarray(pts), k)
     neighbor_labels = labels[np.asarray(idx)]
     assert (neighbor_labels == labels[:, None]).all()
+
+
+# --------------------------------------------------------------------- #
+# fused distance+top-k Pallas kernel (interpret mode on CPU)
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("n,nq,d,k", [
+    (300, 17, 13, 5),         # sub-tile everything, odd sizes
+    (3000, 33, 128, 100),     # multi index tile, kpad==128, north-star k
+    (130, 9, 2, 129),         # k > 128 -> kpad 256, tiny n
+    (900, 7, 16, 300),        # kpad must round to a power of two (512)
+    (2500, 24, 64, 10),
+])
+def test_fused_knn_tile_exact(rng, n, nq, d, k):
+    from raft_tpu.ops.knn_tile import fused_knn_tile
+
+    index = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((nq, d)).astype(np.float32)
+    dist, idx = fused_knn_tile(jnp.asarray(index), jnp.asarray(queries), k)
+    ref_d, ref_i = naive_knn(index, queries, k)
+    np.testing.assert_allclose(np.asarray(dist), ref_d, rtol=1e-4, atol=1e-4)
+    # ties may resolve to different ids of equal distance: compare the
+    # distances at the chosen ids
+    full = ((queries[:, None, :] - index[None, :, :]) ** 2).sum(-1)
+    chosen = np.take_along_axis(full, np.asarray(idx), axis=1)
+    np.testing.assert_allclose(chosen, ref_d, rtol=1e-4, atol=1e-4)
+    assert (np.asarray(idx) >= 0).all() and (np.asarray(idx) < n).all()
+
+
+def test_fused_knn_tile_duplicate_rows(rng):
+    """Duplicate points produce exact-tie distances; the selected set must
+    still be a valid kNN set (no id duplicated within a row)."""
+    from raft_tpu.ops.knn_tile import fused_knn_tile
+
+    base = rng.standard_normal((40, 6)).astype(np.float32)
+    index = np.concatenate([base, base, base])          # every row x3
+    queries = base[:11]
+    dist, idx = fused_knn_tile(jnp.asarray(index), jnp.asarray(queries), 5)
+    idx = np.asarray(idx)
+    for row in idx:
+        assert len(set(row.tolist())) == len(row), row
+    np.testing.assert_allclose(np.asarray(dist)[:, :3], 0.0, atol=1e-5)
+
+
+def test_fused_l2_knn_impl_dispatch(rng):
+    """impl="pallas" and impl="xla" agree through the public entry."""
+    index = rng.standard_normal((600, 32)).astype(np.float32)
+    queries = rng.standard_normal((41, 32)).astype(np.float32)
+    d_x, i_x = fused_l2_knn(jnp.asarray(index), jnp.asarray(queries), 9,
+                            impl="xla")
+    d_p, i_p = fused_l2_knn(jnp.asarray(index), jnp.asarray(queries), 9,
+                            impl="pallas")
+    np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
