@@ -132,7 +132,24 @@ class HostComms:
                 fn, mesh=self.mesh, in_specs=spec, out_specs=spec,
                 check_rep=False))
             self._progs[key] = prog
-        return prog(*args)
+        return self._host_view(prog(*args))
+
+    def _host_view(self, out):
+        """Make an eager-verb result host-readable on every process.
+
+        Single-controller: identity.  Multi-process (the mesh spans
+        ``jax.distributed``-initialized hosts, reference ucp_helper /
+        multi-node role): the result is a global array whose shards live
+        on other hosts, so reading it locally would raise; gather it to
+        a replicated host value — the analog of the reference's NCCL
+        collectives landing in per-rank local buffers (std_comms.hpp:300:
+        every rank owns its recvbuf; here every host gets the full
+        rank-major view)."""
+        if jax.process_count() == 1:
+            return out
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(out, tiled=True)
 
     def _check(self, x) -> jnp.ndarray:
         x = jnp.asarray(x)

@@ -175,14 +175,18 @@ def pairwise_distance(
     metric: DistanceType = D.L2Expanded,
     metric_arg: float = 2.0,
     fin_op: Optional[Callable] = None,
+    handle=None,
     **tile_kw,
 ) -> jnp.ndarray:
     """All-pairs distances between rows of x (m, k) and y (n, k).
 
     Runtime-dispatch analog of reference distance.hpp:207.  ``metric_arg``
     is the Minkowski p.  ``fin_op`` is the optional elementwise final
-    lambda (reference FinalLambda).  Extra keyword args tune the tiled
-    kernel (block sizes) for unexpanded metrics.
+    lambda (reference FinalLambda).  ``handle`` (the reference's first
+    argument, handle.hpp:49) records the async result on the handle's
+    main stream so ``sync_stream``/``stream_syncer`` cover it.  Extra
+    keyword args tune the tiled kernel (block sizes) for unexpanded
+    metrics.
     """
     expects(x.ndim == 2 and y.ndim == 2, "pairwise_distance: 2-D inputs required")
     expects(

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Test runner pinning the simulated-mesh environment (the reference's CI
+# analog, ci/gpu/build.sh:116).  tests/conftest.py forces the platform
+# in-process (sitecustomize may pre-import jax against a real
+# accelerator), so these env vars are belt-and-braces for subprocesses
+# spawned by tests.
+set -euo pipefail
+cd "$(dirname "$0")"
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+export RAFT_TPU_TEST_PLATFORM="${RAFT_TPU_TEST_PLATFORM:-cpu}"
+exec python -m pytest tests/ -q "$@"

@@ -1,0 +1,47 @@
+"""Worker for the multi-process comms bootstrap test.
+
+Usage: python mp_comms_worker.py <process_id> <num_processes> <port>
+
+Each process exposes 2 virtual CPU devices; the global mesh spans
+2 * num_processes devices across the jax.distributed cluster — the
+reference's LocalCUDACluster-driven comms test topology
+(python/raft/test/conftest.py:17-48) without hardware.
+"""
+
+import os
+import sys
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from raft_tpu.comms import selftest  # noqa: E402
+from raft_tpu.session import Comms, get_raft_comm_state, local_handle  # noqa: E402
+
+sess = Comms(coordinator_address=f"localhost:{port}", num_processes=nprocs,
+             process_id=pid).init()
+assert jax.process_count() == nprocs
+assert jax.device_count() == 2 * nprocs
+
+# the reference drives every comms/test.hpp function from pytest on a live
+# cluster (test_comms.py); same here, across real processes
+failures = {}
+for name in sorted(dir(selftest)):
+    if name.startswith("test_"):
+        try:
+            ok = getattr(selftest, name)(sess.comms)
+        except Exception as e:  # noqa: BLE001
+            ok = f"{type(e).__name__}: {e}"
+        if ok is not True:
+            failures[name] = ok
+
+# session-registry API parity checks (comms.py:247,266)
+assert local_handle(sess.sessionId) is sess.handle
+assert get_raft_comm_state(sess.sessionId)["nworkers"] == 2 * nprocs
+
+print(f"WORKER_RESULT {pid} failures={failures}", flush=True)
+sess.destroy()
+sys.exit(0 if not failures else 1)

@@ -1,0 +1,44 @@
+"""Multi-process comms bootstrap: the DCN-role test.
+
+Reference: python/raft/test/test_comms.py runs the comms self-tests on a
+live multi-worker cluster bootstrapped by out-of-band NCCL-uid exchange
+(ucp_helper.hpp:92 provides the cross-host p2p transport).  Here two real
+OS processes bootstrap through ``jax.distributed`` (coordination service
+= the uid-exchange analog, session.py Comms(coordinator_address=...)) and
+run every comms selftest over the spanning mesh.
+"""
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).parent / "helpers" / "mp_comms_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_bootstrap_and_selftests():
+    port = _free_port()
+    procs = [
+        subprocess.Popen([sys.executable, str(WORKER), str(i), "2", str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER_RESULT {i} failures={{}}" in out, out[-3000:]
