@@ -1,39 +1,50 @@
 #!/usr/bin/env python
-"""Headline benchmark — prints ONE JSON line, always.
+"""Headline benchmark — prints ONE JSON line, always, within a hard budget.
 
-Measures the BASELINE.md configs: the north-star brute-force kNN QPS at
-1M x 128d k=100 (config #3) as the headline metric, with pairwise-L2
-Gpairs/s (config #1/#2 family) and a small spectral-partition run
-(config #4) in ``detail``.
+Measures the BASELINE.md configs as a *ladder*, banking each rung as it
+completes: pairwise-L2 Gpairs/s (config #1/#2) at 2k then 8k, brute-force
+kNN QPS (config #3) at 100k then the 1M x 128 k=100 north star, the
+compiled-Pallas fused-kNN comparison, and a small spectral embedding
+(config #4).  The headline metric is the best kNN rung completed.
 
-Robustness (round-1 postmortem: the TPU backend failed to initialize and
-the bench emitted nothing):
+Architecture (round-2 postmortem: the bench was killed by the harness
+timeout before printing anything — rc=124):
 
-- the backend is probed in a SUBPROCESS with a timeout + retries before
-  any in-process JAX work, so a hung PJRT init cannot hang the bench;
-- if the probe fails, the bench re-execs itself pinned to CPU with
-  scaled-down shapes and reports honestly (``fallback`` in detail);
-- every section and the whole main are wrapped so any failure still
-  prints a JSON line (with an ``error`` field) and exits 0.
+- the PARENT process never imports JAX.  It owns a hard wall-clock budget
+  (``RAFT_TPU_BENCH_BUDGET`` seconds, default 420) and a deadline loop;
+  nothing the backend does (hung PJRT init, hung Mosaic compile) can keep
+  it from printing the best JSON assembled so far and exiting 0.
+- ONE measuring CHILD process does all JAX work (a single backend init —
+  round 2 measured >180 s per init in this environment, so extra probe
+  subprocesses are unaffordable).  It streams ``PARTIAL <json>`` lines
+  after every rung; the parent folds them into the final result.
+- the child sees the same deadline (env) and skips rungs that don't fit,
+  recording them as skipped; the parent kills it at the deadline.
+- if the child dies or produces nothing with enough budget left, the
+  parent retries once on CPU (``JAX_PLATFORMS=cpu``) with scaled shapes
+  and reports honestly (``fallback: "cpu"``).
 
-Timing methodology: the device may sit behind a high-latency transport
-where per-call host timing (and even block_until_ready) is unreliable, so
-each measurement chains ITERS data-dependent iterations inside ONE
-compiled program, fetches a scalar to force completion, and subtracts the
-single-iteration run to cancel fixed dispatch/fetch latency.
+Timing methodology: the device can sit behind a high-latency transport
+where per-call host timing is unreliable, so each rung runs ITERS
+data-dependent iterations inside ONE compiled ``fori_loop`` program
+(single compile), fetches a scalar to force completion, and differences
+an n-iteration call against a 1-iteration call of the *same* executable
+to cancel fixed dispatch/fetch latency.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 baseline constant is an A100 estimate for the same op derived from the
 north-star target ("within 1.5x of A100 wall-clock"):
 - brute-force kNN 1M x 128 k=100: FAISS-class A100 throughput ~20k QPS.
-  vs_baseline = ours / 20000.
-- pairwise L2 f32: A100 sustains ~50 Gpairs/s at k=128.
+  vs_baseline = ours / 20000 (smaller-index rungs normalized to their
+  1M-index equivalent: per-query work scales with n_index).
+- pairwise L2 f32: A100 sustains ~50 Gpairs/s at d=128.
 """
 
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -42,186 +53,221 @@ sys.path.insert(0, REPO)
 
 KNN_BASELINE_QPS = 20000.0
 PAIRWISE_BASELINE_GPAIRS = 50.0
-_FALLBACK_ENV = "RAFT_TPU_BENCH_CPU_FALLBACK"
 
-PROBE_SRC = """
-import jax, jax.numpy as jnp
-x = jnp.ones((128, 128), jnp.float32)
-v = float((x @ x)[0, 0])
-assert v == 128.0, v
-print("PROBE_OK", jax.devices()[0].device_kind)
-"""
+_BUDGET_ENV = "RAFT_TPU_BENCH_BUDGET"
+_DEADLINE_ENV = "RAFT_TPU_BENCH_DEADLINE"
+_CPU_ENV = "RAFT_TPU_BENCH_CPU"
+_SAFETY = 12.0          # parent prints this many seconds before the budget
+_CPU_RETRY_COST = 100.0  # min budget left to bother starting a CPU child
 
-
-PALLAS_CHECK_SRC = f"""
-import sys
-sys.path.insert(0, {REPO!r})
-import numpy as np, jax.numpy as jnp
-from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
-x = jnp.asarray(np.random.default_rng(0).standard_normal((512, 128)),
-                dtype=jnp.float32)
-d_p, i_p = fused_l2_knn(x, x[:32], 8, impl="pallas")
-d_r, i_r = fused_l2_knn(x, x[:32], 8, impl="xla")
-assert np.allclose(np.asarray(d_p), np.asarray(d_r), atol=1e-3)
-assert np.array_equal(np.asarray(i_p), np.asarray(i_r))
-print("PALLAS_OK")
-"""
+# an operator pin of the fused-kNN impl, captured before any rung mutates it
+_OPERATOR_IMPL = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
 
 
-def probe_backend(timeout=180, attempts=2):
-    """Run a tiny matmul in a subprocess; returns (ok, info-string).
+# --------------------------------------------------------------------------
+# result assembly (shared by parent and child)
+# --------------------------------------------------------------------------
 
-    A subprocess is the only safe way to test PJRT init: round 1 showed
-    it can either raise UNAVAILABLE or hang indefinitely, and a hang in
-    the bench process itself would produce no JSON at all.  Worst case
-    here is ~6 min of probing before the CPU fallback kicks in — kept
-    well under any plausible harness timeout.
+def assemble(state):
+    """Fold rung results into the single headline JSON object."""
+    detail = dict(state)
+    candidates = [state.get("knn_1m"), state.get("knn_1m_pallas")]
+    knn_1m = max((c for c in candidates if c and c.get("qps")),
+                 key=lambda c: c["qps"], default=None)
+    knn_100k = state.get("knn_100k")
+    fallback = state.get("fallback") == "cpu"
+    if knn_1m:
+        metric = "knn_qps_1M_128d_k100"
+        value = knn_1m["qps"]
+        equiv = knn_1m["qps"]
+    elif knn_100k and knn_100k.get("qps"):
+        n_index = knn_100k["n_index"]
+        metric = "knn_qps_%dk_128d_k100%s" % (
+            n_index // 1000, "_cpu_fallback" if fallback else "")
+        value = knn_100k["qps"]
+        equiv = knn_100k["qps"] * (n_index / 1_000_000)
+    else:
+        metric = "knn_qps_1M_128d_k100"
+        value = 0.0
+        equiv = 0.0
+    return {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(equiv / KNN_BASELINE_QPS, 4),
+        "detail": detail,
+    }
+
+
+# --------------------------------------------------------------------------
+# child: the only process that imports JAX
+# --------------------------------------------------------------------------
+
+def _remaining():
+    return float(os.environ[_DEADLINE_ENV]) - time.time()
+
+
+def _emit(name, payload):
+    print("PARTIAL " + json.dumps({name: payload}), flush=True)
+
+
+def _time_chained(step, x, iters):
+    """Seconds per call of ``step(x) -> array`` via one compiled fori_loop.
+
+    A single executable taking the iteration count as a traced scalar is
+    compiled once and called at n=iters and n=1; the difference cancels
+    fixed dispatch/fetch latency without paying a second compile.
     """
-    last = ""
-    for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", PROBE_SRC],
-                capture_output=True, text=True, timeout=timeout,
-            )
-            out = (r.stdout or "") + (r.stderr or "")
-            if r.returncode == 0 and "PROBE_OK" in r.stdout:
-                kind = r.stdout.split("PROBE_OK", 1)[1].strip()
-                return True, kind
-            last = out[-500:]
-        except subprocess.TimeoutExpired:
-            last = f"probe timed out after {timeout}s"
-        if i + 1 < attempts:
-            time.sleep(5)
-    return False, last
-
-
-def time_chained(step, x, iters):
-    """Seconds per call of ``step(x) -> array``, measured by chaining
-    ``iters`` data-dependent calls in one jit and differencing against a
-    1-iteration run to cancel fixed latency."""
     import jax
     import jax.numpy as jnp
 
-    def chained(n):
-        @jax.jit
-        def run(x0):
-            def body(carry, _):
-                out = step(carry)
-                # data dependency without changing the value: adds 0.0
-                # derived from a FULL reduction of the output, so XLA
-                # cannot slice-narrow the benchmarked op
-                return carry + jnp.sum(out) * 0.0, None
+    @jax.jit
+    def run(x0, n):
+        def body(_, carry):
+            out = step(carry)
+            # data dependency without changing the value: adds 0.0 derived
+            # from a FULL reduction of the output, so XLA cannot
+            # slice-narrow the benchmarked op
+            return carry + jnp.sum(out) * 0.0
+        return jax.lax.fori_loop(0, n, body, x0).ravel()[0]
 
-            final, _ = jax.lax.scan(body, x0, None, length=n)
-            return final.ravel()[0]
-
-        return run
-
-    run_n = chained(iters)
-    run_1 = chained(1)
-    float(run_n(x))  # compile n
-    float(run_1(x))  # compile 1
+    float(run(x, 1))  # compile + warm
     t0 = time.perf_counter()
-    float(run_n(x))
+    float(run(x, iters + 1))
     t_n = time.perf_counter() - t0
     t0 = time.perf_counter()
-    float(run_1(x))
+    float(run(x, 1))
     t_1 = time.perf_counter() - t0
-    return max((t_n - t_1) / (iters - 1), 1e-9)
+    return max((t_n - t_1) / iters, 1e-9)
 
 
-def bench_knn(fallback):
-    """North star (BASELINE.md config #3): brute-force kNN 1M x 128 k=100."""
+def _rand(shape, seed):
+    """Device-side normal data — avoids shipping 100s of MB over a
+    potentially slow host<->device transport."""
+    import jax
     import jax.numpy as jnp
-    import numpy as np
 
+    return jax.jit(
+        lambda: jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+    )()
+
+
+def _rung_init():
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get(_CPU_ENV) == "1":
+        # env-var JAX_PLATFORMS is NOT enough: a sitecustomize-registered
+        # accelerator plugin may force jax_platforms via jax.config at
+        # interpreter startup; backend init is lazy, so re-pinning here
+        # (before any device op) wins
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.float32)
+    v = float((x @ x)[0, 0])
+    assert v == 128.0, v
+    from raft_tpu.core.utils import is_tpu_backend
+
+    return {
+        "seconds": round(time.time() - t0, 1),
+        "device": str(dev.device_kind),
+        "platform": str(dev.platform),
+        "is_tpu": bool(is_tpu_backend()),
+    }
+
+
+def _bench_pairwise(m, iters):
+    from raft_tpu.distance import DistanceType, pairwise_distance
+
+    dim = 128
+    x = _rand((m, dim), 1)
+    y = _rand((m, dim), 2)
+
+    def step(a):
+        return pairwise_distance(a, y, DistanceType.L2Expanded)
+
+    dt = _time_chained(step, x, iters)
+    gpairs = m * m / dt / 1e9
+    return {
+        "gpairs_per_sec": round(gpairs, 2),
+        "seconds_per_call": round(dt, 5),
+        "shape": [m, m, dim],
+        "vs_a100_estimate": round(gpairs / PAIRWISE_BASELINE_GPAIRS, 3),
+    }
+
+
+def _bench_knn(n_index, n_query, iters, impl):
     from raft_tpu.spatial import brute_force_knn
 
-    if fallback:  # CPU can't sustain the 2.56-TFLOP batch; scale honestly
-        n_index, n_query, dim, k, iters = 100_000, 512, 128, 100, 2
-    else:
-        n_index, n_query, dim, k, iters = 1_000_000, 10_000, 128, 100, 4
-
-    # Validate the compiled Pallas fused-kNN path before the headline run —
-    # in a SUBPROCESS with a timeout (a Mosaic compile/runtime hang in this
-    # process would break the one-JSON-line-always contract), and only on a
-    # real TPU backend (anywhere else "pallas" means the interpreter, which
-    # is orders of magnitude slower than the XLA impl).  On any failure,
-    # pin the proven XLA tile-scan impl.
-    impl_used = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
-    if impl_used is None and not fallback:
-        from raft_tpu.core.utils import is_tpu_backend
-
-        impl_used = "xla"
-        if is_tpu_backend():
-            try:
-                r = subprocess.run(
-                    [sys.executable, "-c", PALLAS_CHECK_SRC],
-                    capture_output=True, text=True, timeout=300,
-                )
-                if r.returncode == 0 and "PALLAS_OK" in r.stdout:
-                    impl_used = "pallas"
-            except subprocess.TimeoutExpired:
-                pass
-        os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = impl_used
-
-    rng = np.random.default_rng(42)
-    index = jnp.array(rng.standard_normal((n_index, dim)), dtype=jnp.float32)
-    queries = jnp.array(rng.standard_normal((n_query, dim)), dtype=jnp.float32)
+    dim, k = 128, 100
+    index = _rand((n_index, dim), 3)
+    queries = _rand((n_query, dim), 4)
+    impl = _OPERATOR_IMPL or impl  # an operator env pin wins over the ladder
+    prev = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
+    if impl:
+        os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = impl
 
     def step(q):
         dists, _ = brute_force_knn([index], q, k)
         return dists
 
-    dt = time_chained(step, queries, iters=iters)
+    try:
+        dt = _time_chained(step, queries, iters)
+    finally:
+        if prev is None:
+            os.environ.pop("RAFT_TPU_FUSED_KNN_IMPL", None)
+        else:
+            os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = prev
     qps = n_query / dt
-    # per-query work scales with n_index, so normalize the scaled-down
-    # fallback config to its 1M-index equivalent before comparing against
-    # the 1M-config A100 baseline constant
-    qps_1m_equiv = qps * (n_index / 1_000_000)
-    return qps, qps_1m_equiv, {
+    return {
+        "qps": round(qps, 1),
+        "qps_1m_equiv": round(qps * n_index / 1_000_000, 1),
         "seconds_per_batch": round(dt, 4),
         "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
-        "fused_knn_impl": impl_used or "xla",
+        "impl": impl or "xla",
     }
 
 
-def bench_pairwise(fallback):
-    """BASELINE.md config #1 family: pairwise L2 throughput."""
-    import jax.numpy as jnp
+def _bench_pallas(state):
+    """Compiled (interpret=False) Pallas fused kNN: correctness vs the XLA
+    impl, then a timed comparison at 100k.  Loud status either way —
+    this is the kernel that must not ship unmeasured silently."""
     import numpy as np
 
-    from raft_tpu.distance import DistanceType, pairwise_distance
+    if not state.get("init", {}).get("is_tpu"):
+        return {"status": "skipped_backend"}
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
 
-    m = n = 2048 if fallback else 8192
-    dim = 128
-    rng = np.random.default_rng(42)
-    x = jnp.array(rng.standard_normal((m, dim)), dtype=jnp.float32)
-    y = jnp.array(rng.standard_normal((n, dim)), dtype=jnp.float32)
+    x = _rand((4096, 128), 5)
+    q = _rand((256, 128), 6)
+    d_p, i_p = fused_l2_knn(x, q, 64, impl="pallas")
+    d_r, i_r = fused_l2_knn(x, q, 64, impl="xla")
+    ok_d = bool(np.allclose(np.asarray(d_p), np.asarray(d_r), atol=1e-2))
+    ok_i = bool(np.mean(np.asarray(i_p) == np.asarray(i_r)) > 0.999)
+    out = {"status": "ok" if (ok_d and ok_i) else "mismatch",
+           "dist_close": ok_d, "idx_match": ok_i}
+    if _remaining() > 90:
+        index = _rand((100_000, 128), 3)
+        queries = _rand((1024, 128), 4)
+        for impl in ("pallas", "xla"):
+            def step(qq, impl=impl):
+                d, _ = fused_l2_knn(index, qq, 100, impl=impl)
+                return d
+            dt = _time_chained(step, queries, 2)
+            out[impl + "_seconds_per_batch"] = round(dt, 4)
+            out[impl + "_qps_100k"] = round(1024 / dt, 1)
+    return out
 
-    def step(a):
-        return pairwise_distance(a, y, DistanceType.L2Expanded)
 
-    dt = time_chained(step, x, iters=4 if fallback else 16)
-    gpairs = m * n / dt / 1e9
-    return {
-        "gpairs_per_sec": round(gpairs, 2),
-        "shape": [m, n, dim],
-        "vs_a100_estimate": round(gpairs / PAIRWISE_BASELINE_GPAIRS, 3),
-    }
-
-
-def bench_spectral(fallback):
-    """BASELINE.md config #4: Lanczos -> spectral partition on a CSR graph."""
+def _bench_spectral():
     import numpy as np
 
     from raft_tpu.sparse.formats import COO
     from raft_tpu.sparse.spectral import fit_embedding
 
-    n = 512 if fallback else 2048
+    n = 2048
     rng = np.random.default_rng(0)
-    # ring + random chords: connected, sparse
     src = np.arange(n, dtype=np.int64)
     dst = (src + 1) % n
     extra = rng.integers(0, n, size=(2 * n, 2), dtype=np.int64)
@@ -230,73 +276,209 @@ def bench_spectral(fallback):
     cols = np.concatenate([dst, src, extra[:, 1], extra[:, 0]])
     vals = np.ones(rows.shape[0], dtype=np.float32)
     coo = COO(rows.astype(np.int32), cols.astype(np.int32), vals, shape=(n, n))
+    np.asarray(fit_embedding(coo, n_components=4))  # warmup: trace+compile
     t0 = time.perf_counter()
-    emb = fit_embedding(coo, n_components=4)
-    np.asarray(emb)
+    np.asarray(fit_embedding(coo, n_components=4))
     dt = time.perf_counter() - t0
-    return {"seconds": round(dt, 3), "n_vertices": n, "n_components": 4}
+    return {"seconds": round(dt, 3), "n_vertices": n, "n_components": 4,
+            "note": "steady-state (compile excluded by warmup call)"}
 
 
-def run_benches(fallback, device_kind):
-    detail = {"fallback": "cpu" if fallback else None, "device": device_kind}
-    errors = {}
+def child_main():
+    cpu = os.environ.get(_CPU_ENV) == "1"
+    state = {"fallback": "cpu" if cpu else None}
+    skipped = []
 
-    qps = qps_1m_equiv = 0.0
-    try:
-        qps, qps_1m_equiv, knn_detail = bench_knn(fallback)
-        detail["knn"] = knn_detail
-    except Exception:
-        errors["knn"] = traceback.format_exc()[-800:]
-    for name, fn in (("pairwise", bench_pairwise), ("spectral", bench_spectral)):
+    state["init"] = _rung_init()
+    if not cpu and not state["init"]["is_tpu"]:
+        # init succeeded but on a non-accelerator backend (e.g. a CPU-only
+        # dev box): the full ladder would run for hours — use the scaled
+        # shapes and say so in the metric name
+        cpu = True
+        state["fallback"] = "cpu"
+        state["init"]["note"] = "non-TPU backend; scaled ladder"
+    _emit("init", state["init"])
+    _emit("fallback", state["fallback"])
+
+    def knn_pallas_1m():
+        """Re-run the north star with the Pallas kernel only once it has
+        proven correct AND faster at 100k; assemble() picks the best."""
+        p = state.get("pallas_check", {})
+        if (p.get("status") == "ok"
+                and p.get("pallas_seconds_per_batch", 1e9)
+                < p.get("xla_seconds_per_batch", 0.0)):
+            return _bench_knn(1_000_000, 10_000, 3, "pallas")
+        return {"status": "skipped_pallas_not_faster"}
+
+    if cpu:
+        rungs = [
+            ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 4)),
+            ("knn_100k", 70, lambda: _bench_knn(100_000, 512, 2, "xla")),
+            ("spectral", 40, _bench_spectral),
+        ]
+    else:
+        # knn_1m (the headline, proven XLA impl) runs BEFORE pallas_check:
+        # a Mosaic compile hang in this process must not forfeit the
+        # north-star number (the parent can only kill the whole child)
+        rungs = [
+            ("pairwise_2k", 45, lambda: _bench_pairwise(2048, 8)),
+            ("knn_100k", 80, lambda: _bench_knn(100_000, 4096, 4, "xla")),
+            ("knn_1m", 140,
+             lambda: _bench_knn(1_000_000, 10_000, 3, "xla")),
+            ("pallas_check", 100, lambda: _bench_pallas(state)),
+            ("knn_1m_pallas", 120, knn_pallas_1m),
+            ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 16)),
+            ("spectral", 60, _bench_spectral),
+        ]
+
+    for name, est, fn in rungs:
+        if _remaining() < est:
+            skipped.append(name)
+            _emit("skipped", skipped)
+            continue
         try:
-            detail[name] = fn(fallback)
+            state[name] = fn()
         except Exception:
-            errors[name] = traceback.format_exc()[-800:]
-    if errors:
-        detail["errors"] = errors
+            state.setdefault("errors", {})[name] = \
+                traceback.format_exc()[-600:]
+            _emit("errors", state["errors"])
+            continue
+        _emit(name, state[name])
+    if skipped:
+        state["skipped"] = skipped
+    print("FINAL " + json.dumps(assemble(state)), flush=True)
 
-    return {
-        "metric": "knn_qps_1M_128d_k100" if not fallback
-        else "knn_qps_100k_128d_k100_cpu_fallback",
-        "value": round(qps, 1),
-        "unit": "queries/s",
-        "vs_baseline": round(qps_1m_equiv / KNN_BASELINE_QPS, 4),
-        "detail": detail,
-    }
+
+# --------------------------------------------------------------------------
+# parent: watchdog + orchestration, no JAX
+# --------------------------------------------------------------------------
+
+class _Child:
+    def __init__(self, deadline, cpu):
+        env = dict(os.environ)
+        env[_DEADLINE_ENV] = repr(deadline)
+        if cpu:
+            env[_CPU_ENV] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        self.state = {}
+        self.final = None
+        self.stderr_tail = ""
+        threading.Thread(target=self._read_out, daemon=True).start()
+        threading.Thread(target=self._read_err, daemon=True).start()
+
+    def _read_out(self):
+        for line in self.proc.stdout:
+            line = line.strip()
+            if line.startswith("PARTIAL "):
+                try:
+                    self.state.update(json.loads(line[8:]))
+                except ValueError:
+                    pass
+            elif line.startswith("FINAL "):
+                try:
+                    self.final = json.loads(line[6:])
+                except ValueError:
+                    pass
+
+    def _read_err(self):
+        tail = []
+        for line in self.proc.stderr:
+            tail.append(line)
+            tail = tail[-8:]
+        self.stderr_tail = "".join(tail)[-600:]
+
+    def kill(self):
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+
+
+def _result_of(child, note=None):
+    """Best result extractable from a child: FINAL line, else assembled
+    partials (None if it never even initialized a backend)."""
+    if child is None:
+        return None
+    if child.final is not None:
+        return child.final
+    if not child.state.get("init"):
+        return None
+    state = dict(child.state)
+    if note:
+        state["watchdog"] = note
+    return assemble(state)
+
+
+def parent_main():
+    t_start = time.time()
+    budget = float(os.environ.get(_BUDGET_ENV, "420"))
+    deadline = t_start + budget - _SAFETY
+
+    tpu = _Child(deadline, cpu=False)
+    cpu = None
+    while time.time() < deadline:
+        if tpu.final is not None:
+            break
+        tpu_dead = tpu.proc.poll() is not None
+        if tpu_dead:
+            # grace: the reader thread may not have consumed a FINAL line
+            t_grace = time.time() + 2.0
+            while time.time() < min(t_grace, deadline) and tpu.final is None:
+                time.sleep(0.1)
+            if tpu.final is not None:
+                break
+        no_backend = not tpu.state.get("init")
+        want_cpu = cpu is None and no_backend and (
+            tpu_dead or deadline - time.time() < _CPU_RETRY_COST)
+        if want_cpu and deadline - time.time() > 20:
+            # the accelerator never came up and the window to bank ANY
+            # number is closing: start the CPU child *in parallel* — a
+            # hung PJRT init burns no CPU, and if it completes late its
+            # numbers still supersede the fallback's
+            cpu = _Child(deadline, cpu=True)
+        if tpu_dead and (cpu is None or cpu.proc.poll() is not None):
+            t_grace = time.time() + 2.0
+            while (time.time() < min(t_grace, deadline)
+                   and cpu is not None and cpu.final is None):
+                time.sleep(0.1)
+            break
+        time.sleep(0.5)
+
+    if time.time() >= deadline:
+        note = "deadline reached; reporting completed rungs"
+    else:
+        note = "child exited before FINAL; reporting completed rungs"
+    result = _result_of(tpu, note)
+    if result is not None and result.get("value"):
+        if cpu is not None:
+            result["detail"]["cpu_fallback_superseded"] = True
+    else:
+        cpu_result = _result_of(cpu, note)
+        if cpu_result is not None:
+            cpu_result["detail"]["tpu_attempt"] = (
+                result["detail"] if result is not None
+                else "backend init did not complete within budget")
+            result = cpu_result
+    if result is None:
+        state = {"watchdog": note,
+                 "child_error": tpu.stderr_tail or "backend init never "
+                 "completed and no CPU fallback result"}
+        result = assemble(state)
+    tpu.kill()
+    if cpu is not None:
+        cpu.kill()
+    print(json.dumps(result), flush=True)
 
 
 def main():
-    fallback = os.environ.get(_FALLBACK_ENV) == "1"
-    if not fallback:
-        ok, info = probe_backend()
-        if not ok:
-            # backend dead: re-exec pinned to CPU so this process never
-            # touches the broken backend (in-process platform switching
-            # after a failed init is not reliable)
-            env = dict(os.environ)
-            env[_FALLBACK_ENV] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
-            env["RAFT_TPU_PROBE_ERROR"] = info[-400:]
-            os.execve(sys.executable, [sys.executable, __file__], env)
+    if "--child" in sys.argv:
+        child_main()
     else:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-
-    import jax
-
-    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or None)
-    device_kind = str(jax.devices()[0].device_kind)
-
-    from raft_tpu.core.utils import is_tpu_backend
-
-    if not fallback and not is_tpu_backend():
-        # probe succeeded but on a non-TPU backend (e.g. a CPU-only dev
-        # box): the full 1M-point config would run for hours — use the
-        # scaled shapes and say so in the metric name
-        fallback = True
-    result = run_benches(fallback, device_kind)
-    if fallback and os.environ.get("RAFT_TPU_PROBE_ERROR"):
-        result["detail"]["probe_error"] = os.environ["RAFT_TPU_PROBE_ERROR"]
-    print(json.dumps(result))
+        parent_main()
 
 
 if __name__ == "__main__":
@@ -308,6 +490,6 @@ if __name__ == "__main__":
             "value": 0.0,
             "unit": "queries/s",
             "vs_baseline": 0.0,
-            "error": traceback.format_exc()[-1500:],
+            "detail": {"error": traceback.format_exc()[-1200:]},
         }))
-        sys.exit(0)
+    sys.exit(0)
