@@ -183,6 +183,15 @@ class Handle:
         return props
 
 
+def record_on_handle(handle: Optional[Handle], *arrays) -> None:
+    """Associate dispatched work with a handle's main stream so
+    ``handle.sync_stream()`` blocks on it — the TPU analog of the
+    reference's primitives enqueuing on ``handle.get_stream()``.
+    No-op when ``handle`` is None (every primitive's default)."""
+    if handle is not None:
+        handle.get_stream().record(*arrays)
+
+
 class stream_syncer:
     """RAII-style scope that syncs the handle on exit
     (reference ``stream_syncer``, handle.hpp:311)."""

@@ -39,6 +39,7 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects, fail
+from raft_tpu.core.handle import record_on_handle
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.ops.pairwise_tile import pairwise_tile
 
@@ -241,6 +242,7 @@ def pairwise_distance(
 
     if fin_op is not None:
         out = fin_op(out)
+    record_on_handle(handle, out)
     return out
 
 

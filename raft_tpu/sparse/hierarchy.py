@@ -198,18 +198,18 @@ def build_sorted_mst(X: jnp.ndarray, graph: CSR, max_iter: int = 10,
 
 def get_distance_graph(X: jnp.ndarray, c: int,
                        metric: DistanceType,
-                       linkage: str = "knn") -> CSR:
+                       linkage: str = "knn", handle=None) -> CSR:
     """Connectivity graph: kNN (k = log2(m) + c, reference
     detail/connectivities.cuh) or full pairwise."""
     m = X.shape[0]
     if linkage == "knn":
         k = min(m, int(math.log2(max(m, 2))) + c)
-        g: COO = knn_graph(X, k=k, metric=metric)
+        g: COO = knn_graph(X, k=k, metric=metric, handle=handle)
         return convert.coo_to_csr(g)
     if linkage == "pairwise":
         from raft_tpu.distance.pairwise import pairwise_distance
 
-        dmat = pairwise_distance(X, X, metric)
+        dmat = pairwise_distance(X, X, metric, handle=handle)
         dmat = jnp.where(jnp.eye(m, dtype=bool), 0.0, dmat)
         return CSR.from_dense(np.asarray(dmat))
     raise ValueError(f"unknown linkage '{linkage}'")
@@ -217,18 +217,27 @@ def get_distance_graph(X: jnp.ndarray, c: int,
 
 def single_linkage(X, n_clusters: int,
                    metric: DistanceType = D.L2SqrtExpanded,
-                   linkage: str = "knn", c: int = 15) -> LinkageResult:
+                   linkage: str = "knn", c: int = 15,
+                   handle=None) -> LinkageResult:
     """Single-linkage HAC over dense rows X (m, d) (reference
     single_linkage, sparse/hierarchy/single_linkage.hpp:48).
+
+    ``handle``: optional resource context threaded through the kNN-graph
+    stage (reference signature takes ``handle_t&``,
+    single_linkage.hpp:48); the final labels are recorded on its main
+    stream so ``handle.sync_stream()`` covers the whole pipeline.
     """
     X = jnp.asarray(X)
     m = X.shape[0]
     expects(n_clusters <= m,
             "n_clusters must be less than or equal to the number of data points")
-    graph = get_distance_graph(X, c, metric, linkage)
+    graph = get_distance_graph(X, c, metric, linkage, handle=handle)
     src, dst, w = build_sorted_mst(X, graph, metric=metric)
     children, deltas, sizes = build_dendrogram_host(src, dst, w, m,
                                                     assume_sorted=True)
     labels = extract_flattened_clusters(children, n_clusters, m)
+    from raft_tpu.core.handle import record_on_handle
+
+    record_on_handle(handle, labels)
     return LinkageResult(labels, children, deltas, sizes,
                          n_clusters=n_clusters, n_leaves=m)

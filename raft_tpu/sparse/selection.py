@@ -95,7 +95,8 @@ def brute_force_knn(idx: CSR, query: CSR, k: int,
 
 
 def knn_graph(X: jnp.ndarray, k: int,
-              metric: DistanceType = D.L2SqrtExpanded) -> COO:
+              metric: DistanceType = D.L2SqrtExpanded,
+              handle=None) -> COO:
     """Symmetrized kNN graph of dense row set X (m, d) → COO (m, m).
 
     Reference: sparse/selection/knn_graph.hpp:46 — kNN (k includes self,
@@ -104,5 +105,5 @@ def knn_graph(X: jnp.ndarray, k: int,
     """
     from raft_tpu.spatial.knn import brute_force_knn as dense_knn
 
-    dists, inds = dense_knn([X], X, k=k, metric=metric)
+    dists, inds = dense_knn([X], X, k=k, metric=metric, handle=handle)
     return symmetrize_knn(inds, dists, X.shape[0])

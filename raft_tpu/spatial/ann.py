@@ -7,14 +7,28 @@ FAISS GPU (detail/ann_quantized_faiss.cuh:75+); the TPU build implements
 the quantizers natively (SURVEY.md §7.8):
 
 - **IVF-Flat**: k-means coarse quantizer (reusing spectral/kmeans) +
-  padded per-list storage.  Lists are a dense (nlist, max_len, d) tensor —
-  scanning ``nprobe`` lists per query is a batched matmul on the MXU, the
-  TPU-shaped substitute for FAISS's warp-level list scans.
+  slotted per-list storage (below).  Scanning a slot per query step is a
+  batched matmul on the MXU, the TPU-shaped substitute for FAISS's
+  warp-level list scans.
 - **IVF-PQ**: product quantization of residuals (M subspaces × 2^n_bits
   codes, k-means codebooks); search = per-query ADC lookup tables, code
   gathers, segment sums.
 - **IVF-SQ**: per-dimension 8-bit scalar quantization of residuals (the
   QT_8bit family) scanned like IVF-Flat after dequantization.
+
+**Slotted list storage.** FAISS keeps variable-length inverted lists
+(ann_quantized_faiss.cuh:75); a TPU needs static shapes.  Padding every
+list to the *longest* list collapses under skew — one hot cluster
+inflates the whole index and every query batch.  Instead, lists are cut
+into fixed-length *slots* of ``cap`` rows (cap = mean list size, rounded
+up to a multiple of 8): a hot list simply owns several slots.  Total
+storage is ≤ n_rows + nlist·cap ≈ 2·n_rows regardless of skew, and
+search scans one (n_queries, cap, d) slot at a time inside a
+``fori_loop`` instead of materializing (n_queries, nprobe, max_len, d).
+Each query's valid slots are compacted to the front of its scan list and
+the (traced) trip count is the batch's worst-case live-slot total, so
+scan compute tracks the lengths of the lists actually probed — a batch
+that avoids the hot list doesn't pay for it.
 
 All searches return (distances, ids) best-first like brute_force_knn.
 L2 metrics are supported (reference FAISS path likewise restricts the
@@ -30,8 +44,11 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.handle import record_on_handle
+from raft_tpu.core.utils import round_up_safe
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import expanded_sq_dists
 from raft_tpu.spatial.select_k import select_k
@@ -66,19 +83,23 @@ class IVFSQParams:
 
 
 class IVFFlatIndex(NamedTuple):
-    centroids: jnp.ndarray   # (nlist, d)
-    lists: jnp.ndarray       # (nlist, max_len, d) padded vectors
-    list_ids: jnp.ndarray    # (nlist, max_len) original row ids, -1 pad
-    list_sizes: jnp.ndarray  # (nlist,)
+    centroids: jnp.ndarray     # (nlist, d)
+    slot_vecs: jnp.ndarray     # (n_slots, cap, d) padded vectors
+    slot_ids: jnp.ndarray      # (n_slots, cap) original row ids, -1 pad
+    slot_centroid: jnp.ndarray  # (n_slots,) owning list of each slot
+    cent_slots: jnp.ndarray    # (nlist, max_slots) slot ids per list, -1 pad
+    list_sizes: jnp.ndarray    # (nlist,)
     metric: DistanceType
-    nprobe: int              # default probe count from build params
+    nprobe: int                # default probe count from build params
 
 
 class IVFPQIndex(NamedTuple):
-    centroids: jnp.ndarray    # (nlist, d) coarse
-    codebooks: jnp.ndarray    # (M, ksub, dsub) per-subspace codewords
-    codes: jnp.ndarray        # (nlist, max_len, M) uint8/int32 codes
-    list_ids: jnp.ndarray     # (nlist, max_len)
+    centroids: jnp.ndarray     # (nlist, d) coarse
+    codebooks: jnp.ndarray     # (M, ksub, dsub) per-subspace codewords
+    slot_codes: jnp.ndarray    # (n_slots, cap, M) codes
+    slot_ids: jnp.ndarray      # (n_slots, cap)
+    slot_centroid: jnp.ndarray
+    cent_slots: jnp.ndarray
     list_sizes: jnp.ndarray
     metric: DistanceType
     nprobe: int
@@ -86,14 +107,16 @@ class IVFPQIndex(NamedTuple):
 
 class IVFSQIndex(NamedTuple):
     centroids: jnp.ndarray
-    q_data: jnp.ndarray       # (nlist, max_len, d) quantized residuals
-    scale: jnp.ndarray        # (d,) dequant scale
-    offset: jnp.ndarray       # (d,) dequant offset
-    list_ids: jnp.ndarray
+    slot_q: jnp.ndarray        # (n_slots, cap, d) quantized residuals
+    scale: jnp.ndarray         # (d,) dequant scale
+    offset: jnp.ndarray        # (d,) dequant offset
+    slot_ids: jnp.ndarray
+    slot_centroid: jnp.ndarray
+    cent_slots: jnp.ndarray
     list_sizes: jnp.ndarray
     metric: DistanceType
     nprobe: int
-    encode_residual: bool     # build-time setting, honored by search
+    encode_residual: bool      # build-time setting, honored by search
 
 
 # --------------------------------------------------------------------- #
@@ -105,28 +128,62 @@ def _coarse_assign(X, nlist, seed):
     return res.centroids, res.labels
 
 
-def _build_lists(labels: np.ndarray, nlist: int) -> Tuple[np.ndarray, int]:
-    """Host: (nlist, max_len) row-id table, -1 padded; max_len is sized to
-    the largest list so nothing is ever truncated.
+def _pack_lists(labels: np.ndarray, nlist: int
+                ) -> Tuple[np.ndarray, int]:
+    """Host: (nlist, max_len) row-id table, -1 padded.
 
     Native path: cpp/src/host_runtime.cpp rt_build_lists (the sequential
-    packing loop); Python fallback below.
+    packing loop); vectorized numpy fallback below.
     """
-    labels = np.asarray(labels)
     from raft_tpu.core import native
+
     nat = native.build_lists(labels, nlist)
     if nat is not None:
-        table64, ml = nat
-        return table64.astype(np.int32), ml
+        return nat
     counts = np.bincount(labels, minlength=nlist)
-    ml = max(int(counts.max()), 1)
-    table = np.full((nlist, ml), -1, np.int32)
-    fill = np.zeros(nlist, np.int64)
-    for i, l in enumerate(labels):
-        if fill[l] < ml:
-            table[l, fill[l]] = i
-            fill[l] += 1
-    return table, ml
+    max_len = max(int(counts.max()), 1)
+    order = np.argsort(labels, kind="stable")
+    starts = np.zeros(nlist + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    # position of each sorted row within its list
+    within = np.arange(len(labels)) - starts[labels[order]]
+    table = np.full((nlist, max_len), -1, np.int64)
+    table[labels[order], within] = order
+    return table, max_len
+
+
+def _build_slots(labels: np.ndarray, nlist: int, cap: Optional[int] = None
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int,
+                            np.ndarray]:
+    """Host: cut each list into fixed-``cap``-length slots (module doc).
+
+    Returns (slot_rows (n_slots, cap) int32 row ids -1-padded,
+    slot_centroid (n_slots,) int32, cent_slots (nlist, max_slots) int32
+    slot ids -1-padded, cap, counts (nlist,)).
+    """
+    labels = np.asarray(labels)
+    counts = np.bincount(labels, minlength=nlist)
+    max_count = max(int(counts.max()), 1)
+    if cap is None:
+        # cap ≈ mean list size: total storage Σ ceil(cᵢ/cap)·cap is then
+        # ≤ m + nlist·cap ≈ 2m whatever the skew (a quantile cap fails
+        # this when k-means leaves a long tail of small lists)
+        mean = -(-len(labels) // nlist)
+        cap = min(max_count, max(8, round_up_safe(mean, 8)))
+    table, max_len = _pack_lists(labels, nlist)
+    slots_per = -(-counts // cap)       # ceildiv; empty lists get 0 slots
+    max_slots = max(int(slots_per.max()), 1)
+    n_slots = int(slots_per.sum())
+    # pad the table width to a whole number of slots, then cut
+    tab = np.full((nlist, max_slots * cap), -1, np.int64)
+    tab[:, :max_len] = table
+    mask = np.arange(max_slots)[None, :] < slots_per[:, None]
+    slot_rows = tab.reshape(nlist, max_slots, cap)[mask]
+    slot_centroid = np.repeat(
+        np.arange(nlist, dtype=np.int32), slots_per).astype(np.int32)
+    cent_slots = np.full((nlist, max_slots), -1, np.int32)
+    cent_slots[mask] = np.arange(n_slots, dtype=np.int32)
+    return slot_rows.astype(np.int32), slot_centroid, cent_slots, cap, counts
 
 
 _L2_METRICS = (D.L2Expanded, D.L2SqrtExpanded, D.L2Unexpanded,
@@ -140,33 +197,59 @@ def _check_metric(name, metric):
             "ann_quantized_faiss.cuh:94-118)", name, int(metric))
 
 
-def _search_lists(q, centroids, list_vecs, list_ids, k, nprobe, metric):
-    """Shared IVF search driver: probe → gather → distance → select.
+def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
+                       metric):
+    """Shared IVF search driver: probe centroids, then scan the probed
+    lists' slots one at a time with a running top-k.
 
-    q: (nq, d).  list_vecs: (nlist, max_len, d).  Returns (dists, ids).
+    ``step_dist(slx, pjx) -> (dist (nq, cap), ids (nq, cap))`` computes
+    one slot's candidate distances given per-query slot ids ``slx`` and
+    the per-query probe rank ``pjx`` each slot belongs to (so per-probe
+    precomputes — the PQ ADC tables — can be gathered, not rebuilt).
+    The fori_loop keeps the live set at (nq, cap, d) — never
+    (nq, nprobe, max_len, d) — and HLO size O(1) in the probe count.
+    Valid slots are compacted to the front of each query's scan list and
+    the (traced) trip count is the batch's worst-case live-slot count,
+    so scan cost tracks the lengths of the lists actually probed, not
+    nprobe·max_slots.
     """
-    nlist, max_len, d = list_vecs.shape
-    nprobe = min(nprobe, nlist)
-    # (nq, nlist) query-centroid distances → top-nprobe lists
-    qc = expanded_sq_dists(q, centroids)
-    _, probes = select_k(qc, nprobe, select_min=True)         # (nq, nprobe)
-
-    cand_vecs = list_vecs[probes]          # (nq, nprobe, max_len, d)
-    cand_ids = list_ids[probes]            # (nq, nprobe, max_len)
     nq = q.shape[0]
-    cand_vecs = cand_vecs.reshape(nq, nprobe * max_len, d)
-    cand_ids = cand_ids.reshape(nq, nprobe * max_len)
+    nlist, max_slots = cent_slots.shape
+    nprobe = min(nprobe, nlist)
+    qc = expanded_sq_dists(q, centroids)
+    _, probes = select_k(qc, nprobe, select_min=True)        # (nq, nprobe)
+    slots = cent_slots[probes].reshape(nq, -1)               # -1-padded
+    prank = jnp.broadcast_to(
+        jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_slots)[None],
+        slots.shape)
+    order = jnp.argsort(slots < 0, axis=1, stable=True)      # valid first
+    slots = jnp.take_along_axis(slots, order, axis=1)
+    prank = jnp.take_along_axis(prank, order, axis=1)
+    n_live = jnp.max(jnp.sum(slots >= 0, axis=1))
 
-    dist = (jnp.sum(q * q, 1)[:, None]
-            + jnp.sum(cand_vecs * cand_vecs, -1)
-            - 2.0 * jnp.einsum("nd,nmd->nm", q, cand_vecs,
-                               precision="highest"))
-    dist = jnp.maximum(dist, 0.0)
+    dt = jnp.result_type(q.dtype, jnp.float32)
+    init = (jnp.full((nq, k), jnp.inf, dt),
+            jnp.full((nq, k), -1, jnp.int32))
+
+    def body(j, carry):
+        run_d, run_i = carry
+        sl = slots[:, j]
+        valid = sl >= 0
+        slx = jnp.where(valid, sl, 0)
+        dist, ids = step_dist(slx, prank[:, j])
+        ids = jnp.where(valid[:, None], ids, -1)
+        dist = jnp.where(ids >= 0, jnp.maximum(dist, 0.0),
+                         jnp.inf).astype(dt)
+        cat_d = jnp.concatenate([run_d, dist], axis=1)
+        cat_i = jnp.concatenate([run_i, ids], axis=1)
+        return select_k(cat_d, k, select_min=True, values=cat_i)
+
+    dist, ids = lax.fori_loop(0, n_live, body, init)
     if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
         dist = jnp.sqrt(dist)
-    dist = jnp.where(cand_ids >= 0, dist, jnp.inf)
-    dd, ii = select_k(dist, k, select_min=True, values=cand_ids)
-    return dd, ii
+    return dist, ids
+
+
 
 
 # --------------------------------------------------------------------- #
@@ -174,7 +257,7 @@ def _search_lists(q, centroids, list_vecs, list_ids, k, nprobe, metric):
 # --------------------------------------------------------------------- #
 def ivf_flat_build(X, params: IVFFlatParams,
                    metric: DistanceType = D.L2Expanded,
-                   seed: int = 1234) -> IVFFlatIndex:
+                   seed: int = 1234, handle=None) -> IVFFlatIndex:
     """Build an IVF-Flat index (reference approx_knn_build_index IVFFlat
     path, ann_quantized_faiss.cuh:129-141)."""
     X = jnp.asarray(X)
@@ -182,31 +265,48 @@ def ivf_flat_build(X, params: IVFFlatParams,
     expects(params.nlist <= m, "ivf_flat_build: nlist > n_vectors")
     _check_metric("ivf_flat_build", metric)
     centroids, labels = _coarse_assign(X, params.nlist, seed)
-    table, max_len = _build_lists(np.asarray(labels), params.nlist)
-    table_j = jnp.asarray(table)
-    gather = jnp.where(table_j >= 0, table_j, 0)
-    lists = X[gather] * (table_j >= 0)[..., None]
-    return IVFFlatIndex(centroids, lists, table_j,
-                        jnp.asarray((table >= 0).sum(1), jnp.int32), metric,
-                        params.nprobe)
+    slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
+        np.asarray(labels), params.nlist)
+    rows_j = jnp.asarray(slot_rows)
+    gather = jnp.where(rows_j >= 0, rows_j, 0)
+    slot_vecs = X[gather] * (rows_j >= 0)[..., None]
+    idx = IVFFlatIndex(centroids, slot_vecs, rows_j, jnp.asarray(slot_cent),
+                       jnp.asarray(cent_slots),
+                       jnp.asarray(counts, jnp.int32), metric, params.nprobe)
+    record_on_handle(handle, slot_vecs)
+    return idx
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric"))
-def _ivf_flat_search_jit(centroids, lists, list_ids, q, k, nprobe, metric):
-    return _search_lists(q, centroids, lists, list_ids, k, nprobe, metric)
+def _ivf_flat_search_jit(centroids, slot_vecs, slot_ids, cent_slots, q, k,
+                         nprobe, metric):
+    qn = jnp.sum(q * q, axis=1)
+
+    def step_dist(slx, _pjx):
+        vecs = slot_vecs[slx]                         # (nq, cap, d)
+        ids = slot_ids[slx]                           # (nq, cap)
+        dist = (qn[:, None] + jnp.sum(vecs * vecs, -1)
+                - 2.0 * jnp.einsum("nd,ncd->nc", q, vecs,
+                                   precision="highest"))
+        return dist, ids
+
+    return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
+                              nprobe, metric)
 
 
 def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
-                    nprobe: Optional[int] = None
+                    nprobe: Optional[int] = None, handle=None
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search an IVF-Flat index (reference approx_knn_search, ann.hpp:71);
     ``nprobe`` defaults to the build params' value."""
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
     expects(nprobe >= 1, "ivf_flat_search: nprobe must be >= 1")
-    return _ivf_flat_search_jit(index.centroids, index.lists, index.list_ids,
-                                q, k, nprobe,
-                                DistanceType(int(index.metric)))
+    out = _ivf_flat_search_jit(index.centroids, index.slot_vecs,
+                               index.slot_ids, index.cent_slots,
+                               q, k, nprobe, DistanceType(int(index.metric)))
+    record_on_handle(handle, *out)
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -214,7 +314,7 @@ def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
 # --------------------------------------------------------------------- #
 def ivf_pq_build(X, params: IVFPQParams,
                  metric: DistanceType = D.L2Expanded,
-                 seed: int = 1234) -> IVFPQIndex:
+                 seed: int = 1234, handle=None) -> IVFPQIndex:
     """Build IVF-PQ: coarse quantize, then per-subspace k-means codebooks
     over residuals (reference IVFPQ path, ann_quantized_faiss.cuh:143-160)."""
     X = jnp.asarray(X)
@@ -241,58 +341,61 @@ def ivf_pq_build(X, params: IVFPQParams,
     codebooks = jnp.stack(codebooks)                  # (M, ksub, dsub)
     codes_flat = jnp.stack(codes_flat, axis=1)        # (m, M)
 
-    table, max_len = _build_lists(np.asarray(labels), params.nlist)
-    table_j = jnp.asarray(table)
-    gather = jnp.where(table_j >= 0, table_j, 0)
-    codes = codes_flat[gather]                        # (nlist, max_len, M)
-    return IVFPQIndex(centroids, codebooks, codes, table_j,
-                      jnp.asarray((table >= 0).sum(1), jnp.int32), metric,
-                      params.nprobe)
+    slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
+        np.asarray(labels), params.nlist)
+    rows_j = jnp.asarray(slot_rows)
+    gather = jnp.where(rows_j >= 0, rows_j, 0)
+    slot_codes = codes_flat[gather]                   # (n_slots, cap, M)
+    idx = IVFPQIndex(centroids, codebooks, slot_codes, rows_j,
+                     jnp.asarray(slot_cent), jnp.asarray(cent_slots),
+                     jnp.asarray(counts, jnp.int32), metric, params.nprobe)
+    record_on_handle(handle, slot_codes)
+    return idx
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric"))
-def _ivf_pq_search_jit(centroids, codebooks, all_codes, list_ids, q, k,
-                       nprobe, metric):
-    nlist, max_len, M = all_codes.shape
-    _, ksub, dsub = codebooks.shape
-    nq, d = q.shape
-    nprobe = min(nprobe, nlist)
+def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
+                       slot_centroid, cent_slots, q, k, nprobe, metric):
+    M, ksub, dsub = codebooks.shape
+    nlist = centroids.shape[0]
+    nq = q.shape[0]
+    cb_norms = jnp.sum(codebooks * codebooks, -1)      # (M, ksub)
 
+    # ADC lookup tables depend only on the probed list (residual =
+    # q - centroid): build them once per probe, BEFORE the slot loop —
+    # the probe selection here is recomputed by _probe_scan_search, but
+    # that (nq, nlist) pass is cheap next to rebuilding LUTs per slot
+    np_eff = min(nprobe, nlist)
     qc = expanded_sq_dists(q, centroids)
-    _, probes = select_k(qc, nprobe, select_min=True)   # (nq, nprobe)
+    _, probes = select_k(qc, np_eff, select_min=True)   # (nq, np_eff)
+    resid = q[:, None, :] - centroids[probes]           # (nq, np_eff, d)
+    rs = resid.reshape(nq, np_eff, M, dsub)
+    lut_all = (jnp.sum(rs * rs, -1)[..., None] + cb_norms[None, None]
+               - 2.0 * jnp.einsum("npmd,mkd->npmk", rs, codebooks,
+                                  precision="highest"))  # (nq,np,M,ksub)
 
-    # ADC tables per (query, probed list): residual = q - centroid, so the
-    # lookup table depends on the probe; table[nq, nprobe, M, ksub] =
-    # ||resid_sub - codeword||^2
-    resid = q[:, None, :] - centroids[probes]           # (nq, nprobe, d)
-    rs = resid.reshape(nq, nprobe, M, dsub)
-    cb = codebooks                                      # (M, ksub, dsub)
-    lut = (jnp.sum(rs * rs, -1)[..., None]
-           + jnp.sum(cb * cb, -1)[None, None]
-           - 2.0 * jnp.einsum("npmd,mkd->npmk", rs, cb,
-                              precision="highest"))     # (nq,nprobe,M,ksub)
+    def step_dist(slx, pjx):
+        lut = lut_all[jnp.arange(nq), pjx]             # (nq, M, ksub)
+        codes = slot_codes[slx]                        # (nq, cap, M)
+        codes_t = jnp.transpose(codes, (0, 2, 1)).astype(jnp.int32)
+        dist = jnp.sum(jnp.take_along_axis(lut, codes_t, axis=-1), axis=1)
+        return dist, slot_ids[slx]
 
-    codes = all_codes[probes]                           # (nq,nprobe,max_len,M)
-    ids = list_ids[probes].reshape(nq, nprobe * max_len)
-    # gather LUT entries: dist = sum_m lut[m, code_m]; align code axis with
-    # the LUT's ksub axis to gather without materializing a ksub-sized copy
-    codes_t = jnp.transpose(codes, (0, 1, 3, 2)).astype(jnp.int32)
-    dist = jnp.take_along_axis(lut, codes_t, axis=-1)   # (nq,np,M,max_len)
-    dist = jnp.sum(dist, axis=2).reshape(nq, nprobe * max_len)
-    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
-        dist = jnp.sqrt(jnp.maximum(dist, 0.0))
-    dist = jnp.where(ids >= 0, dist, jnp.inf)
-    return select_k(dist, k, select_min=True, values=ids)
+    return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
+                              nprobe, metric)
 
 
 def ivf_pq_search(index: IVFPQIndex, queries, k: int,
-                  nprobe: Optional[int] = None):
+                  nprobe: Optional[int] = None, handle=None):
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
     expects(nprobe >= 1, "ivf_pq_search: nprobe must be >= 1")
-    return _ivf_pq_search_jit(index.centroids, index.codebooks, index.codes,
-                              index.list_ids, q, k, nprobe,
-                              DistanceType(int(index.metric)))
+    out = _ivf_pq_search_jit(index.centroids, index.codebooks,
+                             index.slot_codes, index.slot_ids,
+                             index.slot_centroid, index.cent_slots,
+                             q, k, nprobe, DistanceType(int(index.metric)))
+    record_on_handle(handle, *out)
+    return out
 
 
 # --------------------------------------------------------------------- #
@@ -300,7 +403,7 @@ def ivf_pq_search(index: IVFPQIndex, queries, k: int,
 # --------------------------------------------------------------------- #
 def ivf_sq_build(X, params: IVFSQParams,
                  metric: DistanceType = D.L2Expanded,
-                 seed: int = 1234) -> IVFSQIndex:
+                 seed: int = 1234, handle=None) -> IVFSQIndex:
     """8-bit scalar quantization of residuals (QT_8bit; reference IVFSQ
     path, ann_quantized_faiss.cuh:162-176)."""
     expects(params.qtype in ("QT_8bit", "QT_8bit_uniform"),
@@ -318,72 +421,76 @@ def ivf_sq_build(X, params: IVFSQParams,
     scale = jnp.where(scale == 0, 1.0, scale)
     q_all = jnp.clip(jnp.round((resid - lo) / scale), 0, 255).astype(jnp.uint8)
 
-    table, _ = _build_lists(np.asarray(labels), params.nlist)
-    table_j = jnp.asarray(table)
-    gather = jnp.where(table_j >= 0, table_j, 0)
-    q_data = q_all[gather]
-    return IVFSQIndex(centroids, q_data, scale, lo, table_j,
-                      jnp.asarray((table >= 0).sum(1), jnp.int32), metric,
-                      params.nprobe, params.encode_residual)
+    slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
+        np.asarray(labels), params.nlist)
+    rows_j = jnp.asarray(slot_rows)
+    gather = jnp.where(rows_j >= 0, rows_j, 0)
+    slot_q = q_all[gather]
+    idx = IVFSQIndex(centroids, slot_q, scale, lo, rows_j,
+                     jnp.asarray(slot_cent), jnp.asarray(cent_slots),
+                     jnp.asarray(counts, jnp.int32), metric, params.nprobe,
+                     params.encode_residual)
+    record_on_handle(handle, slot_q)
+    return idx
 
 
 @functools.partial(jax.jit, static_argnames=("k", "nprobe",
                                              "encode_residual", "metric"))
-def _ivf_sq_search_jit(centroids, q_data, scale, offset, list_ids,
-                       q, k, nprobe, encode_residual, metric):
-    nlist, max_len, d = q_data.shape
-    nq = q.shape[0]
-    nprobe = min(nprobe, nlist)
-    # probe, then dequantize only the probed lists (the whole store stays
-    # uint8 in HBM — the memory point of scalar quantization)
-    qc = expanded_sq_dists(q, centroids)
-    _, probes = select_k(qc, nprobe, select_min=True)       # (nq, nprobe)
-    deq = (q_data[probes].astype(jnp.float32) * scale + offset)
-    if encode_residual:
-        deq = deq + centroids[probes][:, :, None, :]
-    cand = deq.reshape(nq, nprobe * max_len, d)
-    ids = list_ids[probes].reshape(nq, nprobe * max_len)
-    dist = (jnp.sum(q * q, 1)[:, None] + jnp.sum(cand * cand, -1)
-            - 2.0 * jnp.einsum("nd,nmd->nm", q, cand, precision="highest"))
-    dist = jnp.maximum(dist, 0.0)
-    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
-        dist = jnp.sqrt(dist)
-    dist = jnp.where(ids >= 0, dist, jnp.inf)
-    return select_k(dist, k, select_min=True, values=ids)
+def _ivf_sq_search_jit(centroids, slot_q, scale, offset, slot_ids,
+                       slot_centroid, cent_slots, q, k, nprobe,
+                       encode_residual, metric):
+    qn = jnp.sum(q * q, axis=1)
+
+    def step_dist(slx, _pjx):
+        # dequantize only the live slot (the whole store stays uint8 in
+        # HBM — the memory point of scalar quantization)
+        deq = slot_q[slx].astype(jnp.float32) * scale + offset
+        if encode_residual:
+            deq = deq + centroids[slot_centroid[slx]][:, None, :]
+        dist = (qn[:, None] + jnp.sum(deq * deq, -1)
+                - 2.0 * jnp.einsum("nd,ncd->nc", q, deq,
+                                   precision="highest"))
+        return dist, slot_ids[slx]
+
+    return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
+                              nprobe, metric)
 
 
 def ivf_sq_search(index: IVFSQIndex, queries, k: int,
-                  nprobe: Optional[int] = None):
+                  nprobe: Optional[int] = None, handle=None):
     """Search; honors the build-time ``encode_residual`` setting."""
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
     expects(nprobe >= 1, "ivf_sq_search: nprobe must be >= 1")
-    return _ivf_sq_search_jit(index.centroids, index.q_data, index.scale,
-                              index.offset, index.list_ids,
-                              q, k, nprobe,
-                              bool(index.encode_residual),
-                              DistanceType(int(index.metric)))
+    out = _ivf_sq_search_jit(index.centroids, index.slot_q, index.scale,
+                             index.offset, index.slot_ids,
+                             index.slot_centroid, index.cent_slots,
+                             q, k, nprobe, bool(index.encode_residual),
+                             DistanceType(int(index.metric)))
+    record_on_handle(handle, *out)
+    return out
 
 
 # --------------------------------------------------------------------- #
 # dispatcher (reference ann.hpp:45,71)
 # --------------------------------------------------------------------- #
 def approx_knn_build_index(X, params, metric: DistanceType = D.L2Expanded,
-                           seed: int = 1234):
+                           seed: int = 1234, handle=None):
     if isinstance(params, IVFPQParams):
-        return ivf_pq_build(X, params, metric, seed)
+        return ivf_pq_build(X, params, metric, seed, handle=handle)
     if isinstance(params, IVFSQParams):
-        return ivf_sq_build(X, params, metric, seed)
+        return ivf_sq_build(X, params, metric, seed, handle=handle)
     if isinstance(params, IVFFlatParams):
-        return ivf_flat_build(X, params, metric, seed)
+        return ivf_flat_build(X, params, metric, seed, handle=handle)
     raise TypeError(f"unknown ANN params {type(params)}")
 
 
-def approx_knn_search(index, queries, k: int, nprobe: Optional[int] = None):
+def approx_knn_search(index, queries, k: int, nprobe: Optional[int] = None,
+                      handle=None):
     if isinstance(index, IVFPQIndex):
-        return ivf_pq_search(index, queries, k, nprobe)
+        return ivf_pq_search(index, queries, k, nprobe, handle=handle)
     if isinstance(index, IVFSQIndex):
-        return ivf_sq_search(index, queries, k, nprobe)
+        return ivf_sq_search(index, queries, k, nprobe, handle=handle)
     if isinstance(index, IVFFlatIndex):
-        return ivf_flat_search(index, queries, k, nprobe)
+        return ivf_flat_search(index, queries, k, nprobe, handle=handle)
     raise TypeError(f"unknown ANN index {type(index)}")

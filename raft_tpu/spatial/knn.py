@@ -35,6 +35,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects, fail
+from raft_tpu.core.handle import record_on_handle
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
@@ -135,6 +136,7 @@ def brute_force_knn(
     metric_arg: float = 2.0,
     translations: Optional[Sequence[int]] = None,
     tile_n: int = 8192,
+    handle=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN of ``queries`` against one or more index partitions.
 
@@ -155,6 +157,13 @@ def brute_force_knn(
         partition starts (reference id_ranges, :241-255).
     tile_n:
         Index tile size for the scanned L2/haversine paths.
+    handle:
+        Optional :class:`raft_tpu.core.handle.Handle`.  Each partition's
+        search is recorded on the next pool stream (the reference forks
+        partitions across the stream pool, knn_brute_force_faiss.cuh:
+        289-297) — XLA's async dispatch overlaps the independent searches,
+        and ``handle.sync_stream_pool()`` blocks on exactly that work;
+        the merged result lands on the handle's main stream.
 
     Returns
     -------
@@ -175,10 +184,12 @@ def brute_force_knn(
             total += p.shape[0]
 
     select_min = metric not in _IP_FAMILY
-    results = [
-        _search_one_partition(p, queries, k, metric, metric_arg, tile_n)
-        for p in parts
-    ]
+    results = []
+    for i, p in enumerate(parts):
+        r = _search_one_partition(p, queries, k, metric, metric_arg, tile_n)
+        if handle is not None:
+            handle.get_next_usable_stream(i).record(*r)
+        results.append(r)
     if len(parts) == 1:
         dist, idx = results[0]
         t0 = int(translations[0])
@@ -194,4 +205,5 @@ def brute_force_knn(
     # order is unaffected because the maps are monotone
     if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
         dist = jnp.sqrt(jnp.maximum(dist, 0.0))
+    record_on_handle(handle, dist, idx)
     return dist, idx

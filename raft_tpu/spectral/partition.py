@@ -11,6 +11,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import record_on_handle
 from raft_tpu.sparse.formats import CSR
 from raft_tpu.spectral._driver import solve_embed_cluster
 from raft_tpu.spectral.cluster_solvers import KmeansSolver
@@ -31,16 +32,21 @@ def partition(csr: CSR,
               eigen_solver: Optional[LanczosSolver] = None,
               cluster_solver: Optional[KmeansSolver] = None,
               n_clusters: int = 2,
-              n_eig_vecs: Optional[int] = None) -> PartitionResult:
+              n_eig_vecs: Optional[int] = None,
+              handle=None) -> PartitionResult:
     """Spectral partition of an (undirected, symmetric) graph (reference
-    spectral::partition, partition.hpp:65).
+    spectral::partition, partition.hpp:65; takes ``handle_t&`` first).
 
     Default solvers mirror the reference configs when not supplied.
+    ``handle``: optional resource context; the result arrays are recorded
+    on its main stream so ``sync_stream``/``stream_syncer`` cover them.
     """
     L = LaplacianMatrix(csr)
-    return PartitionResult(*solve_embed_cluster(
+    res = PartitionResult(*solve_embed_cluster(
         L, csr.n_rows, "smallest", eigen_solver, cluster_solver,
         n_clusters, n_eig_vecs))
+    record_on_handle(handle, res.clusters, res.eig_vals, res.eig_vecs)
+    return res
 
 
 def analyze_partition(csr: CSR, n_clusters: int, clusters: jnp.ndarray

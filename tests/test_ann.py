@@ -151,3 +151,96 @@ class TestBallCover:
         full = spd.cdist(X, X, "euclidean")
         ref_i = np.argsort(full, axis=1, kind="stable")[:, :4]
         assert recall(np.asarray(ii), ref_i) > 0.999
+
+
+class TestIVFSkew:
+    """Slotted list storage under Zipf-skewed cluster sizes (the reference
+    FAISS path keeps variable-length lists, ann_quantized_faiss.cuh:75;
+    dense max_len padding would collapse here)."""
+
+    def _zipf_blobs(self, m=20000, d=16, nlist=50):
+        rng = np.random.default_rng(0)
+        # cluster sizes ~ 1/rank: the hottest cluster holds ~20% of rows
+        w = 1.0 / np.arange(1, nlist + 1)
+        sizes = np.maximum((w / w.sum() * m).astype(int), 1)
+        sizes[0] += m - sizes.sum()
+        centers = rng.normal(0, 10, (nlist, d))
+        X = np.concatenate([
+            centers[c] + rng.normal(0, 0.5, (s, d))
+            for c, s in enumerate(sizes)
+        ]).astype(np.float32)
+        return X[rng.permutation(len(X))]
+
+    def test_build_memory_bounded(self):
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+        X = self._zipf_blobs()
+        m = X.shape[0]
+        idx = ivf_flat_build(X, IVFFlatParams(nlist=50), D.L2Expanded)
+        n_slots, cap, d = idx.slot_vecs.shape
+        # storage within ~2x of the unpadded ideal (m rows + per-list
+        # rounding), however skewed the k-means assignment came out
+        assert n_slots * cap <= 2 * m + 8 * 50, (n_slots, cap, m)
+        # a dense (nlist, max_len, d) layout would need nlist*max_len:
+        max_len = int(np.asarray(idx.list_sizes).max())
+        assert n_slots * cap < 50 * max_len
+
+    def test_skewed_recall(self):
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build, \
+            ivf_flat_search
+
+        X = self._zipf_blobs(m=5000)
+        Q = X[:64] + 0.01
+        idx = ivf_flat_build(X, IVFFlatParams(nlist=20), D.L2Expanded)
+        dd, ii = ivf_flat_search(idx, Q, k=10, nprobe=8)
+        _, ref = brute(X, Q, 10)
+        assert recall(np.asarray(ii), ref) > 0.9
+
+    def test_explicit_cap_splits_hot_list(self):
+        from raft_tpu.spatial.ann import _build_slots
+
+        labels = np.array([0] * 100 + [1] * 3 + [2] * 5)
+        slot_rows, slot_cent, cent_slots, cap, counts = _build_slots(
+            labels, 3, cap=16)
+        np.testing.assert_array_equal(counts, [100, 3, 5])
+        assert cap == 16
+        # list 0 split into ceil(100/16)=7 slots; others 1 each
+        assert (slot_cent == 0).sum() == 7
+        assert slot_rows.shape == (9, 16)
+        assert (cent_slots[0] >= 0).sum() == 7
+        # every row appears exactly once
+        got = np.sort(slot_rows[slot_rows >= 0])
+        np.testing.assert_array_equal(got, np.arange(108))
+
+
+class TestHandleInjection:
+    def test_ivf_search_records_on_handle(self, data):
+        from raft_tpu import Handle
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build, \
+            ivf_flat_search
+
+        X, Q = data
+        h = Handle(n_streams=2)
+        idx = ivf_flat_build(X, IVFFlatParams(nlist=10), D.L2Expanded,
+                             handle=h)
+        dd, ii = ivf_flat_search(idx, Q, k=5, nprobe=10, handle=h)
+        assert len(h.get_stream()._pending) > 0
+        h.sync_stream()
+        assert len(h.get_stream()._pending) == 0
+        _, ref = brute(X, Q, 5)
+        assert recall(np.asarray(ii), ref) == 1.0
+
+
+def test_ivf_float64(data):
+    """x64 inputs must work (conftest enables jax_enable_x64; the scan
+    carry must adopt the input dtype, not hard-code f32)."""
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build, \
+        ivf_flat_search
+
+    X, Q = data
+    X64, Q64 = X.astype(np.float64), Q.astype(np.float64)
+    idx = ivf_flat_build(X64, IVFFlatParams(nlist=10), D.L2Expanded)
+    dd, ii = ivf_flat_search(idx, Q64, k=5, nprobe=10)
+    ref_d, ref = brute(X64, Q64, 5)
+    assert recall(np.asarray(ii), ref) == 1.0
+    np.testing.assert_allclose(np.asarray(dd), ref_d, rtol=1e-6, atol=1e-9)
