@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# API-docs build target (reference analog: `build.sh cppdocs` ->
+# cmake/doxygen.cmake).  Writes HTML to docs/html/.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python docs/gen_docs.py "$@"

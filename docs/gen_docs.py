@@ -1,0 +1,58 @@
+"""API-docs build: pydoc HTML for every raft_tpu module.
+
+The reference ships a Doxygen target (cpp/Doxyfile.in, cmake/doxygen.cmake,
+`build.sh cppdocs`); this is its analog for the TPU build using only the
+stdlib (pdoc/sphinx are not in the baked image).  Output: docs/html/.
+
+Run via ./docs.sh (or: python docs/gen_docs.py).
+"""
+
+import importlib
+import os
+import pkgutil
+import pydoc
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "docs", "html")
+sys.path.insert(0, REPO)
+
+# the environment may pre-register an accelerator backend; docs must
+# build hardware-free
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def iter_modules():
+    import raft_tpu
+
+    yield "raft_tpu"
+    for m in pkgutil.walk_packages(raft_tpu.__path__, prefix="raft_tpu."):
+        yield m.name
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    os.chdir(OUT)
+    names = []
+    for name in iter_modules():
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # pragma: no cover - gated optional deps
+            print(f"skip {name}: {e}", file=sys.stderr)
+            continue
+        pydoc.writedoc(name)
+        names.append(name)
+    with open("index.html", "w") as f:
+        f.write("<html><head><title>raft_tpu API</title></head><body>\n"
+                "<h1>raft_tpu API documentation</h1>\n<ul>\n")
+        for n in sorted(names):
+            f.write(f'<li><a href="{n}.html">{n}</a></li>\n')
+        f.write("</ul></body></html>\n")
+    print(f"wrote {len(names)} module pages to {OUT}")
+    return 0 if names else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Reproducible non-test source LoC count (the diagnostic VERDICT.md
+# reports each round; recorded here so the number is re-derivable).
+# Counts: the raft_tpu package, the C++ runtime, and the repo-root
+# entry points (bench, graft entry).  Excludes tests/, docs/, and
+# round artifacts.  Single wc over one concatenated stream — immune to
+# xargs argument batching.
+set -euo pipefail
+cd "$(dirname "$0")"
+{
+  find raft_tpu cpp -type f \( -name '*.py' -o -name '*.cpp' -o -name '*.hpp' \
+    -o -name '*.h' -o -name 'CMakeLists.txt' \) -print0 | xargs -0 cat
+  cat bench.py __graft_entry__.py
+} | wc -l

@@ -90,10 +90,10 @@ class HostComms:
     """Whole-communicator handle over a 1-D device mesh axis.
 
     Data convention: collective inputs/outputs are **rank-major** arrays —
-    shape ``(size, ...)`` where row r is rank r's buffer.  Results follow
-    the replicated-superset convention of
-    :class:`~raft_tpu.comms.mesh_comms.MeshComms` (root-only results are
-    valid on every rank).
+    shape ``(size, ...)`` where row r is rank r's buffer.  ``reduce``
+    follows :class:`~raft_tpu.comms.mesh_comms.MeshComms`'s documented
+    replicated superset (every row valid); ``gather``/``gatherv`` have
+    true root-only semantics (non-root rows are zeros).
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, axis: str = _AXIS):
@@ -186,10 +186,20 @@ class HostComms:
                          x)
 
     def gather(self, x, root: int = 0):
-        return self.allgather(x)
+        """Rank-major (size, n, ...) → (size, size*n, ...): row ``root``
+        holds the concatenation of all rows, every other row is zeros
+        (true root-only semantics, reference gather std_comms.hpp:377;
+        contrast :meth:`allgather` where every row is populated)."""
+        x = self._check(x)
+        return self._run(("gather", root),
+                         lambda b: self._mc.gather(b[0], root)[None], x)
 
     def gatherv(self, x, recvcounts: Sequence[int], root: int = 0):
-        return self.allgatherv(x, recvcounts)
+        """Variable-sized :meth:`gather`; root-only validity as there."""
+        x = self._check(x)
+        return self._run(("gatherv", tuple(recvcounts), root),
+                         lambda b: self._mc.gatherv(b[0], recvcounts,
+                                                    root)[None], x)
 
     def reducescatter(self, x, op: Op = Op.SUM):
         """Rank-major (size, size*n, ...) → (size, n, ...)."""

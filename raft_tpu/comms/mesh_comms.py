@@ -27,7 +27,8 @@ Verb-for-verb parity map (reference → here):
 - allgather / allgatherv   → ``lax.all_gather`` (+ static per-rank counts,
                              mirroring the per-root-broadcast semantics of
                              std_comms.hpp:355-375)
-- gather(v)(root)          → all_gather (replicated superset)
+- gather(v)(root)          → all_gather + non-root rows masked to zero
+                             (true root-only validity, unlike reduce)
 - reducescatter            → ``lax.psum_scatter``
 - device_sendrecv          → ``lax.ppermute`` with a static pair list
 - device_multicast_sendrecv→ sum of ppermutes (one per fan-out step)
@@ -126,15 +127,23 @@ class MeshComms:
 
     def gather(self, x, root: int = 0):
         """Gather blocks "to root" (reference gather, std_comms.hpp:377 —
-        grouped ncclSend/Recv).  Replicated-result superset, as
-        :meth:`reduce`."""
-        del root
-        return self.allgather(x)
+        grouped ncclSend/Recv).  Non-root ranks get ZEROS — the in-trace
+        encoding of the reference's "recvbuf valid on root only"
+        contract: SPMD has no rank-varying shapes and XLA's ICI lowering
+        has no gather-to-root primitive, so the transport is all_gather
+        and the root-only contract is enforced by masking (this is what
+        makes gather distinguishable from allgather, unlike
+        :meth:`reduce`'s documented replicated superset)."""
+        out = self.allgather(x)
+        is_root = lax.axis_index(self.axis) == root
+        return jnp.where(is_root, out, jnp.zeros_like(out))
 
     def gatherv(self, x, recvcounts: Sequence[int], root: int = 0):
-        """Variable-sized gather (reference gatherv, std_comms.hpp:403)."""
-        del root
-        return self.allgatherv(x, recvcounts)
+        """Variable-sized gather (reference gatherv, std_comms.hpp:403).
+        Root-only validity enforced by masking, as :meth:`gather`."""
+        out = self.allgatherv(x, recvcounts)
+        is_root = lax.axis_index(self.axis) == root
+        return jnp.where(is_root, out, jnp.zeros_like(out))
 
     def reducescatter(self, x, op: Op = Op.SUM):
         """Reduce then scatter equal blocks (reference reducescatter →

@@ -49,28 +49,47 @@ def test_collective_allgather(comms: HostComms) -> bool:
 
 
 def test_collective_gather(comms: HostComms) -> bool:
-    """(test.hpp:190)"""
-    return test_collective_allgather(comms)
+    """Root row holds [0..size); every NON-root row must be zeros — true
+    root-only semantics, distinguishable from allgather (test.hpp:190)."""
+    size = comms.get_size()
+    root = size - 1  # a non-default root exercises the mask placement
+    x = jnp.arange(size, dtype=jnp.float32)[:, None] + 1.0
+    out = np.asarray(comms.gather(x, root=root))
+    want = np.arange(size) + 1.0
+    if not (out[root].ravel() == want).all():
+        return False
+    return all((out[r] == 0).all() for r in range(size) if r != root)
 
 
 def test_collective_gatherv(comms: HostComms) -> bool:
-    """Variable block sizes: rank r contributes r+1 copies of r
-    (test.hpp:229)."""
+    """Variable block sizes: rank r contributes r+1 copies of r+1 to the
+    root row; non-root rows are zeros (test.hpp:229)."""
+    size = comms.get_size()
+    counts = [r + 1 for r in range(size)]
+    maxc = max(counts)
+    buf = np.zeros((size, maxc, 1), np.float32)
+    for r in range(size):
+        buf[r, : counts[r]] = r + 1
+    out = np.asarray(comms.gatherv(jnp.asarray(buf), counts, root=0))
+    expected = np.concatenate(
+        [np.full((c, 1), r + 1, np.float32) for r, c in enumerate(counts)])
+    if not (out[0] == expected).all():
+        return False
+    return all((out[r] == 0).all() for r in range(1, size))
+
+
+def test_collective_allgatherv(comms: HostComms) -> bool:
+    """Every rank sees the tight concatenation (test.hpp:289)."""
     size = comms.get_size()
     counts = [r + 1 for r in range(size)]
     maxc = max(counts)
     buf = np.zeros((size, maxc, 1), np.float32)
     for r in range(size):
         buf[r, : counts[r]] = r
-    out = np.asarray(comms.gatherv(jnp.asarray(buf), counts))
+    out = np.asarray(comms.allgatherv(jnp.asarray(buf), counts))
     expected = np.concatenate(
         [np.full((c, 1), r, np.float32) for r, c in enumerate(counts)])
     return all((out[r] == expected).all() for r in range(size))
-
-
-def test_collective_allgatherv(comms: HostComms) -> bool:
-    """(test.hpp:289)"""
-    return test_collective_gatherv(comms)
 
 
 def test_collective_reducescatter(comms: HostComms) -> bool:

@@ -1,0 +1,97 @@
+"""Numeric sanitizer hooks: the TPU analog of the reference's debug aids.
+
+The reference's only sanitizer integration is the ``CUDA_ENABLE_LINEINFO``
+CMake option, "useful for cuda-memcheck" (cpp/CMakeLists.txt:45) — memory
+tools exist outside the library and are merely enabled by a build flag.
+The failure mode that actually bites numeric primitives is silent
+NaN/Inf propagation through iterative solvers, so the TPU build wires
+the JAX-native equivalents (SURVEY.md §5: ``debug_nans`` / checkify)
+as opt-in hooks on the solver paths (Lanczos, k-means):
+
+- :func:`enable_debug_checks` / env ``RAFT_TPU_DEBUG=1`` turn on eager
+  finiteness assertions (:func:`check_finite`) at solver entry and exit.
+  They synchronize the device (like ``cuda-memcheck``, you pay for the
+  diagnosis), which is why they are opt-in.
+- :func:`debug_nans` scopes JAX's own ``jax_debug_nans`` — every jitted
+  computation under it re-runs un-jitted on NaN production and raises at
+  the producing primitive.
+- :func:`checkify_checks` wraps a jittable function with
+  ``jax.experimental.checkify`` so float checks run *inside* the
+  compiled program (no host sync per call) and surface as errors after.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import RaftError
+
+_enabled = os.environ.get("RAFT_TPU_DEBUG", "") == "1"
+
+
+class NumericError(RaftError):
+    """A debug-mode finiteness check failed (non-finite values where a
+    solver requires finite data)."""
+
+
+def enable_debug_checks(on: bool = True) -> None:
+    """Globally enable/disable the eager finiteness checks."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def debug_checks_enabled() -> bool:
+    return _enabled
+
+
+def check_finite(x, name: str):
+    """If debug checks are on: block on ``x`` and raise
+    :class:`NumericError` when it contains NaN/Inf.  Returns ``x`` either
+    way so it can be used inline at solver boundaries.
+
+    This is an *eager* sanitizer: under an outer ``jax.jit`` trace the
+    value is abstract and cannot be inspected, so the check is skipped
+    there (in-trace checking is :func:`checkify_checks`'s job — wrap the
+    jitted pipeline instead)."""
+    if _enabled and not isinstance(x, jax.core.Tracer):
+        ok = bool(jnp.all(jnp.isfinite(x)))
+        if not ok:
+            raise NumericError(
+                f"debug check failed: '{name}' contains non-finite values "
+                f"(shape {tuple(x.shape)}, dtype {x.dtype})")
+    return x
+
+
+@contextmanager
+def debug_nans(enable: bool = True):
+    """Scope JAX's ``jax_debug_nans`` flag (SURVEY §5's named hook):
+    inside the scope, any jitted op producing a NaN raises
+    FloatingPointError at the producing primitive."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def checkify_checks(fn: Callable) -> Callable:
+    """Wrap a jittable ``fn`` with checkify float checks compiled into
+    the program: the returned function raises ``JaxRuntimeError``-style
+    checkify errors (via ``error.throw()``) when a NaN/Inf is produced,
+    without per-op host syncs."""
+    from jax.experimental import checkify
+
+    checked = checkify.checkify(fn, errors=checkify.float_checks)
+
+    def wrapper(*args, **kw):
+        err, out = checked(*args, **kw)
+        err.throw()
+        return out
+
+    return wrapper

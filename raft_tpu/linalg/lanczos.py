@@ -26,6 +26,7 @@ from typing import Callable, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.debug import check_finite
 from raft_tpu.core.error import expects
 
 Operator = Union[jnp.ndarray, Callable[[jnp.ndarray], jnp.ndarray]]
@@ -186,7 +187,14 @@ def compute_smallest_eigenvectors(
     ncv = restart_iter if restart_iter > 0 else max(4 * n_eig_vecs, 32)
     ncv = min(ncv, n)
     max_restarts = max(1, maxiter // max(ncv, 1))
-    return _lanczos(a, n, n_eig_vecs, "smallest", ncv, max_restarts, tol, seed)
+    vals, vecs, iters = _lanczos(a, n, n_eig_vecs, "smallest", ncv,
+                                 max_restarts, tol, seed)
+    # opt-in sanitizer (SURVEY §5; no-op unless enabled): a NaN/Inf in the
+    # operator propagates into every Ritz value, so checking the output
+    # catches seeded poison wherever it entered the iteration
+    check_finite(vals, "lanczos eigenvalues")
+    check_finite(vecs, "lanczos eigenvectors")
+    return vals, vecs, iters
 
 
 def compute_largest_eigenvectors(
@@ -203,4 +211,8 @@ def compute_largest_eigenvectors(
     ncv = restart_iter if restart_iter > 0 else max(4 * n_eig_vecs, 32)
     ncv = min(ncv, n)
     max_restarts = max(1, maxiter // max(ncv, 1))
-    return _lanczos(a, n, n_eig_vecs, "largest", ncv, max_restarts, tol, seed)
+    vals, vecs, iters = _lanczos(a, n, n_eig_vecs, "largest", ncv,
+                                 max_restarts, tol, seed)
+    check_finite(vals, "lanczos eigenvalues")
+    check_finite(vecs, "lanczos eigenvectors")
+    return vals, vecs, iters

@@ -19,6 +19,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.debug import check_finite
 from raft_tpu.core.error import expects
 
 
@@ -116,5 +117,7 @@ def kmeans(X: jnp.ndarray, k: int, tol: float = 1e-4,
     expects(X.ndim == 2, "kmeans: 2-D observations required")
     expects(1 <= k <= X.shape[0],
             "kmeans: k=%d out of range for %d points", k, X.shape[0])
+    check_finite(X, "kmeans observations")  # opt-in sanitizer, SURVEY §5
     C, labels, res, iters = _kmeans_jit(X, k, tol, max_iter, seed)
+    check_finite(C, "kmeans centroids")
     return KmeansResult(C, labels, res, iters)

@@ -3,6 +3,7 @@ test/pow2_utils.cu, test/nvtx.cpp)."""
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from raft_tpu import Handle, RaftError, expects, fail
@@ -153,3 +154,82 @@ class TestTracingPopWhileDisabled:
             assert len(tracing._range_stack) == 0
         finally:
             tracing.set_enabled(True)
+
+
+class TestDebugHooks:
+    """Opt-in numeric sanitizers (SURVEY §5: debug_nans / checkify; the
+    reference's analog is the lineinfo-for-memcheck build flag,
+    cpp/CMakeLists.txt:45)."""
+
+    def _poisoned(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 8)).astype(np.float32)
+        X[13, 3] = np.nan
+        return X
+
+    def test_kmeans_catches_seeded_nan(self):
+        from raft_tpu.core import debug
+        from raft_tpu.spectral.kmeans import kmeans
+
+        X = self._poisoned()
+        kmeans(X, 4)  # disabled: silent (NaN propagates, no raise)
+        debug.enable_debug_checks(True)
+        try:
+            with pytest.raises(debug.NumericError, match="observations"):
+                kmeans(X, 4)
+        finally:
+            debug.enable_debug_checks(False)
+
+    def test_lanczos_catches_seeded_nan(self):
+        from raft_tpu.core import debug
+        from raft_tpu.linalg.lanczos import compute_smallest_eigenvectors
+
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((32, 32)).astype(np.float32)
+        A = A + A.T
+        A[5, 7] = A[7, 5] = np.nan
+        Aj = jnp.asarray(A)
+        debug.enable_debug_checks(True)
+        try:
+            with pytest.raises(debug.NumericError, match="lanczos"):
+                compute_smallest_eigenvectors(Aj, 32, 2)
+        finally:
+            debug.enable_debug_checks(False)
+
+    def test_debug_nans_scope(self):
+        from raft_tpu.core.debug import debug_nans
+
+        with debug_nans():
+            @jax.jit
+            def f(x):
+                return jnp.log(x)
+
+            with pytest.raises(FloatingPointError):
+                f(jnp.asarray(-1.0)).block_until_ready()
+        assert not jax.config.jax_debug_nans
+
+    def test_checkify_checks_wrapper(self):
+        from raft_tpu.core.debug import checkify_checks
+
+        def f(x):
+            return jnp.sqrt(x) + 1.0
+
+        g = checkify_checks(f)
+        assert float(g(jnp.asarray(4.0))) == 3.0
+        with pytest.raises(Exception, match="nan"):
+            g(jnp.asarray(-1.0))
+
+    def test_check_finite_skipped_under_trace(self):
+        """The eager sanitizer must not break jittability of the public
+        API (in-trace checking is checkify_checks's job)."""
+        from raft_tpu.core import debug
+        from raft_tpu.spectral.kmeans import kmeans
+
+        debug.enable_debug_checks(True)
+        try:
+            out = jax.jit(lambda X: kmeans(X, 2).centroids)(
+                jnp.asarray(np.random.default_rng(3)
+                            .standard_normal((32, 4)), jnp.float32))
+            assert out.shape == (2, 4)
+        finally:
+            debug.enable_debug_checks(False)

@@ -1,0 +1,78 @@
+"""North-star-shape AOT compile smoke (BASELINE.md config #3/#5).
+
+The unit tests exercise <=1k-row shapes; nothing there catches scaling
+bugs — HLO blow-ups, tiling mistakes, memory planning — that only appear
+at the 1M x 128 k=100 regime the bench measures.  AOT lowering +
+compilation (jax.jit(...).lower().compile()) exercises exactly that
+without executing a single FLOP, so it runs fine on the CPU test mesh.
+
+Reference contrast: RAFT runs its perf-shaped paths in test_raft
+(cpp/test/CMakeLists.txt:18-113); this is the shape-only analog.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+N_INDEX = 1_000_000
+N_QUERY = 10_000
+DIM = 128
+K = 100
+
+
+def _abstract(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+class TestNorthStarCompile:
+    def test_brute_force_knn_1m_compiles(self):
+        """Single-chip north star: lower + compile, no execution."""
+        from raft_tpu.spatial import brute_force_knn
+
+        def step(index, queries):
+            return brute_force_knn([index], queries, K)
+
+        lowered = jax.jit(step).lower(_abstract((N_INDEX, DIM)),
+                                      _abstract((N_QUERY, DIM)))
+        # the tile scan must keep HLO size independent of n_index: a
+        # driver that unrolls 123 tiles would blow far past this bound
+        hlo_lines = lowered.as_text().count("\n")
+        assert hlo_lines < 4000, f"HLO blow-up: {hlo_lines} lines"
+        compiled = lowered.compile()
+        mem = compiled.memory_analysis()
+        if mem is not None:  # backend-dependent availability
+            # index (512MB) + queries + a (nq, tile) live tile — far
+            # below a 16GB HBM; catches accidental (nq, n_index) temps,
+            # which alone would need 40GB
+            total = (mem.argument_size_in_bytes
+                     + mem.temp_size_in_bytes + mem.output_size_in_bytes)
+            assert total < 4 * 1024 ** 3, f"memory plan {total/2**30:.1f}GB"
+
+    def test_mnmg_knn_sharded_equivalent_compiles(self):
+        """Multi-chip north star: the same shape row-sharded over the
+        8-device test mesh (BASELINE.md config #5)."""
+        from raft_tpu.comms.host_comms import default_mesh
+        from raft_tpu.spatial.mnmg_knn import mnmg_knn
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device test mesh")
+        mesh = default_mesh(8)
+
+        def step(index, queries):
+            return mnmg_knn(index, queries, K, mesh=mesh, axis="ranks")
+
+        lowered = jax.jit(step).lower(_abstract((N_INDEX, DIM)),
+                                      _abstract((N_QUERY, DIM)))
+        hlo_lines = lowered.as_text().count("\n")
+        assert hlo_lines < 6000, f"HLO blow-up: {hlo_lines} lines"
+        lowered.compile()
+
+    def test_select_k_at_scale_compiles(self):
+        """k=100 selection over a 1M-wide candidate row (the k>64 regime
+        the reference routes to FAISS block-select)."""
+        from raft_tpu.spatial.select_k import select_k
+
+        lowered = jax.jit(
+            lambda d: select_k(d, K, select_min=True)
+        ).lower(_abstract((64, N_INDEX)))
+        lowered.compile()
