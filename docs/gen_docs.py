@@ -28,7 +28,12 @@ def iter_modules():
     import raft_tpu
 
     yield "raft_tpu"
-    for m in pkgutil.walk_packages(raft_tpu.__path__, prefix="raft_tpu."):
+    # onerror: walk_packages imports subpackages itself and re-raises
+    # non-ImportErrors without a handler — a gated optional dep must
+    # skip that subpackage, not abort the build
+    for m in pkgutil.walk_packages(
+            raft_tpu.__path__, prefix="raft_tpu.",
+            onerror=lambda name: print(f"skip {name}", file=sys.stderr)):
         yield m.name
 
 

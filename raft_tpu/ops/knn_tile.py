@@ -57,10 +57,18 @@ _INF = float("inf")
 
 
 def _roll_lanes(x: jnp.ndarray, shift: int, interpret: bool) -> jnp.ndarray:
-    """Circular shift along the lane (last) axis."""
+    """Circular shift along the lane (last) axis.
+
+    Mosaic's ``pltpu.roll`` rejects negative shifts (the interpreter's
+    ``jnp.roll`` accepts them — exactly the kind of divergence that made
+    the compiled kernel fail TPU lowering while every interpret-mode
+    test passed); a circular roll by -s over w lanes equals a roll by
+    w - s, so normalize modulo the lane count."""
     if interpret:
         return jnp.roll(x, shift, axis=1)
-    return pltpu.roll(x, shift, axis=1)
+    # int32 scalar: under jax_enable_x64 a Python-int shift becomes an
+    # i64 operand, which tpu.dynamic_rotate rejects
+    return pltpu.roll(x, jnp.int32(shift % x.shape[1]), axis=1)
 
 
 def _bitonic_sort_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
@@ -114,9 +122,13 @@ def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
         preferred_element_type=jnp.float32, precision=precision)
     dist = qn_ref[:] + xn_ref[:] - 2.0 * acc
     dist = jnp.maximum(dist, 0.0)
-    # mask padded index rows of the final tile
+    # mask padded index rows of the final tile.  Constants are explicit
+    # float32: under jax_enable_x64 a Python-float literal promotes the
+    # branch to f64, and Mosaic has no f64 cast (the interpreter
+    # silently accepts it -- another compiled-path-only divergence)
+    inf32 = jnp.float32(_INF)
     col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
-    dist = jnp.where(j * bn + col < n_index, dist, _INF)
+    dist = jnp.where(j * bn + col < n_index, dist, inf32)
 
     bm = dist.shape[0]
     r_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
@@ -125,21 +137,24 @@ def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
     def gate(state):
         d, bd, _ = state
         worst = bd[:, kpad - 1:kpad]
-        return jnp.any(d < worst)
+        # int32 reduce-max, not jnp.any: Mosaic proxies boolean
+        # reductions through the default float type, which is f64 under
+        # jax_enable_x64 and has no TPU lowering
+        return jnp.max((d < worst).astype(jnp.int32)) > 0
 
     def extract_merge(state):
         d, bd, bi = state
         d3 = d.reshape(bm, g, kpad)
         gmin = jnp.min(d3, axis=1)                        # (bm, kpad)
         is_min = d3 == jnp.expand_dims(gmin, 1)
-        gg_star = jnp.min(jnp.where(is_min, gg_iota, g), axis=1)
+        gg_star = jnp.min(jnp.where(is_min, gg_iota, jnp.int32(g)), axis=1)
         # candidate global id: strided grouping → column = gg*kpad + r
         cand_i = j * bn + gg_star * kpad + r_iota
-        cand_i = jnp.where(gmin < _INF, cand_i, -1)
+        cand_i = jnp.where(gmin < inf32, cand_i, jnp.int32(-1))
         # mask the extracted element of each group (exactly one: the
         # lowest-gg argmin)
         picked = gg_iota == jnp.expand_dims(gg_star, 1)
-        d = jnp.where(picked, _INF, d3).reshape(bm, g * kpad)
+        d = jnp.where(picked, inf32, d3).reshape(bm, g * kpad)
         # merge candidates into the sorted running top-k
         md = jnp.concatenate([bd, gmin], axis=1)          # (bm, 2*kpad)
         mi = jnp.concatenate([bi, cand_i], axis=1)
