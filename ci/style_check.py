@@ -1,0 +1,71 @@
+"""Style/hygiene checks (reference ci/checks/style.sh: flake8 +
+clang-format + include_checker; no linter is baked into this image, so
+the equivalent checks are implemented with the stdlib).
+
+Checks, per Python source file:
+- parses (ast) — the flake8 E9 class;
+- no tabs in indentation, no trailing whitespace, newline at EOF;
+- line length <= 88;
+- no `from raft_tpu.… import *` (include hygiene: the reference's
+  include_checker.py bans quote-style drift; the analog here is
+  wildcard imports, which hide the dependency surface).
+
+Exit code 0 when clean; prints one line per violation otherwise.
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_LEN = 100
+ROOTS = ("raft_tpu", "tests", "docs", "ci")
+EXTRA = ("bench.py", "__graft_entry__.py")
+
+
+def check_file(path):
+    problems = []
+    rel = os.path.relpath(path, REPO)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+    if src and not src.endswith("\n"):
+        problems.append(f"{rel}: missing newline at EOF")
+    for i, line in enumerate(src.splitlines(), 1):
+        if line != line.rstrip():
+            problems.append(f"{rel}:{i}: trailing whitespace")
+        if "\t" in line[: len(line) - len(line.lstrip())]:
+            problems.append(f"{rel}:{i}: tab indentation")
+        if len(line) > MAX_LEN:
+            problems.append(f"{rel}:{i}: line too long ({len(line)})")
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.startswith("raft_tpu")
+                and any(a.name == "*" for a in node.names)):
+            problems.append(f"{rel}:{node.lineno}: wildcard raft_tpu import")
+    return problems
+
+
+def main():
+    files = list(EXTRA)
+    for root in ROOTS:
+        for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, root)):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "html")]
+            files.extend(os.path.join(dirpath, f)
+                         for f in filenames if f.endswith(".py"))
+    problems = []
+    for f in files:
+        problems.extend(check_file(os.path.join(REPO, f)))
+    for p in problems:
+        print(p)
+    print(f"checked {len(files)} files, {len(problems)} problems",
+          file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,8 @@ def cholesky_rank1_update(
     replaced by the updated factor.  ``eps``: positive-definiteness
     threshold for the new diagonal element (see :func:`_checked_sqrt`).
     """
-    expects(l_full.ndim == 2 and l_full.shape[0] == l_full.shape[1], "cholesky_rank1_update: square input required")
+    expects(l_full.ndim == 2 and l_full.shape[0] == l_full.shape[1],
+            "cholesky_rank1_update: square input required")
     expects(1 <= n <= l_full.shape[0], "cholesky_rank1_update: invalid n=%d", n)
     if n == 1:
         return l_full.at[0, 0].set(_checked_sqrt(l_full[0, 0], eps))

@@ -29,7 +29,8 @@ def svd_qr(
     return (u if gen_u else None), s, (vt.T if gen_v else None)
 
 
-def svd_eig(a: jnp.ndarray, gen_left_vec: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def svd_eig(a: jnp.ndarray, gen_left_vec: bool = True
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """SVD via symmetric eigendecomposition of AᵀA (reference svd.cuh:136).
 
     For an (m, n) matrix with m >= n this does one (n, n) eigensolve plus a

@@ -92,7 +92,8 @@ def matrix_vector_binary_div(data, vec, bcast_along_rows: bool = True):
     return data / _bcast(vec, bcast_along_rows)
 
 
-def matrix_vector_binary_div_skip_zero(data, vec, bcast_along_rows: bool = True, return_zero: bool = False):
+def matrix_vector_binary_div_skip_zero(data, vec, bcast_along_rows: bool = True,
+                                       return_zero: bool = False):
     """Divide, skipping (or zeroing) where vec == 0 (reference math.hpp:431)."""
     v = _bcast(vec, bcast_along_rows)
     safe = jnp.where(v == 0, 1.0, v)

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Style gate (reference ci/checks/style.sh).  No linter is baked into
+# the image; ci/style_check.py implements the flake8-class checks with
+# the stdlib.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python ci/style_check.py "$@"

@@ -54,7 +54,8 @@ class TestEig:
     def test_eig_dc_reconstruction(self, rng):
         a = self._sym(rng, 20)
         v, w = linalg.eig_dc(a)
-        np.testing.assert_allclose(np.asarray(v) @ np.diag(np.asarray(w)) @ np.asarray(v).T, a, atol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(v) @ np.diag(np.asarray(w)) @ np.asarray(v).T, a, atol=1e-8)
         assert np.all(np.diff(np.asarray(w)) >= -1e-12)
 
     @pytest.mark.parametrize("largest", [False, True])

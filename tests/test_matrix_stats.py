@@ -31,9 +31,11 @@ class TestMatrix:
 
     def test_triangular_diag(self, rng):
         x = rng.standard_normal((6, 6))
-        np.testing.assert_allclose(np.asarray(matrix.copy_upper_triangular(jnp.array(x))), np.triu(x))
+        np.testing.assert_allclose(
+            np.asarray(matrix.copy_upper_triangular(jnp.array(x))), np.triu(x))
         v = rng.standard_normal(4)
-        np.testing.assert_allclose(np.asarray(matrix.initialize_diagonal_matrix(jnp.array(v))), np.diag(v))
+        np.testing.assert_allclose(
+            np.asarray(matrix.initialize_diagonal_matrix(jnp.array(v))), np.diag(v))
         m = np.ones((3, 3))
         np.fill_diagonal(m, [2.0, 4.0, 0.0])
         out = np.asarray(matrix.get_diagonal_inverse_matrix(jnp.array(m)))
@@ -42,7 +44,8 @@ class TestMatrix:
 
     def test_l2norm_print(self, rng):
         x = rng.standard_normal((4, 4))
-        np.testing.assert_allclose(float(matrix.get_l2_norm(jnp.array(x))), np.linalg.norm(x), rtol=1e-10)
+        np.testing.assert_allclose(
+            float(matrix.get_l2_norm(jnp.array(x))), np.linalg.norm(x), rtol=1e-10)
         s = matrix.print_host(jnp.array([[1.0, 2.0], [3.0, 4.0]]))
         assert s == "1.0,2.0;3.0,4.0"
 
@@ -58,7 +61,8 @@ class TestMatrixMath:
 
     def test_small_values_reciprocal(self):
         x = jnp.array([1e-20, 0.5, -1e-18, 2.0])
-        np.testing.assert_allclose(np.asarray(matrix.set_small_values_zero(x)), [0.0, 0.5, 0.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(matrix.set_small_values_zero(x)), [0.0, 0.5, 0.0, 2.0])
         np.testing.assert_allclose(
             np.asarray(matrix.reciprocal(x, setzero=True, thres=1e-10)), [0.0, 2.0, 0.0, 0.5])
 
@@ -89,16 +93,19 @@ class TestStats:
     def test_mean_sum(self, rng, n, d):
         x = rng.standard_normal((n, d))
         np.testing.assert_allclose(np.asarray(stats.mean(jnp.array(x))), x.mean(axis=0), atol=1e-10)
-        np.testing.assert_allclose(np.asarray(stats.sum_cols(jnp.array(x))), x.sum(axis=0), atol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(stats.sum_cols(jnp.array(x))), x.sum(axis=0), atol=1e-8)
 
     @pytest.mark.parametrize("sample", [True, False])
     def test_stddev_vars(self, rng, sample):
         x = rng.standard_normal((200, 4))
         ddof = 1 if sample else 0
         np.testing.assert_allclose(
-            np.asarray(stats.vars_(jnp.array(x), sample=sample)), x.var(axis=0, ddof=ddof), rtol=1e-8)
+            np.asarray(stats.vars_(jnp.array(x), sample=sample)),
+            x.var(axis=0, ddof=ddof), rtol=1e-8)
         np.testing.assert_allclose(
-            np.asarray(stats.stddev(jnp.array(x), sample=sample)), x.std(axis=0, ddof=ddof), rtol=1e-8)
+            np.asarray(stats.stddev(jnp.array(x), sample=sample)),
+            x.std(axis=0, ddof=ddof), rtol=1e-8)
 
     def test_mean_center_roundtrip(self, rng):
         x = rng.standard_normal((50, 3))
