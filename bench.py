@@ -60,8 +60,11 @@ _CPU_ENV = "RAFT_TPU_BENCH_CPU"
 _SAFETY = 12.0          # parent prints this many seconds before the budget
 _CPU_RETRY_COST = 100.0  # min budget left to bother starting a CPU child
 
-# an operator pin of the fused-kNN impl, captured before any rung mutates it
+# operator pins of the fused-kNN / selection impls, captured before any
+# rung mutates the env (a pinned env var must win over the ladder AND be
+# reported truthfully)
 _OPERATOR_IMPL = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
+_OPERATOR_SELECT = os.environ.get("RAFT_TPU_SELECT_IMPL")
 
 
 # --------------------------------------------------------------------------
@@ -70,11 +73,14 @@ _OPERATOR_IMPL = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
 
 def assemble(state):
     """Fold rung results into the single headline JSON object."""
+    def best(*names):
+        cands = [state.get(n) for n in names]
+        return max((c for c in cands if c and c.get("qps")),
+                   key=lambda c: c["qps"], default=None)
+
     detail = dict(state)
-    candidates = [state.get("knn_1m"), state.get("knn_1m_pallas")]
-    knn_1m = max((c for c in candidates if c and c.get("qps")),
-                 key=lambda c: c["qps"], default=None)
-    knn_100k = state.get("knn_100k")
+    knn_1m = best("knn_1m", "knn_1m_pallas")
+    knn_100k = best("knn_100k", "knn_100k_approx")
     fallback = state.get("fallback") == "cpu"
     if knn_1m:
         metric = "knn_qps_1M_128d_k100"
@@ -197,16 +203,20 @@ def _bench_pairwise(m, iters):
     }
 
 
-def _bench_knn(n_index, n_query, iters, impl):
+def _bench_knn(n_index, n_query, iters, impl, select_impl=None):
     from raft_tpu.spatial import brute_force_knn
 
     dim, k = 128, 100
     index = _rand((n_index, dim), 3)
     queries = _rand((n_query, dim), 4)
-    impl = _OPERATOR_IMPL or impl  # an operator env pin wins over the ladder
-    prev = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
+    impl = _OPERATOR_IMPL or impl  # operator env pins win over the ladder
+    select_impl = _OPERATOR_SELECT or select_impl
+    prev = {v: os.environ.get(v) for v in
+            ("RAFT_TPU_FUSED_KNN_IMPL", "RAFT_TPU_SELECT_IMPL")}
     if impl:
         os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = impl
+    if select_impl:
+        os.environ["RAFT_TPU_SELECT_IMPL"] = select_impl
 
     def step(q):
         dists, _ = brute_force_knn([index], q, k)
@@ -215,17 +225,18 @@ def _bench_knn(n_index, n_query, iters, impl):
     try:
         dt = _time_chained(step, queries, iters)
     finally:
-        if prev is None:
-            os.environ.pop("RAFT_TPU_FUSED_KNN_IMPL", None)
-        else:
-            os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = prev
+        for var, val in prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
     qps = n_query / dt
     return {
         "qps": round(qps, 1),
         "qps_1m_equiv": round(qps * n_index / 1_000_000, 1),
         "seconds_per_batch": round(dt, 4),
         "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
-        "impl": impl or "xla",
+        "impl": impl or "xla", "select_impl": select_impl or "topk",
     }
 
 
@@ -317,14 +328,30 @@ def child_main():
             ("spectral", 40, _bench_spectral),
         ]
     else:
+        def best_select():
+            """approx_max_k (TPU PartialReduce) vs top_k, per measurement
+            at 100k — the winner drives the 1M rung."""
+            a = state.get("knn_100k_approx", {})
+            b = state.get("knn_100k", {})
+            if a.get("qps", 0) > b.get("qps", 0):
+                return "approx"
+            return None
+
         # knn_1m (the headline, proven XLA impl) runs BEFORE pallas_check:
         # a Mosaic compile hang in this process must not forfeit the
         # north-star number (the parent can only kill the whole child)
         rungs = [
             ("pairwise_2k", 45, lambda: _bench_pairwise(2048, 8)),
             ("knn_100k", 80, lambda: _bench_knn(100_000, 4096, 4, "xla")),
+            # gate = its own cost (60) PLUS the 1M rung's (140): the
+            # comparison rung must never consume the budget that would
+            # otherwise let the north-star headline run
+            ("knn_100k_approx", 60 + 140,
+             lambda: _bench_knn(100_000, 4096, 4, "xla",
+                                select_impl="approx")),
             ("knn_1m", 140,
-             lambda: _bench_knn(1_000_000, 10_000, 3, "xla")),
+             lambda: _bench_knn(1_000_000, 10_000, 3, "xla",
+                                select_impl=best_select())),
             ("pallas_check", 100, lambda: _bench_pallas(state)),
             ("knn_1m_pallas", 120, knn_pallas_1m),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 16)),

@@ -1,0 +1,41 @@
+"""Memory resources: explicit-lifetime device buffers and pooling.
+
+Reference: ``raft::mr`` (cpp/include/raft/mr/) — ``base_allocator``
+(mr/allocator.hpp:35) with device/host variants and the owning
+``buffer_base`` (mr/buffer_base.hpp:39) used by comms and the kNN API.
+
+TPU mapping.  XLA owns the HBM heap (the BFC allocator plays RMM's
+role), so a faithful re-implementation of a raw allocator would fight
+the runtime.  What survives the translation is the *lifetime and reuse*
+story the reference's mr layer provides to eager callers:
+
+- :class:`DeviceBuffer` / :class:`HostBuffer` — owning buffers with
+  explicit ``deallocate()`` (``jax.Array.delete()`` frees the backing
+  HBM eagerly instead of waiting for GC — buffer_base's dtor semantics).
+- :class:`PoolAllocator` — freelist reuse of same-(shape, dtype)
+  buffers for eager loops holding large scratch arrays (the role of
+  RMM's pool_memory_resource for repeated workspace allocations).
+- :func:`device_memory_stats` — bytes in use / limit from the device
+  (``cudaMemGetInfo``'s role, cudart_utils.h).
+- the native *host* arena (cpp/include/raft_tpu/arena.hpp, exposed via
+  :func:`raft_tpu.core.native.arena_stats`) covers the host-side
+  allocator row.
+
+In-jit code needs none of this: XLA plans temp memory statically and
+``donate_argnums`` recycles inputs.  These helpers are for the eager
+boundary, where Python GC latency would otherwise hold HBM hostage.
+"""
+
+from raft_tpu.mr.buffer import (
+    DeviceBuffer,
+    HostBuffer,
+    PoolAllocator,
+    device_memory_stats,
+)
+
+__all__ = [
+    "DeviceBuffer",
+    "HostBuffer",
+    "PoolAllocator",
+    "device_memory_stats",
+]

@@ -1,0 +1,168 @@
+"""Owning buffers + pool allocator (see package docstring for the
+design mapping to reference mr/allocator.hpp:35 / buffer_base.hpp:39)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:
+    """Bytes in use / limit for a device (cudaMemGetInfo's role,
+    reference cudart_utils.h).  Backends without stats return {}."""
+    d = device if device is not None else jax.devices()[0]
+    try:
+        stats = d.memory_stats() or {}
+    except Exception:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+        if key in stats:
+            out[key] = int(stats[key])
+    return out
+
+
+class DeviceBuffer:
+    """Owning device allocation with explicit lifetime (reference
+    ``device_buffer`` = buffer_base over the device allocator,
+    mr/buffer_base.hpp:39).
+
+    ``deallocate()`` frees the backing HBM *now* (``jax.Array.delete``)
+    rather than when Python GC gets around to it — the dtor semantics
+    eager pipelines need when cycling large scratch arrays.
+    """
+
+    def __init__(self, shape: Tuple[int, ...], dtype=jnp.float32,
+                 device: Optional[jax.Device] = None,
+                 _array: Optional[jax.Array] = None):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.device = device if device is not None else jax.devices()[0]
+        if _array is not None:
+            self._array: Optional[jax.Array] = _array
+        else:
+            self._array = jax.device_put(
+                jnp.zeros(self.shape, self.dtype), self.device)
+
+    @classmethod
+    def from_array(cls, array) -> "DeviceBuffer":
+        """Adopt an existing array (reference buffer_base's
+        pointer-adopting ctor)."""
+        arr = jnp.asarray(array)
+        dev = list(arr.devices())[0]
+        return cls(arr.shape, arr.dtype, dev, _array=arr)
+
+    @property
+    def data(self) -> jax.Array:
+        """The live array (reference ``buffer.data()``)."""
+        expects(self._array is not None, "DeviceBuffer: use after deallocate")
+        return self._array
+
+    def size_bytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+
+    @property
+    def deallocated(self) -> bool:
+        return self._array is None or self._array.is_deleted()
+
+    def deallocate(self) -> None:
+        """Free the device memory immediately; idempotent."""
+        if self._array is not None and not self._array.is_deleted():
+            self._array.delete()
+        self._array = None
+
+    def __enter__(self) -> "DeviceBuffer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.deallocate()
+
+
+class HostBuffer(DeviceBuffer):
+    """Host-side owning buffer (reference ``host_buffer``).  Backed by
+    numpy (always host-resident); same explicit-lifetime interface."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype=jnp.float32):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.device = None
+        self._np: Optional[np.ndarray] = np.zeros(shape, self.dtype)
+        self._array = None
+
+    @classmethod
+    def from_array(cls, array) -> "HostBuffer":
+        arr = np.asarray(array)
+        buf = cls(arr.shape, arr.dtype)
+        buf._np = arr  # adopt without copy
+        return buf
+
+    @property
+    def data(self) -> np.ndarray:
+        expects(self._np is not None, "HostBuffer: use after deallocate")
+        return self._np
+
+    @property
+    def deallocated(self) -> bool:
+        return self._np is None
+
+    def deallocate(self) -> None:
+        self._np = None
+
+
+class PoolAllocator:
+    """Freelist reuse of same-(shape, dtype) device buffers (the role of
+    RMM's pool resource for repeated eager workspace allocations —
+    allocation latency and fragmentation, not capacity, are what it
+    buys on a runtime whose heap XLA already owns).
+
+    ``allocate`` returns a pooled buffer when one matches, else a fresh
+    one; ``deallocate`` returns the buffer to the pool (device memory
+    stays live for reuse).  ``release`` frees everything pooled.
+
+    Like RMM's pool resource, a pool HIT returns the buffer with its
+    previous contents — only the fresh-allocation path zero-fills.
+    Callers needing zeros must clear the buffer themselves.
+    """
+
+    def __init__(self, device: Optional[jax.Device] = None,
+                 max_pooled_per_key: int = 4):
+        self.device = device if device is not None else jax.devices()[0]
+        self.max_pooled_per_key = max_pooled_per_key
+        self._free: Dict[Tuple, List[DeviceBuffer]] = {}
+        self.n_hits = 0
+        self.n_misses = 0
+
+    def _key(self, shape, dtype):
+        return (tuple(shape), jnp.dtype(dtype).name)
+
+    def allocate(self, shape, dtype=jnp.float32) -> DeviceBuffer:
+        bucket = self._free.get(self._key(shape, dtype))
+        if bucket:
+            self.n_hits += 1
+            return bucket.pop()
+        self.n_misses += 1
+        return DeviceBuffer(shape, dtype, self.device)
+
+    def deallocate(self, buf: DeviceBuffer) -> None:
+        expects(not buf.deallocated,
+                "PoolAllocator: cannot pool a deallocated buffer")
+        bucket = self._free.setdefault(self._key(buf.shape, buf.dtype), [])
+        if len(bucket) < self.max_pooled_per_key:
+            bucket.append(buf)
+        else:
+            buf.deallocate()
+
+    def pooled_bytes(self) -> int:
+        return sum(b.size_bytes() for bs in self._free.values() for b in bs)
+
+    def release(self) -> None:
+        """Free all pooled memory (RMM pool release)."""
+        for bs in self._free.values():
+            for b in bs:
+                b.deallocate()
+        self._free.clear()

@@ -8,12 +8,19 @@ shared memory, specialised for k ≤ {32,64,128,256,512,1024}.
 
 TPU re-design: there are no warp shuffles or per-thread heaps on a
 systolic/vector machine; the efficient shapes are (a) XLA's native sorted
-``TopK`` (bitonic-style, k-specialised) and (b) on real TPU hardware the
-``approx_max_k`` MIPS instruction path with recall=1.0.  Both keep the
-whole row in VMEM-resident vectors; for very wide rows XLA tiles
-internally.  We dispatch to ``lax.top_k`` (exact, sorted, stable toward
-smaller index on ties — the same tie rule as the reference's heap with
-sequential insertion) and translate min-selection by key negation.
+``TopK`` (bitonic-style, k-specialised) and (b) the TPU
+``approx_max_k`` PartialReduce instruction path, exact at
+``recall_target=1.0`` + ``aggregate_to_topk`` and typically faster on
+wide rows.  Both keep the whole row in VMEM-resident vectors; for very
+wide rows XLA tiles internally.  Min-selection is key negation.
+
+Implementation choice (``impl``): ``"topk"`` (default) is ``lax.top_k``
+— exact, sorted, stable toward smaller index on ties (the same tie rule
+as the reference's heap with sequential insertion).  ``"approx"`` is
+``lax.approx_max_k`` — exact in *membership* at recall 1.0 but with no
+tie-order guarantee.  Default comes from ``RAFT_TPU_SELECT_IMPL`` (read
+at trace time; the bench measures both on hardware and reports the
+winner rather than assuming).
 
 ``select_k`` is THE building block for kNN merge and ANN list scans, so it
 accepts an optional payload (``values``) to carry indices through
@@ -22,6 +29,7 @@ selection, mirroring the (key, value) pairs of the reference heaps.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -30,11 +38,27 @@ from jax import lax
 from raft_tpu.core.error import expects
 
 
+def top_k_rows(sel: jnp.ndarray, k: int,
+               impl: Optional[str] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw per-row top-k (largest) with impl dispatch (module doc).
+    Shared by :func:`select_k` and the tile-scan kNN driver."""
+    if impl is None:
+        impl = os.environ.get("RAFT_TPU_SELECT_IMPL", "topk")
+    expects(impl in ("topk", "approx"),
+            "select_k: unknown impl %s", impl)
+    if impl == "approx":
+        return lax.approx_max_k(sel, k, recall_target=1.0,
+                                aggregate_to_topk=True)
+    return lax.top_k(sel, k)
+
+
 def select_k(
     keys: jnp.ndarray,
     k: int,
     select_min: bool = True,
     values: Optional[jnp.ndarray] = None,
+    impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Select the k smallest (or largest) keys per row.
 
@@ -51,6 +75,8 @@ def select_k(
         Optional (m, n) payload carried through selection (e.g. global
         ids).  Defaults to the column index, matching the reference's
         identity-value path.
+    impl:
+        "topk" | "approx" | None (env/default; module doc).
 
     Returns
     -------
@@ -62,7 +88,7 @@ def select_k(
     expects(0 < k <= n, "select_k: k=%d out of range for n=%d", k, n)
 
     sel = -keys if select_min else keys
-    top_vals, top_idx = lax.top_k(sel, k)
+    top_vals, top_idx = top_k_rows(sel, k, impl)
     out_keys = -top_vals if select_min else top_vals
     if values is None:
         return out_keys, top_idx.astype(jnp.int32)

@@ -18,6 +18,7 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import ceildiv
+from raft_tpu.spatial.select_k import top_k_rows
 
 
 def tiled_knn(
@@ -50,7 +51,10 @@ def tiled_knn(
         x_t = lax.dynamic_slice_in_dim(x_p, j0, tile_n, axis=0)
         v_t = lax.dynamic_slice_in_dim(valid, j0, tile_n, axis=0)
         d = jnp.where(v_t[None, :], tile_dist(queries, x_t), jnp.inf)
-        t_vals, t_idx = lax.top_k(-d, k)
+        # wide tile selection dispatches impl (top_k vs the TPU
+        # approx_max_k instruction at recall 1.0 — see select_k module
+        # doc); the narrow 2k merge below stays lax.top_k
+        t_vals, t_idx = top_k_rows(-d, k)
         t_idx = (j0 + t_idx).astype(jnp.int32)
         # merge running and tile top-k: 2k-wide re-selection
         cat_d = jnp.concatenate([best_d, -t_vals], axis=1)

@@ -1,0 +1,89 @@
+"""Memory-resource tests (reference test/mr/device/buffer.cpp,
+test/mr/host/buffer.cpp)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import RaftError
+from raft_tpu.mr import (DeviceBuffer, HostBuffer, PoolAllocator,
+                         device_memory_stats)
+
+
+class TestDeviceBuffer:
+    def test_alloc_use_free(self):
+        buf = DeviceBuffer((128, 64), jnp.float32)
+        assert buf.data.shape == (128, 64)
+        assert buf.size_bytes() == 128 * 64 * 4
+        assert not buf.deallocated
+        buf.deallocate()
+        assert buf.deallocated
+        with pytest.raises(RaftError, match="use after deallocate"):
+            _ = buf.data
+        buf.deallocate()  # idempotent
+
+    def test_from_array_adopts(self):
+        x = jnp.arange(16.0)
+        buf = DeviceBuffer.from_array(x)
+        assert float(buf.data[3]) == 3.0
+        buf.deallocate()
+        assert x.is_deleted()
+
+    def test_context_manager(self):
+        with DeviceBuffer((8,), jnp.int32) as buf:
+            assert buf.data.dtype == jnp.int32
+        assert buf.deallocated
+
+
+class TestHostBuffer:
+    def test_alloc_use_free(self):
+        buf = HostBuffer((4, 4), jnp.float64)
+        buf.data[1, 2] = 7.0
+        assert buf.data[1, 2] == 7.0
+        assert isinstance(buf.data, np.ndarray)
+        buf.deallocate()
+        assert buf.deallocated
+
+
+class TestPoolAllocator:
+    def test_reuse(self):
+        pool = PoolAllocator()
+        a = pool.allocate((256, 32))
+        pool.deallocate(a)
+        b = pool.allocate((256, 32))
+        assert b is a                       # freelist hit
+        assert pool.n_hits == 1 and pool.n_misses == 1
+        c = pool.allocate((256, 32))
+        assert c is not a                   # pool empty again
+        assert pool.n_misses == 2
+
+    def test_key_isolation(self):
+        pool = PoolAllocator()
+        a = pool.allocate((16,), jnp.float32)
+        pool.deallocate(a)
+        b = pool.allocate((16,), jnp.int32)
+        assert b is not a
+
+    def test_cap_and_release(self):
+        pool = PoolAllocator(max_pooled_per_key=1)
+        a, b = pool.allocate((8,)), pool.allocate((8,))
+        pool.deallocate(a)
+        pool.deallocate(b)                  # over cap: freed outright
+        assert b.deallocated and not a.deallocated
+        assert pool.pooled_bytes() == 8 * 4
+        pool.release()
+        assert a.deallocated and pool.pooled_bytes() == 0
+
+    def test_rejects_dead_buffer(self):
+        pool = PoolAllocator()
+        a = pool.allocate((8,))
+        a.deallocate()
+        with pytest.raises(RaftError):
+            pool.deallocate(a)
+
+
+def test_memory_stats_shape():
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)
+    for v in stats.values():
+        assert isinstance(v, int)

@@ -321,3 +321,41 @@ class TestHandleThreading:
         h.sync_stream()
         labels = np.asarray(res.clusters)
         assert len(set(labels[:3])) == 1 and len(set(labels[3:])) == 1
+
+
+class TestSelectKImpl:
+    """approx_max_k path (TPU PartialReduce; exact membership at
+    recall_target=1.0) vs the default top_k."""
+
+    def test_approx_matches_topk_membership(self):
+        rng = np.random.default_rng(0)
+        keys = jnp.asarray(rng.standard_normal((32, 4096)), jnp.float32)
+        from raft_tpu.spatial.select_k import select_k
+
+        d_t, i_t = select_k(keys, 16, select_min=True, impl="topk")
+        d_a, i_a = select_k(keys, 16, select_min=True, impl="approx")
+        # membership and sorted keys identical on distinct keys; tie
+        # ORDER is not guaranteed by the approx path (module doc)
+        np.testing.assert_allclose(np.sort(np.asarray(d_a), 1),
+                                   np.sort(np.asarray(d_t), 1), atol=1e-6)
+        for r in range(32):
+            assert set(np.asarray(i_a)[r]) == set(np.asarray(i_t)[r])
+
+    def test_payload_carried(self):
+        rng = np.random.default_rng(1)
+        keys = jnp.asarray(rng.standard_normal((4, 256)), jnp.float32)
+        payload = jnp.asarray(rng.integers(0, 9999, (4, 256)), jnp.int32)
+        from raft_tpu.spatial.select_k import select_k
+
+        d, v = select_k(keys, 8, select_min=False, values=payload,
+                        impl="approx")
+        ref_d, ref_i = select_k(keys, 8, select_min=False)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(ref_d),
+                                   atol=1e-6)
+
+    def test_env_default(self, monkeypatch):
+        from raft_tpu.spatial.select_k import select_k
+
+        monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "bogus")
+        with pytest.raises(Exception, match="unknown impl"):
+            select_k(jnp.ones((2, 8)), 2)
