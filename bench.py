@@ -2,27 +2,35 @@
 """Headline benchmark — prints ONE JSON line, always, within a hard budget.
 
 Measures the BASELINE.md configs as a *ladder*, banking each rung as it
-completes: pairwise-L2 Gpairs/s (config #1/#2) at 2k then 8k, brute-force
-kNN QPS (config #3) at 100k then the 1M x 128 k=100 north star, the
-compiled-Pallas fused-kNN comparison, and a small spectral embedding
-(config #4).  The headline metric is the best kNN rung completed.
+completes.  Rungs are ordered by compile cost so the first hardware
+number banks as early as possible: the README config (pairwise
+L2SqrtExpanded 1k x 64, BASELINE.md #1) first, then pairwise 2k, kNN
+100k, the 1M x 128 k=100 north star (#3), the compiled-Pallas fused
+kernel comparison, and a small spectral embedding (#4).  The headline
+metric is the best accelerator kNN rung, falling back to an accelerator
+pairwise rung, then to CPU kNN.
 
-Architecture (round-2 postmortem: the bench was killed by the harness
-timeout before printing anything — rc=124):
+Architecture (round-3 verdict: three rounds of CPU fallbacks because
+backend init ate the budget sequentially):
 
-- the PARENT process never imports JAX.  It owns a hard wall-clock budget
-  (``RAFT_TPU_BENCH_BUDGET`` seconds, default 420) and a deadline loop;
-  nothing the backend does (hung PJRT init, hung Mosaic compile) can keep
-  it from printing the best JSON assembled so far and exiting 0.
-- ONE measuring CHILD process does all JAX work (a single backend init —
-  round 2 measured >180 s per init in this environment, so extra probe
-  subprocesses are unaffordable).  It streams ``PARTIAL <json>`` lines
-  after every rung; the parent folds them into the final result.
-- the child sees the same deadline (env) and skips rungs that don't fit,
-  recording them as skipped; the parent kills it at the deadline.
-- if the child dies or produces nothing with enough budget left, the
-  parent retries once on CPU (``JAX_PLATFORMS=cpu``) with scaled shapes
-  and reports honestly (``fallback: "cpu"``).
+- the PARENT process never imports JAX.  It owns a hard wall-clock
+  budget (``RAFT_TPU_BENCH_BUDGET`` seconds, default 420) and a
+  deadline loop; nothing the backend does (hung PJRT init, hung Mosaic
+  compile) can keep it from printing the best JSON assembled so far and
+  exiting 0.
+- TWO children start at t=0 *in parallel*: the TPU child gets the
+  entire budget minus safety (a hung PJRT init burns no CPU), and the
+  CPU child banks scaled fallback rungs for free from the first second
+  instead of being a sequential retry.  Accelerator partials always
+  supersede CPU ones in the headline.
+- both children stream ``PARTIAL <json>`` lines after every rung, each
+  rung carrying a ``device`` field; the TPU child additionally streams
+  a timestamped ``init_log`` so a budget-eating backend init is
+  *provable* from the report rather than inferred.
+- the parent distinguishes "child died before init" (exit status +
+  stderr tail) from "killed at deadline during init" (init_log shows
+  where it sat) from "init ok but no rung fit" — the three look
+  identical in a bare fallback note but need different fixes.
 
 Timing methodology: the device can sit behind a high-latency transport
 where per-call host timing is unreliable, so each rung runs ITERS
@@ -31,13 +39,13 @@ data-dependent iterations inside ONE compiled ``fori_loop`` program
 an n-iteration call against a 1-iteration call of the *same* executable
 to cancel fixed dispatch/fetch latency.
 
-vs_baseline: the reference publishes no numbers (BASELINE.md), so the
-baseline constant is an A100 estimate for the same op derived from the
-north-star target ("within 1.5x of A100 wall-clock"):
-- brute-force kNN 1M x 128 k=100: FAISS-class A100 throughput ~20k QPS.
-  vs_baseline = ours / 20000 (smaller-index rungs normalized to their
-  1M-index equivalent: per-query work scales with n_index).
-- pairwise L2 f32: A100 sustains ~50 Gpairs/s at d=128.
+Perf accounting: every accelerator rung reports an ``mfu`` block —
+analytic FLOPs (2*m*n*d for distance-shaped ops), achieved FLOP/s, and
+the fraction of the chip's nominal bf16 MXU peak (generation detected
+from ``device_kind``).  This replaces "vs an A100 guess" as the basis
+for the perf verdict; ``vs_baseline`` keeps the A100-derived constants
+only because BASELINE.md defines the north star that way (the reference
+publishes no numbers).
 """
 
 import json
@@ -54,11 +62,24 @@ sys.path.insert(0, REPO)
 KNN_BASELINE_QPS = 20000.0
 PAIRWISE_BASELINE_GPAIRS = 50.0
 
+# Nominal dense bf16 MXU peak FLOP/s per chip, by generation.  f32
+# inputs (our benchmarked dtype) run below this (bf16x3 passes or
+# conversion), so mfu is a conservative fraction of the chip's
+# *headline* peak — honest accounting, not marketing.  Sources: public
+# TPU spec sheets.
+TPU_PEAK_BF16 = {
+    "v2": 46e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
 _BUDGET_ENV = "RAFT_TPU_BENCH_BUDGET"
 _DEADLINE_ENV = "RAFT_TPU_BENCH_DEADLINE"
 _CPU_ENV = "RAFT_TPU_BENCH_CPU"
 _SAFETY = 12.0          # parent prints this many seconds before the budget
-_CPU_RETRY_COST = 100.0  # min budget left to bother starting a CPU child
 
 # operator pins of the fused-kNN / selection impls, captured before any
 # rung mutates the env (a pinned env var must win over the ladder AND be
@@ -67,47 +88,104 @@ _OPERATOR_IMPL = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
 _OPERATOR_SELECT = os.environ.get("RAFT_TPU_SELECT_IMPL")
 
 
+def chip_peak_flops(device_kind, platform):
+    """(peak_flops, generation) from a PJRT device_kind string, or
+    (None, None) when unrecognized / not an accelerator.  Generation
+    number is matched before the lite/p suffix so 'TPU v6 lite' maps to
+    v6e, not v5e."""
+    s = (device_kind or "").lower().replace(" ", "")
+    hint = (os.environ.get("PALLAS_AXON_TPU_GEN") or "").lower()
+    gen = None
+    if "v6" in s or "trillium" in s:
+        gen = "v6e"
+    elif "v5" in s:
+        gen = "v5e" if ("lite" in s or "5e" in s) else "v5p"
+    elif "v4" in s:
+        gen = "v4"
+    elif "v3" in s:
+        gen = "v3"
+    elif "v2" in s:
+        gen = "v2"
+    if gen:
+        return TPU_PEAK_BF16[gen], gen
+    if platform != "cpu" and hint in TPU_PEAK_BF16:
+        return TPU_PEAK_BF16[hint], hint + "(env)"
+    return None, None
+
+
 # --------------------------------------------------------------------------
 # result assembly (shared by parent and child)
 # --------------------------------------------------------------------------
 
-def assemble(state):
-    """Fold rung results into the single headline JSON object."""
-    def best(*names):
-        cands = [state.get(n) for n in names]
-        return max((c for c in cands if c and c.get("qps")),
-                   key=lambda c: c["qps"], default=None)
+def _best_knn(state, *names):
+    cands = [state.get(n) for n in names]
+    return max((c for c in cands if c and c.get("qps")),
+               key=lambda c: c["qps"], default=None)
 
-    detail = dict(state)
-    knn_1m = best("knn_1m", "knn_1m_pallas")
-    knn_100k = best("knn_100k", "knn_100k_approx")
-    fallback = state.get("fallback") == "cpu"
+
+def assemble(tpu_state, cpu_state):
+    """Fold both children's rung results into the headline JSON object.
+
+    Preference order for the headline: accelerator kNN > accelerator
+    pairwise > CPU-fallback kNN > zero.
+    """
+    tpu_state = tpu_state or {}
+    cpu_state = cpu_state or {}
+    detail = dict(tpu_state)
+    if cpu_state:
+        detail["cpu_fallback"] = cpu_state
+
+    knn_1m = _best_knn(tpu_state, "knn_1m", "knn_1m_pallas")
+    knn_100k = _best_knn(tpu_state, "knn_100k", "knn_100k_approx")
+    pw = None
+    for name in ("pairwise_8k", "pairwise_2k", "pairwise_1k"):
+        cand = tpu_state.get(name)
+        if cand and cand.get("gpairs_per_sec"):
+            pw = cand
+            break
+    cpu_knn = _best_knn(cpu_state, "knn_100k")
+
     if knn_1m:
-        metric = "knn_qps_1M_128d_k100"
-        value = knn_1m["qps"]
-        equiv = knn_1m["qps"]
-    elif knn_100k and knn_100k.get("qps"):
+        metric, value = "knn_qps_1M_128d_k100", knn_1m["qps"]
+        unit, vs = "queries/s", knn_1m["qps"] / KNN_BASELINE_QPS
+    elif knn_100k:
         n_index = knn_100k["n_index"]
-        metric = "knn_qps_%dk_128d_k100%s" % (
-            n_index // 1000, "_cpu_fallback" if fallback else "")
+        metric = "knn_qps_%dk_128d_k100" % (n_index // 1000)
         value = knn_100k["qps"]
-        equiv = knn_100k["qps"] * (n_index / 1_000_000)
+        unit = "queries/s"
+        vs = value * (n_index / 1_000_000) / KNN_BASELINE_QPS
+    elif pw:
+        m, _, d = pw["shape"]
+        metric = "pairwise_l2_gpairs_%dx%d" % (m, d)
+        value = pw["gpairs_per_sec"]
+        unit = "Gpairs/s"
+        # the 50 Gpairs/s A100 constant is defined at d=128: normalize
+        # this rung's pair rate to its d=128 FLOP equivalent
+        vs = value * (d / 128.0) / PAIRWISE_BASELINE_GPAIRS
+    elif cpu_knn:
+        n_index = cpu_knn["n_index"]
+        metric = "knn_qps_%dk_128d_k100_cpu_fallback" % (n_index // 1000)
+        value = cpu_knn["qps"]
+        unit = "queries/s"
+        vs = value * (n_index / 1_000_000) / KNN_BASELINE_QPS
     else:
-        metric = "knn_qps_1M_128d_k100"
-        value = 0.0
-        equiv = 0.0
+        metric, value, unit, vs = "knn_qps_1M_128d_k100", 0.0, "queries/s", 0.0
     return {
         "metric": metric,
         "value": round(value, 1),
-        "unit": "queries/s",
-        "vs_baseline": round(equiv / KNN_BASELINE_QPS, 4),
+        "unit": unit,
+        "vs_baseline": round(vs, 4),
         "detail": detail,
     }
 
 
 # --------------------------------------------------------------------------
-# child: the only process that imports JAX
+# child: the only process kind that imports JAX
 # --------------------------------------------------------------------------
+
+_CHILD_T0 = time.time()
+_INIT_LOG = []
+
 
 def _remaining():
     return float(os.environ[_DEADLINE_ENV]) - time.time()
@@ -115,6 +193,36 @@ def _remaining():
 
 def _emit(name, payload):
     print("PARTIAL " + json.dumps({name: payload}), flush=True)
+
+
+def _log_init(event):
+    _INIT_LOG.append({"t": round(time.time() - _CHILD_T0, 1), "event": event})
+    _emit("init_log", _INIT_LOG)
+
+
+_DEVICE_INFO = {}
+
+
+def _tag(payload):
+    """Attach the measured device to a rung result."""
+    if isinstance(payload, dict) and _DEVICE_INFO:
+        payload.setdefault("device", _DEVICE_INFO.get("device"))
+        payload.setdefault("platform", _DEVICE_INFO.get("platform"))
+    return payload
+
+
+def _mfu(flops_per_call, seconds_per_call):
+    achieved = flops_per_call / seconds_per_call
+    out = {"flops_per_call": flops_per_call,
+           "achieved_tflops": round(achieved / 1e12, 3)}
+    peak, gen = chip_peak_flops(_DEVICE_INFO.get("device"),
+                                _DEVICE_INFO.get("platform"))
+    if peak:
+        out["chip_gen"] = gen
+        out["peak_tflops_bf16"] = round(peak / 1e12, 1)
+        out["mfu"] = round(achieved / peak, 4)
+        out["peak_basis"] = "bf16 MXU peak; inputs are f32"
+    return out
 
 
 def _time_chained(step, x, iters):
@@ -160,9 +268,11 @@ def _rand(shape, seed):
 
 def _rung_init():
     t0 = time.time()
+    _log_init("backend_init_start")
     import jax
     import jax.numpy as jnp
 
+    _log_init("jax_imported")
     if os.environ.get(_CPU_ENV) == "1":
         # env-var JAX_PLATFORMS is NOT enough: a sitecustomize-registered
         # accelerator plugin may force jax_platforms via jax.config at
@@ -170,11 +280,17 @@ def _rung_init():
         # (before any device op) wins
         jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
+    _log_init("devices_ready")
     x = jnp.ones((128, 128), jnp.float32)
     v = float((x @ x)[0, 0])
     assert v == 128.0, v
+    _log_init("first_matmul_done")
     from raft_tpu.core.utils import is_tpu_backend
 
+    _DEVICE_INFO.update({
+        "device": str(dev.device_kind),
+        "platform": str(dev.platform),
+    })
     return {
         "seconds": round(time.time() - t0, 1),
         "device": str(dev.device_kind),
@@ -183,15 +299,16 @@ def _rung_init():
     }
 
 
-def _bench_pairwise(m, iters):
+def _bench_pairwise(m, dim, iters, sqrt=False):
     from raft_tpu.distance import DistanceType, pairwise_distance
 
-    dim = 128
+    metric = (DistanceType.L2SqrtExpanded if sqrt
+              else DistanceType.L2Expanded)
     x = _rand((m, dim), 1)
     y = _rand((m, dim), 2)
 
     def step(a):
-        return pairwise_distance(a, y, DistanceType.L2Expanded)
+        return pairwise_distance(a, y, metric)
 
     dt = _time_chained(step, x, iters)
     gpairs = m * m / dt / 1e9
@@ -199,7 +316,11 @@ def _bench_pairwise(m, iters):
         "gpairs_per_sec": round(gpairs, 2),
         "seconds_per_call": round(dt, 5),
         "shape": [m, m, dim],
-        "vs_a100_estimate": round(gpairs / PAIRWISE_BASELINE_GPAIRS, 3),
+        "metric": "L2SqrtExpanded" if sqrt else "L2Expanded",
+        "mfu": _mfu(2.0 * m * m * dim, dt),
+        # A100 constant is at d=128: normalize to the d=128 equivalent
+        "vs_a100_estimate": round(
+            gpairs * (dim / 128.0) / PAIRWISE_BASELINE_GPAIRS, 3),
     }
 
 
@@ -237,6 +358,7 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None):
         "seconds_per_batch": round(dt, 4),
         "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
         "impl": impl or "xla", "select_impl": select_impl or "topk",
+        "mfu": _mfu(2.0 * n_query * n_index * dim, dt),
     }
 
 
@@ -268,6 +390,7 @@ def _bench_pallas(state):
             dt = _time_chained(step, queries, 2)
             out[impl + "_seconds_per_batch"] = round(dt, 4)
             out[impl + "_qps_100k"] = round(1024 / dt, 1)
+            out[impl + "_mfu"] = _mfu(2.0 * 1024 * 100_000 * 128, dt)
     return out
 
 
@@ -323,7 +446,9 @@ def child_main():
 
     if cpu:
         rungs = [
-            ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 4)),
+            ("pairwise_1k", 25, lambda: _bench_pairwise(1024, 64, 4,
+                                                        sqrt=True)),
+            ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 4)),
             ("knn_100k", 70, lambda: _bench_knn(100_000, 512, 2, "xla")),
             ("spectral", 40, _bench_spectral),
         ]
@@ -337,11 +462,17 @@ def child_main():
                 return "approx"
             return None
 
-        # knn_1m (the headline, proven XLA impl) runs BEFORE pallas_check:
-        # a Mosaic compile hang in this process must not forfeit the
-        # north-star number (the parent can only kill the whole child)
+        # ladder ordered by compile cost: the README 1k x 64 config
+        # (BASELINE.md #1) is the smallest possible program — bank ONE
+        # hardware number before attempting anything hungrier.
+        # knn_1m (the headline, proven XLA impl) runs BEFORE
+        # pallas_check: a Mosaic compile hang in this process must not
+        # forfeit the north-star number (the parent can only kill the
+        # whole child).
         rungs = [
-            ("pairwise_2k", 45, lambda: _bench_pairwise(2048, 8)),
+            ("pairwise_1k", 30, lambda: _bench_pairwise(1024, 64, 8,
+                                                        sqrt=True)),
+            ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 8)),
             ("knn_100k", 80, lambda: _bench_knn(100_000, 4096, 4, "xla")),
             # gate = its own cost (60) PLUS the 1M rung's (140): the
             # comparison rung must never consume the budget that would
@@ -354,7 +485,7 @@ def child_main():
                                 select_impl=best_select())),
             ("pallas_check", 100, lambda: _bench_pallas(state)),
             ("knn_1m_pallas", 120, knn_pallas_1m),
-            ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 16)),
+            ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
             ("spectral", 60, _bench_spectral),
         ]
 
@@ -364,7 +495,7 @@ def child_main():
             _emit("skipped", skipped)
             continue
         try:
-            state[name] = fn()
+            state[name] = _tag(fn())
         except Exception:
             state.setdefault("errors", {})[name] = \
                 traceback.format_exc()[-600:]
@@ -373,7 +504,8 @@ def child_main():
         _emit(name, state[name])
     if skipped:
         state["skipped"] = skipped
-    print("FINAL " + json.dumps(assemble(state)), flush=True)
+    final = (assemble(None, state) if cpu else assemble(state, None))
+    print("FINAL " + json.dumps(final), flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -384,9 +516,15 @@ class _Child:
     def __init__(self, deadline, cpu):
         env = dict(os.environ)
         env[_DEADLINE_ENV] = repr(deadline)
+        # persistent compilation cache: in-session compiles (and prior
+        # bench runs) pre-pay the driver's compile cost where the
+        # backend supports executable serialization
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache"))
         if cpu:
             env[_CPU_ENV] = "1"
             env["JAX_PLATFORMS"] = "cpu"
+        self.t_spawn = time.time()
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -425,19 +563,38 @@ class _Child:
             pass
 
 
-def _result_of(child, note=None):
-    """Best result extractable from a child: FINAL line, else assembled
-    partials (None if it never even initialized a backend)."""
-    if child is None:
-        return None
-    if child.final is not None:
-        return child.final
-    if not child.state.get("init"):
-        return None
-    state = dict(child.state)
-    if note:
-        state["watchdog"] = note
-    return assemble(state)
+def _tpu_attempt_note(tpu, deadline):
+    """Honest status of the accelerator child (round-3 advisor: a child
+    killed mid-import must not be labeled 'init did not complete')."""
+    rc = tpu.proc.poll()
+    init_log = tpu.state.get("init_log") or []
+    note = {
+        "init_log": init_log,
+        "elapsed_at_report": round(time.time() - tpu.t_spawn, 1),
+    }
+    if tpu.state.get("init"):
+        note["status"] = (
+            "init_ok_but_no_accelerator_rung_completed"
+            if tpu.state["init"].get("is_tpu")
+            else "init_on_non_accelerator_backend")
+        # keep the child's evidence: which rungs errored/skipped and
+        # anything it did bank — 'init ok, all rungs died' must stay
+        # diagnosable from the report alone
+        for key in ("init", "errors", "skipped"):
+            if tpu.state.get(key) is not None:
+                note[key] = tpu.state[key]
+    elif rc is None:
+        where = init_log[-1]["event"] if init_log else "spawn"
+        note["status"] = ("killed_at_deadline_during_backend_init"
+                          if time.time() >= deadline else "still_running")
+        note["stuck_after"] = where
+    elif rc != 0:
+        note["status"] = "child_died_rc=%d_before_init" % rc
+    else:
+        note["status"] = "child_exited_rc=0_before_init"
+    if tpu.stderr_tail:
+        note["stderr_tail"] = tpu.stderr_tail
+    return note
 
 
 def parent_main():
@@ -445,59 +602,60 @@ def parent_main():
     budget = float(os.environ.get(_BUDGET_ENV, "420"))
     deadline = t_start + budget - _SAFETY
 
+    # BOTH children at t=0: the TPU child owns the whole budget (hung
+    # init costs nothing), the CPU child banks fallback rungs for free.
     tpu = _Child(deadline, cpu=False)
-    cpu = None
+    cpu = _Child(deadline, cpu=True)
+    tpu_graced = False
     while time.time() < deadline:
         if tpu.final is not None:
             break
         tpu_dead = tpu.proc.poll() is not None
-        if tpu_dead:
-            # grace: the reader thread may not have consumed a FINAL line
+        cpu_done = cpu.final is not None or cpu.proc.poll() is not None
+        if tpu_dead and not tpu_graced:
+            # one-time grace: the reader thread may not have consumed a
+            # FINAL line yet
+            tpu_graced = True
             t_grace = time.time() + 2.0
             while time.time() < min(t_grace, deadline) and tpu.final is None:
                 time.sleep(0.1)
             if tpu.final is not None:
                 break
-        no_backend = not tpu.state.get("init")
-        want_cpu = cpu is None and no_backend and (
-            tpu_dead or deadline - time.time() < _CPU_RETRY_COST)
-        if want_cpu and deadline - time.time() > 20:
-            # the accelerator never came up and the window to bank ANY
-            # number is closing: start the CPU child *in parallel* — a
-            # hung PJRT init burns no CPU, and if it completes late its
-            # numbers still supersede the fallback's
-            cpu = _Child(deadline, cpu=True)
-        if tpu_dead and (cpu is None or cpu.proc.poll() is not None):
-            t_grace = time.time() + 2.0
-            while (time.time() < min(t_grace, deadline)
-                   and cpu is not None and cpu.final is None):
-                time.sleep(0.1)
+        if tpu_dead and cpu_done:
             break
         time.sleep(0.5)
 
-    if time.time() >= deadline:
-        note = "deadline reached; reporting completed rungs"
+    # small drain so reader threads catch trailing PARTIAL lines
+    t_grace = time.time() + 1.0
+    while time.time() < t_grace:
+        time.sleep(0.1)
+
+    def has_rung(state):
+        return any(isinstance(v, dict)
+                   and (v.get("qps") or v.get("gpairs_per_sec"))
+                   for v in state.values())
+
+    tpu_state = dict(tpu.state)
+    tpu_state.pop("fallback", None)
+    tpu_is_accel = bool(tpu_state.get("init", {}).get("is_tpu"))
+    cpu_state = dict(cpu.state)
+    cpu_state.pop("fallback", None)
+    cpu_state.pop("init_log", None)
+    if tpu_is_accel and has_rung(tpu_state):
+        result = assemble(tpu_state, cpu_state)
     else:
-        note = "child exited before FINAL; reporting completed rungs"
-    result = _result_of(tpu, note)
-    if result is not None and result.get("value"):
-        if cpu is not None:
-            result["detail"]["cpu_fallback_superseded"] = True
-    else:
-        cpu_result = _result_of(cpu, note)
-        if cpu_result is not None:
-            cpu_result["detail"]["tpu_attempt"] = (
-                result["detail"] if result is not None
-                else "backend init did not complete within budget")
-            result = cpu_result
-    if result is None:
-        state = {"watchdog": note,
-                 "child_error": tpu.stderr_tail or "backend init never "
-                 "completed and no CPU fallback result"}
-        result = assemble(state)
+        # no hardware number: both children (at best) ran CPU ladders —
+        # report whichever banked the better kNN rung, with an honest
+        # account of what happened to the accelerator attempt
+        if not tpu_is_accel and has_rung(tpu_state):
+            a = _best_knn(tpu_state, "knn_100k")
+            b = _best_knn(cpu_state, "knn_100k")
+            if (a.get("qps", 0) if a else 0) > (b.get("qps", 0) if b else 0):
+                cpu_state = tpu_state
+        cpu_state["tpu_attempt"] = _tpu_attempt_note(tpu, deadline)
+        result = assemble(None, cpu_state)
     tpu.kill()
-    if cpu is not None:
-        cpu.kill()
+    cpu.kill()
     print(json.dumps(result), flush=True)
 
 

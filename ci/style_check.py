@@ -5,7 +5,8 @@ the equivalent checks are implemented with the stdlib).
 Checks, per Python source file:
 - parses (ast) — the flake8 E9 class;
 - no tabs in indentation, no trailing whitespace, newline at EOF;
-- line length <= 88;
+- line length <= 100 (``MAX_LEN``; wider than flake8's 88 to match the
+  reference's .clang-format 100-column limit);
 - no `from raft_tpu.… import *` (include hygiene: the reference's
   include_checker.py bans quote-style drift; the analog here is
   wildcard imports, which hide the dependency surface).

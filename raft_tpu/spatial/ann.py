@@ -72,6 +72,7 @@ class IVFPQParams:
     nprobe: int = 8
     M: int = 8           # subquantizers
     n_bits: int = 8      # log2 codebook size
+    refine_ratio: int = 1  # >1: exact re-rank of top k*ratio candidates
 
 
 @dataclass
@@ -103,6 +104,11 @@ class IVFPQIndex(NamedTuple):
     list_sizes: jnp.ndarray
     metric: DistanceType
     nprobe: int
+    # refinement (FAISS IndexRefineFlat analog): original vectors kept
+    # only when built with refine_ratio > 1, for exact re-ranking of the
+    # ADC top-(k*refine_ratio) candidates
+    vectors: Optional[jnp.ndarray] = None
+    refine_ratio: int = 1
 
 
 class IVFSQIndex(NamedTuple):
@@ -198,7 +204,7 @@ def _check_metric(name, metric):
 
 
 def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
-                       metric):
+                       metric, probes=None):
     """Shared IVF search driver: probe centroids, then scan the probed
     lists' slots one at a time with a running top-k.
 
@@ -206,6 +212,10 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
     one slot's candidate distances given per-query slot ids ``slx`` and
     the per-query probe rank ``pjx`` each slot belongs to (so per-probe
     precomputes — the PQ ADC tables — can be gathered, not rebuilt).
+    When the caller has already selected probe lists (to build such
+    precomputes), it passes the (nq, nprobe) ``probes`` array and the
+    scan derives from that SAME selection — probe ranks and per-probe
+    tables can never disagree on tie order.
     The fori_loop keeps the live set at (nq, cap, d) — never
     (nq, nprobe, max_len, d) — and HLO size O(1) in the probe count.
     Valid slots are compacted to the front of each query's scan list and
@@ -216,8 +226,9 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
     nq = q.shape[0]
     nlist, max_slots = cent_slots.shape
     nprobe = min(nprobe, nlist)
-    qc = expanded_sq_dists(q, centroids)
-    _, probes = select_k(qc, nprobe, select_min=True)        # (nq, nprobe)
+    if probes is None:
+        qc = expanded_sq_dists(q, centroids)
+        _, probes = select_k(qc, nprobe, select_min=True)    # (nq, nprobe)
     slots = cent_slots[probes].reshape(nq, -1)               # -1-padded
     prank = jnp.broadcast_to(
         jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_slots)[None],
@@ -346,9 +357,11 @@ def ivf_pq_build(X, params: IVFPQParams,
     rows_j = jnp.asarray(slot_rows)
     gather = jnp.where(rows_j >= 0, rows_j, 0)
     slot_codes = codes_flat[gather]                   # (n_slots, cap, M)
+    ratio = max(int(params.refine_ratio), 1)
     idx = IVFPQIndex(centroids, codebooks, slot_codes, rows_j,
                      jnp.asarray(slot_cent), jnp.asarray(cent_slots),
-                     jnp.asarray(counts, jnp.int32), metric, params.nprobe)
+                     jnp.asarray(counts, jnp.int32), metric, params.nprobe,
+                     vectors=X if ratio > 1 else None, refine_ratio=ratio)
     record_on_handle(handle, slot_codes)
     return idx
 
@@ -362,9 +375,10 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
     cb_norms = jnp.sum(codebooks * codebooks, -1)      # (M, ksub)
 
     # ADC lookup tables depend only on the probed list (residual =
-    # q - centroid): build them once per probe, BEFORE the slot loop —
-    # the probe selection here is recomputed by _probe_scan_search, but
-    # that (nq, nlist) pass is cheap next to rebuilding LUTs per slot
+    # q - centroid): build them once per probe, BEFORE the slot loop.
+    # The SAME probes array is handed to _probe_scan_search so the
+    # prank -> LUT pairing holds even when the selection impl has
+    # unguaranteed tie order (approx_max_k).
     np_eff = min(nprobe, nlist)
     qc = expanded_sq_dists(q, centroids)
     _, probes = select_k(qc, np_eff, select_min=True)   # (nq, np_eff)
@@ -382,18 +396,47 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
         return dist, slot_ids[slx]
 
     return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
-                              nprobe, metric)
+                              nprobe, metric, probes=probes)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "sqrt"))
+def _refine_jit(vectors, q, cand_ids, k, sqrt):
+    """Exact re-rank of ADC candidates against the original vectors
+    (the quality half of FAISS's IndexRefineFlat, which the reference
+    inherits via ann_quantized_faiss.cuh:75)."""
+    valid = cand_ids >= 0
+    vecs = vectors[jnp.where(valid, cand_ids, 0)]      # (nq, k2, d)
+    diff = vecs - q[:, None, :]
+    dist = jnp.sum(diff * diff, axis=-1)
+    dist = jnp.where(valid, dist, jnp.inf)
+    out_d, out_i = select_k(dist, k, select_min=True,
+                            values=cand_ids)
+    if sqrt:
+        out_d = jnp.sqrt(out_d)
+    return out_d, out_i
 
 
 def ivf_pq_search(index: IVFPQIndex, queries, k: int,
-                  nprobe: Optional[int] = None, handle=None):
+                  nprobe: Optional[int] = None,
+                  refine_ratio: Optional[int] = None, handle=None):
+    """ADC search; when the index holds original vectors and
+    ``refine_ratio`` (default: build-time value) is > 1, the top
+    ``k*refine_ratio`` ADC candidates are re-ranked exactly."""
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
     expects(nprobe >= 1, "ivf_pq_search: nprobe must be >= 1")
+    ratio = index.refine_ratio if refine_ratio is None else refine_ratio
+    ratio = max(int(ratio), 1)
+    refine = ratio > 1 and index.vectors is not None
+    metric = DistanceType(int(index.metric))
+    k_search = k * ratio if refine else k
     out = _ivf_pq_search_jit(index.centroids, index.codebooks,
                              index.slot_codes, index.slot_ids,
                              index.slot_centroid, index.cent_slots,
-                             q, k, nprobe, DistanceType(int(index.metric)))
+                             q, k_search, nprobe, metric)
+    if refine:
+        sqrt = metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded)
+        out = _refine_jit(index.vectors, q, out[1], k, sqrt)
     record_on_handle(handle, *out)
     return out
 
@@ -486,9 +529,10 @@ def approx_knn_build_index(X, params, metric: DistanceType = D.L2Expanded,
 
 
 def approx_knn_search(index, queries, k: int, nprobe: Optional[int] = None,
-                      handle=None):
+                      refine_ratio: Optional[int] = None, handle=None):
     if isinstance(index, IVFPQIndex):
-        return ivf_pq_search(index, queries, k, nprobe, handle=handle)
+        return ivf_pq_search(index, queries, k, nprobe,
+                             refine_ratio=refine_ratio, handle=handle)
     if isinstance(index, IVFSQIndex):
         return ivf_sq_search(index, queries, k, nprobe, handle=handle)
     if isinstance(index, IVFFlatIndex):

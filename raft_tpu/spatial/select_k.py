@@ -22,6 +22,14 @@ tie-order guarantee.  Default comes from ``RAFT_TPU_SELECT_IMPL`` (read
 at trace time; the bench measures both on hardware and reports the
 winner rather than assuming).
 
+Executable-cache caveat: because the env default is read at *trace*
+time, jitted consumers that were already compiled for a given shape
+(e.g. the module-level ANN search jits) will NOT retrace when the env
+var changes mid-process — flipping ``RAFT_TPU_SELECT_IMPL`` affects
+only not-yet-compiled shapes.  Pass ``impl=`` explicitly (it reaches
+the trace as a Python value) or set the env var before first use; the
+bench creates a fresh outer jit per rung for exactly this reason.
+
 ``select_k`` is THE building block for kNN merge and ANN list scans, so it
 accepts an optional payload (``values``) to carry indices through
 selection, mirroring the (key, value) pairs of the reference heaps.
