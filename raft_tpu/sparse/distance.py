@@ -14,18 +14,41 @@ run the dense metric kernels on the blocks.  The expanded metric families
 families reuse the dense tiled kernel.  Sparse-only binary metrics
 (Jaccard/Dice, distance_type.h:44,63) are computed from binarized inner
 products here and exported for dense parity as well.
+
+**Column scaling** (the regime the reference's load-balanced SpMV +
+cuCollections hash strategy exists for — wide, very sparse CSR,
+detail/coo_spmv.cuh:49,106, coo_spmv_strategies/hash_strategy.cuh): a
+``(block, n_cols)`` densification cannot scale in n_cols (1M columns =
+4 GB per f32 block).  ``batch_size_k`` enables the **column-tiled
+engine**: every metric is decomposed into per-row statistics computed
+directly from the CSR entry list (segment sums — O(nnz), never
+densified) plus a cross term accumulated across (row, row, col) dense
+tiles — a matmul accumulator for the expanded/dot family, an
+elementwise combine-reduce accumulator (+ or max) for the unexpanded
+family — then finalized per row-block pair.  Peak memory is
+O(bm·bk + bn·bk + bm·bn), independent of n_cols.
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.error import expects
+from raft_tpu.core.error import expects, fail
 from raft_tpu.distance.distance_type import DistanceType
-from raft_tpu.distance.pairwise import pairwise_distance as dense_pairwise
+from raft_tpu.distance.pairwise import (
+    _c_canberra,
+    _c_hamming,
+    _c_jensen_shannon,
+    _c_l1,
+    _c_l2,
+    _c_minkowski,
+    pairwise_distance as dense_pairwise,
+)
+from raft_tpu.ops.pairwise_tile import pairwise_tile
 from raft_tpu.sparse.formats import CSR
 
 D = DistanceType
@@ -35,15 +58,205 @@ def densify_rows(csr: CSR, row_start: int, block: int) -> jnp.ndarray:
     """Scatter CSR rows [row_start, row_start+block) into a dense block.
 
     One masked scatter-add over the whole entry list — no per-row kernels,
-    static shapes, jit-safe for traced ``row_start``.
+    static shapes, jit-safe for traced ``row_start``.  Full-width special
+    case of :func:`densify_block`.
     """
+    return densify_block(csr, row_start, block, 0, csr.n_cols)
+
+
+def densify_block(csr: CSR, row_start, bm: int, col_start, bk: int
+                  ) -> jnp.ndarray:
+    """Dense (bm, bk) tile of rows [row_start, +bm) x cols
+    [col_start, +bk) — the 2-D-tiled sibling of :func:`densify_rows`.
+    Padding entries (row sentinel == n_rows) are masked explicitly."""
     rows = csr.row_ids()
-    in_tile = (rows >= row_start) & (rows < row_start + block)
+    in_tile = ((rows >= row_start) & (rows < row_start + bm)
+               & (rows < csr.n_rows)
+               & (csr.indices >= col_start) & (csr.indices < col_start + bk))
     r = jnp.where(in_tile, rows - row_start, 0)
-    c = jnp.where(in_tile, csr.indices, 0)
+    c = jnp.where(in_tile, csr.indices - col_start, 0)
     v = jnp.where(in_tile, csr.data, 0)
-    out = jnp.zeros((block, csr.n_cols), dtype=csr.data.dtype)
+    out = jnp.zeros((bm, bk), dtype=csr.data.dtype)
     return out.at[r, c].add(v, mode="drop")
+
+
+def _entry_row_sum(csr: CSR, vals: jnp.ndarray) -> jnp.ndarray:
+    """(n_rows,) segment sum of per-entry ``vals`` — row statistics
+    straight from the CSR entry list, O(nnz), no densification."""
+    rows = csr.row_ids()
+    valid = rows < csr.n_rows
+    seg = jnp.where(valid, rows, 0)
+    contrib = jnp.where(valid, vals, 0).astype(jnp.float32)
+    return jax.ops.segment_sum(contrib, seg, num_segments=csr.n_rows)
+
+
+def _guarded_div(num, den):
+    return jnp.where(den == 0, 0.0, num / jnp.where(den == 0, 1.0, den))
+
+
+def _coltiled_spec(metric: DistanceType, metric_arg: float, k_total: int):
+    """Column-tiled decomposition of one metric (module docstring).
+
+    Returns ``(kind, ta, tb, stats_a, stats_b, extra, finalize)``:
+    ``kind`` is "mm" (matmul cross term) or a reduce kind ("add"/"max")
+    for the elementwise family; ``ta``/``tb`` transform dense tiles
+    before the matmul; ``stats_a``/``stats_b`` map stat name -> entry
+    function (row sums via :func:`_entry_row_sum`); ``extra`` is the
+    elementwise combine; ``finalize(acc, sa, sb)`` produces the final
+    block from the accumulated cross term and broadcast-ready row stats
+    (sa: (bm, 1) each, sb: (1, bn) each).
+    """
+    ident = lambda t: t            # noqa: E731 — tile transforms
+    binz = lambda t: (t != 0).astype(jnp.float32)  # noqa: E731
+    sq = {"sq": lambda v: v * v}
+    none = {}
+
+    if metric in (D.L2Expanded, D.L2SqrtExpanded):
+        def fin(ip, sa, sb):
+            d = jnp.maximum(sa["sq"] + sb["sq"] - 2.0 * ip, 0.0)
+            return jnp.sqrt(d) if metric == D.L2SqrtExpanded else d
+        return "mm", ident, ident, sq, sq, None, fin
+    if metric == D.InnerProduct:
+        return "mm", ident, ident, none, none, None, lambda ip, sa, sb: ip
+    if metric == D.CosineExpanded:
+        def fin(ip, sa, sb):
+            den = jnp.sqrt(sa["sq"]) * jnp.sqrt(sb["sq"])
+            return 1.0 - _guarded_div(ip, den)
+        return "mm", ident, ident, sq, sq, None, fin
+    if metric == D.CorrelationExpanded:
+        st = {"sum": lambda v: v, "sq": lambda v: v * v}
+        def fin(ip, sa, sb):
+            k = float(k_total)
+            numer = k * ip - sa["sum"] * sb["sum"]
+            qa = k * sa["sq"] - sa["sum"] * sa["sum"]
+            qb = k * sb["sq"] - sb["sum"] * sb["sum"]
+            return 1.0 - numer / jnp.sqrt(qa * qb)
+        return "mm", ident, ident, st, st, None, fin
+    if metric == D.HellingerExpanded:
+        tr = lambda t: jnp.sqrt(jnp.abs(t))  # noqa: E731
+        def fin(ip, sa, sb):
+            return jnp.sqrt(jnp.maximum(1.0 - ip, 0.0))
+        return "mm", tr, tr, none, none, None, fin
+    if metric == D.RusselRaoExpanded:
+        def fin(ip, sa, sb):
+            return (k_total - ip) / k_total
+        return "mm", ident, ident, none, none, None, fin
+    if metric == D.KLDivergence:
+        # 0.5 * (Σ x log x − x @ masked_log(y)ᵀ): the first term is a
+        # row stat over entries (0 log 0 = 0), the second a matmul with
+        # the y tile log-masked (kl_divergence.cuh:95-99)
+        st_a = {"xlogx": lambda v: jnp.where(
+            v > 0, v * jnp.log(jnp.where(v > 0, v, 1.0)), 0.0)}
+        tb = lambda t: jnp.where(  # noqa: E731
+            t > 0, jnp.log(jnp.where(t > 0, t, 1.0)), 0.0)
+        def fin(ip, sa, sb):
+            return 0.5 * (sa["xlogx"] - ip)
+        return "mm", ident, tb, st_a, none, None, fin
+    if metric in (D.JaccardExpanded, D.DiceExpanded):
+        st = {"nnz": lambda v: (v != 0).astype(jnp.float32)}
+        def fin(ip, sa, sb):
+            if metric == D.JaccardExpanded:
+                return 1.0 - _guarded_div(ip, sa["nnz"] + sb["nnz"] - ip)
+            return 1.0 - _guarded_div(2.0 * ip, sa["nnz"] + sb["nnz"])
+        return "mm", binz, binz, st, st, None, fin
+
+    # elementwise combine family: accumulate Σ_k (or max_k) over column
+    # tiles, finalize once per block pair
+    if metric == D.L1:
+        return "add", None, None, none, none, _c_l1, lambda a, sa, sb: a
+    if metric == D.L2Unexpanded:
+        return "add", None, None, none, none, _c_l2, lambda a, sa, sb: a
+    if metric == D.L2SqrtUnexpanded:
+        return ("add", None, None, none, none, _c_l2,
+                lambda a, sa, sb: jnp.sqrt(a))
+    if metric == D.Linf:
+        return "max", None, None, none, none, _c_l1, lambda a, sa, sb: a
+    if metric == D.Canberra:
+        return ("add", None, None, none, none, _c_canberra,
+                lambda a, sa, sb: a)
+    if metric == D.LpUnexpanded:
+        p = float(metric_arg)
+        return ("add", None, None, none, none, _c_minkowski(p),
+                lambda a, sa, sb: a ** (1.0 / p))
+    if metric == D.HammingUnexpanded:
+        return ("add", None, None, none, none, _c_hamming,
+                lambda a, sa, sb: a / k_total)
+    if metric == D.JensenShannon:
+        return ("add", None, None, none, none, _c_jensen_shannon,
+                lambda a, sa, sb: jnp.sqrt(jnp.maximum(0.5 * a, 0.0)))
+    if metric == D.BrayCurtis:
+        st = {"sum": lambda v: v}
+        def fin(acc, sa, sb):
+            return _guarded_div(acc, sa["sum"] + sb["sum"])
+        return "add", None, None, st, st, _c_l1, fin
+    fail("sparse pairwise_distance: metric %d has no column-tiled "
+         "decomposition", int(metric))
+
+
+def _coltiled_pairwise(a: CSR, b: CSR, metric: DistanceType,
+                       metric_arg: float, bm: int, bn: int, bk: int
+                       ) -> jnp.ndarray:
+    """Column-tiled engine (module docstring): peak temporary memory is
+    O(bm·bk + bn·bk + bm·n) however wide the input — the bm·n term is
+    the per-a-tile cross-term stripe, never larger than the (m, n)
+    output this function materializes anyway."""
+    m, n, k_total = a.n_rows, b.n_rows, a.n_cols
+    kind, ta, tb, stats_a, stats_b, combine, finalize = _coltiled_spec(
+        metric, metric_arg, k_total)
+    nta, ntb, ntk = -(-m // bm), -(-n // bn), -(-k_total // bk)
+
+    def stat_tiles(csr, spec, n_tiles, width):
+        out = {}
+        for name, fn in spec.items():
+            s = _entry_row_sum(csr, fn(csr.data))
+            out[name] = jnp.pad(s, (0, n_tiles * width - s.shape[0]))
+        return out
+
+    sa_full = stat_tiles(a, stats_a, nta, bm)
+    sb_full = stat_tiles(b, stats_b, ntb, bn)
+
+    out = jnp.zeros((nta * bm, ntb * bn), dtype=jnp.float32)
+
+    def a_step(ia, out):
+        sa = {k: jax.lax.dynamic_slice(v, (ia * bm,), (bm,))[:, None]
+              for k, v in sa_full.items()}
+
+        # stripe accumulator (bm, ntb*bn): the (ia, ic) A tile is
+        # densified ONCE and used against every b tile (the b tiles are
+        # rebuilt per ia, but an O(nnz) masked scatter is noise next to
+        # the bm x bk x bn tile contraction it feeds)
+        def k_step(ic, acc):
+            xa = densify_block(a, ia * bm, bm, ic * bk, bk)
+            txa = ta(xa) if kind == "mm" else xa
+
+            def b_step(ib, acc):
+                xb = densify_block(b, ib * bn, bn, ic * bk, bk)
+                if kind == "mm":
+                    part = jnp.matmul(txa, tb(xb).T, precision="highest")
+                else:
+                    part = pairwise_tile(xa, xb, combine, reduce_kind=kind,
+                                         epilog=None, init=0.0)
+                cur = jax.lax.dynamic_slice(acc, (0, ib * bn), (bm, bn))
+                upd = cur + part if kind != "max" else jnp.maximum(cur, part)
+                return jax.lax.dynamic_update_slice(acc, upd, (0, ib * bn))
+
+            return jax.lax.fori_loop(0, ntb, b_step, acc)
+
+        acc = jax.lax.fori_loop(
+            0, ntk, k_step, jnp.zeros((bm, ntb * bn), jnp.float32))
+
+        def fin_step(ib, out):
+            sb = {k: jax.lax.dynamic_slice(v, (ib * bn,), (bn,))[None, :]
+                  for k, v in sb_full.items()}
+            cross = jax.lax.dynamic_slice(acc, (0, ib * bn), (bm, bn))
+            blk = finalize(cross, sa, sb)
+            return jax.lax.dynamic_update_slice(
+                out, blk.astype(jnp.float32), (ia * bm, ib * bn))
+
+        return jax.lax.fori_loop(0, ntb, fin_step, out)
+
+    out = jax.lax.fori_loop(0, nta, a_step, out)
+    return out[:m, :n]
 
 
 def _binary_expanded(xa: jnp.ndarray, xb: jnp.ndarray, metric: DistanceType):
@@ -72,17 +285,24 @@ def block_pairwise(xa: jnp.ndarray, xb: jnp.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("metric", "metric_arg",
-                                             "batch_size_a", "batch_size_b"))
+                                             "batch_size_a", "batch_size_b",
+                                             "batch_size_k"))
 def pairwise_distance(a: CSR, b: CSR,
                       metric: DistanceType = D.L2Expanded,
                       metric_arg: float = 2.0,
                       batch_size_a: int = 1024,
-                      batch_size_b: int = 1024) -> jnp.ndarray:
+                      batch_size_b: int = 1024,
+                      batch_size_k: Optional[int] = None) -> jnp.ndarray:
     """All-pairs distances between CSR row sets a (m, k) and b (n, k).
 
     Runtime-switch analog of reference sparse/distance/distance.hpp:83-137;
     ``batch_size_*`` play the role of the reference's
     ``distances_config_t`` batching knobs (sparse/distance/common.h:26).
+    ``batch_size_k`` enables the column-tiled engine (module docstring)
+    for inputs too wide to densify a full row block — the regime of the
+    reference's load-balanced SpMV (detail/coo_spmv.cuh:49,106); when
+    None (default) a heuristic picks it so a densified row block stays
+    under ~256 MB.
     """
     expects(a.n_cols == b.n_cols,
             "sparse pairwise_distance: dimensionality mismatch (%d vs %d)",
@@ -90,6 +310,14 @@ def pairwise_distance(a: CSR, b: CSR,
     m, n = a.n_rows, b.n_rows
     bm = min(batch_size_a, m)
     bn = min(batch_size_b, n)
+    budget = 256 * 2**20
+    if batch_size_k is None and max(bm, bn) * a.n_cols * 4 > budget:
+        # derive the col tile from the row blocks so a densified
+        # (block, bk) tile actually fits the documented ~256 MB budget
+        batch_size_k = max(512, budget // (max(bm, bn) * 4) // 128 * 128)
+    if batch_size_k is not None and batch_size_k < a.n_cols:
+        return _coltiled_pairwise(a, b, metric, metric_arg, bm, bn,
+                                  min(batch_size_k, a.n_cols))
     n_tiles_a = -(-m // bm)
     n_tiles_b = -(-n // bn)
 
