@@ -394,6 +394,98 @@ def _bench_pallas(state):
     return out
 
 
+def make_blobs(rng, m, d, n_blobs, spread=0.15):
+    """(X, labels) Gaussian blobs — the canonical workload generator
+    shared by the linkage bench rung and tests/test_scale_stress.py
+    (single source so bench and stress test measure the same data)."""
+    import numpy as np
+
+    centers = rng.standard_normal((n_blobs, d)) * 4.0
+    labels = rng.integers(0, n_blobs, m)
+    X = (centers[labels]
+         + rng.standard_normal((m, d)) * spread).astype(np.float32)
+    return X, labels
+
+
+def two_community_graph(n_half, n_cross, rng):
+    """Symmetric deduped CSR of two ring communities + random intra
+    edges + ``n_cross`` planted bridges; shared by the spectral bench
+    rung and tests/test_scale_stress.py."""
+    import numpy as np
+
+    from raft_tpu.sparse.convert import coo_to_csr
+    from raft_tpu.sparse.formats import COO
+    from raft_tpu.sparse.op import max_duplicates
+
+    n = 2 * n_half
+    src = np.concatenate([
+        np.arange(n_half), n_half + np.arange(n_half),
+        rng.integers(0, n_half, 2 * n_half),
+        n_half + rng.integers(0, n_half, 2 * n_half),
+        rng.integers(0, n_half, n_cross)])
+    dst = np.concatenate([
+        (np.arange(n_half) + 1) % n_half,
+        n_half + (np.arange(n_half) + 1) % n_half,
+        rng.integers(0, n_half, 2 * n_half),
+        n_half + rng.integers(0, n_half, 2 * n_half),
+        n_half + rng.integers(0, n_half, n_cross)])
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    rows = np.concatenate([src, dst]).astype(np.int32)
+    cols = np.concatenate([dst, src]).astype(np.int32)
+    coo = max_duplicates(COO(rows, cols, np.ones(rows.size, np.float32),
+                             shape=(n, n)))
+    return coo_to_csr(coo, assume_sorted=True)
+
+
+def _bench_linkage_50k():
+    """m=50k single-linkage end-to-end (single_linkage.hpp:48 at bench
+    scale): kNN graph + MST + host dendrogram + cluster extraction.
+    Wall-clock includes compile (one-shot pipeline, not a steady-state
+    op); label quality asserted against the planted blobs."""
+    import numpy as np
+
+    from raft_tpu.sparse.hierarchy import single_linkage
+
+    m, d, blobs = 50_000, 2, 3
+    X, truth = make_blobs(np.random.default_rng(0), m, d, blobs)
+    t0 = time.perf_counter()
+    res = single_linkage(X, n_clusters=blobs)
+    labels = np.asarray(res.labels)
+    dt = time.perf_counter() - t0
+    # purity against the planted labels via majority vote per cluster
+    correct = sum(np.bincount(truth[labels == c]).max()
+                  for c in range(blobs) if (labels == c).any())
+    return {"seconds_incl_compile": round(dt, 2), "m": m,
+            "n_clusters": blobs, "purity": round(float(correct) / m, 4)}
+
+
+def _bench_spectral_100k():
+    """100k-vertex spectral partition (partition.hpp:65 at bench scale):
+    two ring communities + planted bridges; wall-clock incl compile and
+    the recovered-community accuracy."""
+    import numpy as np
+
+    from raft_tpu.spectral import partition
+    from raft_tpu.spectral.eigen_solvers import (EigenSolverConfig,
+                                                 LanczosSolver)
+
+    n_half = 50_000
+    n = 2 * n_half
+    csr = two_community_graph(n_half, 40, np.random.default_rng(0))
+    solver = LanczosSolver(EigenSolverConfig(n_eig_vecs=2, max_iter=6000,
+                                             restart_iter=80, tol=1e-3,
+                                             seed=42))
+    t0 = time.perf_counter()
+    res = partition(csr, eigen_solver=solver, n_clusters=2)
+    clusters = np.asarray(res.clusters)
+    dt = time.perf_counter() - t0
+    truth = np.arange(n) >= n_half
+    acc = max((clusters == truth).mean(), (clusters != truth).mean())
+    return {"seconds_incl_compile": round(dt, 2), "n_vertices": n,
+            "community_accuracy": round(float(acc), 4)}
+
+
 def _bench_spectral():
     import numpy as np
 
@@ -487,6 +579,8 @@ def child_main():
             ("knn_1m_pallas", 120, knn_pallas_1m),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
             ("spectral", 60, _bench_spectral),
+            ("linkage_50k", 130, _bench_linkage_50k),
+            ("spectral_100k", 80, _bench_spectral_100k),
         ]
 
     for name, est, fn in rungs:

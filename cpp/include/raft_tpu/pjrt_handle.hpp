@@ -1,0 +1,82 @@
+// raft_tpu::pjrt::Handle — the C++-consumable resource handle over the
+// PJRT C API.
+//
+// Reference role: raft::handle_t (cpp/include/raft/handle.hpp:49) is the
+// C++ entry point every reference primitive takes first; C++ consumers
+// (cuML, cuGraph) own one and thread it everywhere.  The TPU analog of
+// the *device runtime* behind that handle is a PJRT plugin (libtpu.so or
+// any other PJRT_Api provider), and the stable, header-only,
+// ABI-versioned way for C++ code to own it is the PJRT C API
+// (cpp/third_party/xla/pjrt/c/pjrt_c_api.h, vendored from openxla/xla,
+// Apache-2.0).
+//
+// Scope (deliberate): plugin loading, API-version negotiation, client
+// lifecycle, platform/device introspection, and error plumbing — the
+// resource-management slice of handle.hpp (streams/pools/comms live in
+// the Python/JAX layer where XLA owns scheduling; see SURVEY.md §7.1
+// amendment).  Compilation/execution through this handle is possible via
+// the same PJRT_Api table but out of scope until a C++ consumer needs it.
+//
+// Threading: the PJRT C API is thread-safe; this wrapper adds no locks.
+// Error model: every failing PJRT call surfaces as raft_tpu::pjrt::Error
+// carrying the plugin's human-readable message (the analog of
+// raft::exception / RAFT_EXPECTS in cpp/include/raft/error.hpp).
+
+#pragma once
+
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace raft_tpu {
+namespace pjrt {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+struct ApiVersion {
+  int major_version = 0;
+  int minor_version = 0;
+};
+
+struct DeviceInfo {
+  int id = 0;
+  std::string kind;         // e.g. "TPU v5 lite"
+  std::string debug_string;
+  bool addressable = false;
+};
+
+class Handle {
+ public:
+  // dlopens the plugin, resolves GetPjrtApi, runs PJRT_Plugin_Initialize,
+  // and records the API version.  Does NOT create a client (backend/device
+  // init is the expensive, environment-dependent step — keep construction
+  // cheap the way handle_t construction is).
+  explicit Handle(const std::string& plugin_path);
+  ~Handle();
+  Handle(const Handle&) = delete;
+  Handle& operator=(const Handle&) = delete;
+
+  ApiVersion api_version() const;
+  const std::string& plugin_path() const;
+
+  // Creates the PJRT client (device bring-up).  Throws Error with the
+  // plugin's message when the environment has no device.
+  void create_client();
+  bool has_client() const;
+
+  // Introspection (require a live client).
+  std::string platform_name() const;
+  std::string platform_version() const;
+  std::vector<DeviceInfo> devices() const;
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace pjrt
+}  // namespace raft_tpu

@@ -1,0 +1,276 @@
+// Implementation of raft_tpu::pjrt::Handle (see pjrt_handle.hpp) plus a
+// plain C ABI for ctypes consumers (raft_tpu/core/pjrt.py) — the same
+// binding style as host_runtime.cpp (the reference's Cython layer role,
+// python/raft/common/handle.pyx).
+
+#include "raft_tpu/pjrt_handle.hpp"
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstring>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace raft_tpu {
+namespace pjrt {
+
+namespace {
+
+// Render and free a PJRT_Error.  Returns empty string when err is null.
+std::string consume_error(const PJRT_Api* api, PJRT_Error* err) {
+  if (err == nullptr) return {};
+  PJRT_Error_Message_Args msg;
+  msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  msg.extension_start = nullptr;
+  msg.error = err;
+  api->PJRT_Error_Message(&msg);
+  std::string out(msg.message, msg.message_size);
+  PJRT_Error_Destroy_Args destroy;
+  destroy.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  destroy.extension_start = nullptr;
+  destroy.error = err;
+  api->PJRT_Error_Destroy(&destroy);
+  return out;
+}
+
+void check(const PJRT_Api* api, PJRT_Error* err, const char* what) {
+  if (err == nullptr) return;
+  throw Error(std::string(what) + ": " + consume_error(api, err));
+}
+
+}  // namespace
+
+struct Handle::Impl {
+  void* dso = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  std::string path;
+
+  ~Impl() {
+    if (api != nullptr && client != nullptr) {
+      PJRT_Client_Destroy_Args args;
+      args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      args.extension_start = nullptr;
+      args.client = client;
+      consume_error(api, api->PJRT_Client_Destroy(&args));
+    }
+    // The dso is intentionally never dlclosed: PJRT plugins register
+    // global state (XLA flags, runtime singletons) that does not survive
+    // unload; leaking the library handle at process end is the correct
+    // lifetime (same policy as jax's xla_bridge).
+  }
+};
+
+Handle::Handle(const std::string& plugin_path) : impl_(new Impl) {
+  impl_->path = plugin_path;
+  impl_->dso = dlopen(plugin_path.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (impl_->dso == nullptr) {
+    throw Error(std::string("dlopen failed: ") + dlerror());
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(impl_->dso, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    throw Error(plugin_path + " exports no GetPjrtApi symbol");
+  }
+  impl_->api = get_api();
+  if (impl_->api == nullptr) {
+    throw Error("GetPjrtApi returned null");
+  }
+  PJRT_Plugin_Initialize_Args init;
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  init.extension_start = nullptr;
+  check(impl_->api, impl_->api->PJRT_Plugin_Initialize(&init),
+        "PJRT_Plugin_Initialize");
+}
+
+Handle::~Handle() = default;
+
+ApiVersion Handle::api_version() const {
+  ApiVersion v;
+  v.major_version = impl_->api->pjrt_api_version.major_version;
+  v.minor_version = impl_->api->pjrt_api_version.minor_version;
+  return v;
+}
+
+const std::string& Handle::plugin_path() const { return impl_->path; }
+
+void Handle::create_client() {
+  if (impl_->client != nullptr) return;
+  PJRT_Client_Create_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  check(impl_->api, impl_->api->PJRT_Client_Create(&args),
+        "PJRT_Client_Create");
+  impl_->client = args.client;
+}
+
+bool Handle::has_client() const { return impl_->client != nullptr; }
+
+std::string Handle::platform_name() const {
+  if (!has_client()) throw Error("platform_name: no client");
+  PJRT_Client_PlatformName_Args args;
+  args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  args.extension_start = nullptr;
+  args.client = impl_->client;
+  check(impl_->api, impl_->api->PJRT_Client_PlatformName(&args),
+        "PJRT_Client_PlatformName");
+  return std::string(args.platform_name, args.platform_name_size);
+}
+
+std::string Handle::platform_version() const {
+  if (!has_client()) throw Error("platform_version: no client");
+  PJRT_Client_PlatformVersion_Args args;
+  args.struct_size = PJRT_Client_PlatformVersion_Args_STRUCT_SIZE;
+  args.extension_start = nullptr;
+  args.client = impl_->client;
+  check(impl_->api, impl_->api->PJRT_Client_PlatformVersion(&args),
+        "PJRT_Client_PlatformVersion");
+  return std::string(args.platform_version, args.platform_version_size);
+}
+
+std::vector<DeviceInfo> Handle::devices() const {
+  if (!has_client()) throw Error("devices: no client");
+  PJRT_Client_Devices_Args args;
+  args.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  args.extension_start = nullptr;
+  args.client = impl_->client;
+  check(impl_->api, impl_->api->PJRT_Client_Devices(&args),
+        "PJRT_Client_Devices");
+  std::vector<DeviceInfo> out;
+  out.reserve(args.num_devices);
+  for (size_t i = 0; i < args.num_devices; ++i) {
+    DeviceInfo info;
+    PJRT_Device_GetDescription_Args desc;
+    desc.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+    desc.extension_start = nullptr;
+    desc.device = args.devices[i];
+    check(impl_->api, impl_->api->PJRT_Device_GetDescription(&desc),
+          "PJRT_Device_GetDescription");
+    // global PJRT device id, NOT the enumeration index: on a multi-host
+    // slice PJRT_Client_Devices interleaves remote devices and ids are
+    // globally unique across hosts
+    PJRT_DeviceDescription_Id_Args id_args;
+    id_args.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+    id_args.extension_start = nullptr;
+    id_args.device_description = desc.device_description;
+    check(impl_->api, impl_->api->PJRT_DeviceDescription_Id(&id_args),
+          "PJRT_DeviceDescription_Id");
+    info.id = id_args.id;
+    PJRT_DeviceDescription_Kind_Args kind;
+    kind.struct_size = PJRT_DeviceDescription_Kind_Args_STRUCT_SIZE;
+    kind.extension_start = nullptr;
+    kind.device_description = desc.device_description;
+    check(impl_->api, impl_->api->PJRT_DeviceDescription_Kind(&kind),
+          "PJRT_DeviceDescription_Kind");
+    info.kind.assign(kind.device_kind, kind.device_kind_size);
+    PJRT_DeviceDescription_DebugString_Args dbg;
+    dbg.struct_size = PJRT_DeviceDescription_DebugString_Args_STRUCT_SIZE;
+    dbg.extension_start = nullptr;
+    dbg.device_description = desc.device_description;
+    check(impl_->api, impl_->api->PJRT_DeviceDescription_DebugString(&dbg),
+          "PJRT_DeviceDescription_DebugString");
+    info.debug_string.assign(dbg.debug_string, dbg.debug_string_size);
+    PJRT_Device_IsAddressable_Args addr;
+    addr.struct_size = PJRT_Device_IsAddressable_Args_STRUCT_SIZE;
+    addr.extension_start = nullptr;
+    addr.device = args.devices[i];
+    check(impl_->api, impl_->api->PJRT_Device_IsAddressable(&addr),
+          "PJRT_Device_IsAddressable");
+    info.addressable = addr.is_addressable;
+    out.push_back(std::move(info));
+  }
+  return out;
+}
+
+}  // namespace pjrt
+}  // namespace raft_tpu
+
+// ---------------------------------------------------------------------------
+// C ABI for ctypes (raft_tpu/core/pjrt.py).  Every function writes a
+// result or error message into (out, out_len) and returns 0 on success.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+int fill(char* out, size_t out_len, const std::string& s) {
+  if (out == nullptr || out_len == 0) return 1;
+  std::snprintf(out, out_len, "%s", s.c_str());
+  return 0;
+}
+
+// JSON string escaping for plugin-reported free-form strings (platform
+// name/version, device kind): without it a quote or backslash in a
+// plugin string breaks json.loads on the Python side.
+std::string jstr(const std::string& s) {
+  std::string out = "\"";
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  out += "\"";
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// API-version probe: dlopen + GetPjrtApi + Plugin_Initialize only — no
+// device bring-up, safe on machines without the accelerator.
+int raft_tpu_pjrt_probe(const char* plugin_path, char* out, size_t out_len) {
+  try {
+    raft_tpu::pjrt::Handle h(plugin_path);
+    auto v = h.api_version();
+    fill(out, out_len,
+         "{\"api_version\": [" + std::to_string(v.major_version) + ", " +
+             std::to_string(v.minor_version) + "]}");
+    return 0;
+  } catch (const std::exception& e) {
+    fill(out, out_len, e.what());
+    return 1;
+  }
+}
+
+// Full client bring-up + device enumeration.  Expensive; may fail where
+// the process has no device access (the message says why).
+int raft_tpu_pjrt_client_info(const char* plugin_path, char* out,
+                              size_t out_len) {
+  try {
+    raft_tpu::pjrt::Handle h(plugin_path);
+    h.create_client();
+    std::string json = "{\"platform\": " + jstr(h.platform_name()) +
+                       ", \"version\": " + jstr(h.platform_version()) +
+                       ", \"devices\": [";
+    bool first = true;
+    for (const auto& d : h.devices()) {
+      if (!first) json += ", ";
+      first = false;
+      json += "{\"id\": " + std::to_string(d.id) + ", \"kind\": " +
+              jstr(d.kind) + ", \"addressable\": " +
+              (d.addressable ? "true" : "false") + "}";
+    }
+    json += "]}";
+    fill(out, out_len, json);
+    return 0;
+  } catch (const std::exception& e) {
+    fill(out, out_len, e.what());
+    return 1;
+  }
+}
+
+}  // extern "C"

@@ -183,6 +183,38 @@ class Handle:
         return props
 
 
+def takes_handle(fn):
+    """Give a primitive the reference's ``handle_t&`` argument contract.
+
+    Every reference primitive takes a handle first (handle.hpp:49) and
+    enqueues its work on ``handle.get_stream()``.  On TPU the handle's
+    role at primitive granularity is completion tracking, so instead of
+    hand-writing the same plumbing into ~60 thin XLA delegations, this
+    decorator appends an optional ``handle=None`` keyword and records
+    every array output on the handle's main stream — after which
+    ``sync_stream`` / ``stream_syncer`` cover the call exactly as they
+    do for the hand-threaded primitives (pairwise/knn/spectral/...).
+    """
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, handle=None, **kwargs):
+        out = fn(*args, **kwargs)
+        if handle is not None:
+            record_on_handle(
+                handle,
+                *[x for x in jax.tree_util.tree_leaves(out)
+                  if hasattr(x, "dtype")])
+        return out
+
+    doc = wrapper.__doc__ or ""
+    wrapper.__doc__ = doc + (
+        "\n\n    ``handle``: optional resource context (reference "
+        "``handle_t&`` first arg);\n    outputs are recorded on its main "
+        "stream for ``sync_stream`` coverage.\n")
+    return wrapper
+
+
 def record_on_handle(handle: Optional[Handle], *arrays) -> None:
     """Associate dispatched work with a handle's main stream so
     ``handle.sync_stream()`` blocks on it — the TPU analog of the

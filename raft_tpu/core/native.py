@@ -31,20 +31,43 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _compile() -> Optional[str]:
-    src = os.path.join(_CPP, "src", "host_runtime.cpp")
+def lazy_build_so(so_path: str, src: str, deps: Optional[list] = None,
+                  includes: Optional[list] = None,
+                  libs: Optional[list] = None,
+                  opt: str = "-O3") -> Optional[str]:
+    """Build (if missing or stale vs ``deps``) and return the .so path.
+
+    Shared by every native extension (host runtime, PJRT handle): one
+    place owns the g++ invocation, the staleness rule, and the
+    compile-to-per-pid-temp + atomic-rename step that keeps concurrent
+    first-use processes from loading a half-written .so.  Returns None
+    when the source is absent or the toolchain fails (callers degrade to
+    their Python fallbacks).
+    """
     if not os.path.exists(src):
         return None
-    os.makedirs(_BUILD, exist_ok=True)
-    # compile to a per-pid temp path and atomically rename: concurrent
-    # first-use processes must never load a half-written .so
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-I", os.path.join(_CPP, "include"), src, "-o", tmp]
+    deps = [src] + list(deps or [])
+
+    def stale() -> bool:
+        try:
+            so_mtime = os.path.getmtime(so_path)
+            return any(so_mtime < os.path.getmtime(d) for d in deps
+                       if os.path.exists(d))
+        except OSError:
+            return True
+
+    if os.path.exists(so_path) and not stale():
+        return so_path
+    os.makedirs(os.path.dirname(so_path), exist_ok=True)
+    tmp = f"{so_path}.{os.getpid()}.tmp"
+    cmd = ["g++", opt, "-std=c++17", "-shared", "-fPIC"]
+    for inc in includes or [os.path.join(_CPP, "include")]:
+        cmd += ["-I", inc]
+    cmd += [src, "-o", tmp] + list(libs or [])
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
+        os.replace(tmp, so_path)
+        return so_path
     except Exception:
         try:
             os.unlink(tmp)
@@ -53,22 +76,13 @@ def _compile() -> Optional[str]:
         return None
 
 
-def _stale() -> bool:
-    """True when the cached .so predates the C++ source."""
-    src = os.path.join(_CPP, "src", "host_runtime.cpp")
-    try:
-        return os.path.getmtime(_SO) < os.path.getmtime(src)
-    except OSError:
-        return True
-
-
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        path = _SO if (os.path.exists(_SO) and not _stale()) else _compile()
+        path = lazy_build_so(_SO, os.path.join(_CPP, "src", "host_runtime.cpp"))
         if path is None:
             return None
         try:

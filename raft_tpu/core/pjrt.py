@@ -1,0 +1,126 @@
+"""ctypes binding to the C++ PJRT handle (cpp/src/pjrt_handle.cpp).
+
+The C++-consumable layer of SURVEY §7 step 1: ``raft_tpu::pjrt::Handle``
+plays the role reference ``raft::handle_t`` (cpp/include/raft/handle.hpp:49)
+plays for C++ consumers — it owns the device runtime (a PJRT plugin)
+behind a stable C ABI.  This module compiles/loads the library lazily
+and exposes the two probes; like :mod:`raft_tpu.core.native`, absence of
+a toolchain degrades gracefully (``pjrt_native_available() -> False``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import json
+import os
+import threading
+from typing import Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_CPP = os.path.join(_ROOT, "cpp")
+_BUILD = os.path.join(_CPP, "build")
+_SO = os.path.join(_BUILD, "libraft_tpu_pjrt.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    from raft_tpu.core.native import lazy_build_so
+
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = lazy_build_so(
+            _SO, os.path.join(_CPP, "src", "pjrt_handle.cpp"),
+            deps=[
+                os.path.join(_CPP, "include", "raft_tpu", "pjrt_handle.hpp"),
+                os.path.join(_CPP, "third_party", "xla", "pjrt", "c",
+                             "pjrt_c_api.h"),
+            ],
+            includes=[os.path.join(_CPP, "include"),
+                      os.path.join(_CPP, "third_party")],
+            libs=["-ldl"], opt="-O2")
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            for fn in ("raft_tpu_pjrt_probe", "raft_tpu_pjrt_client_info"):
+                getattr(lib, fn).restype = ctypes.c_int
+                getattr(lib, fn).argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        except (OSError, AttributeError):
+            return None
+        _lib = lib
+        return _lib
+
+
+def pjrt_native_available() -> bool:
+    return _load() is not None
+
+
+_plugin_path_cache: Optional[str] = None
+_plugin_path_searched = False
+
+
+def default_plugin_path() -> Optional[str]:
+    """Locate a PJRT plugin .so: RAFT_TPU_PJRT_PLUGIN env wins, else the
+    installed libtpu.  The filesystem fallback search is cached — the
+    recursive globs can take seconds on hosts with a large /opt, exactly
+    the machines where the fallback runs."""
+    global _plugin_path_cache, _plugin_path_searched
+    env = os.environ.get("RAFT_TPU_PJRT_PLUGIN")
+    if env:
+        return env
+    if _plugin_path_searched:
+        return _plugin_path_cache
+    try:
+        import libtpu
+
+        path = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+    except ImportError:
+        path = None
+    if path is None:
+        for pattern in ("/usr/lib/**/libtpu.so", "/opt/**/libtpu/libtpu.so"):
+            hits = glob.glob(pattern, recursive=True)
+            if hits:
+                path = hits[0]
+                break
+    _plugin_path_cache = path
+    _plugin_path_searched = True
+    return path
+
+
+def _call(fn_name: str, plugin_path: str) -> dict:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native PJRT layer unavailable (no toolchain?)")
+    buf = ctypes.create_string_buffer(1 << 16)
+    rc = getattr(lib, fn_name)(plugin_path.encode(), buf, len(buf))
+    text = buf.value.decode(errors="replace")
+    if rc != 0:
+        raise RuntimeError(text)
+    return json.loads(text)
+
+
+def probe_api_version(plugin_path: Optional[str] = None) -> dict:
+    """{"api_version": [major, minor]} of the plugin — dlopen +
+    GetPjrtApi + Plugin_Initialize only; never touches devices."""
+    path = plugin_path or default_plugin_path()
+    if path is None:
+        raise RuntimeError("no PJRT plugin found (set RAFT_TPU_PJRT_PLUGIN)")
+    return _call("raft_tpu_pjrt_probe", path)
+
+
+def client_info(plugin_path: Optional[str] = None) -> dict:
+    """Full client bring-up: {"platform", "version", "devices": [...]}.
+    Expensive, device-touching; raises with the plugin's message when the
+    process has no device access."""
+    path = plugin_path or default_plugin_path()
+    if path is None:
+        raise RuntimeError("no PJRT plugin found (set RAFT_TPU_PJRT_PLUGIN)")
+    return _call("raft_tpu_pjrt_client_info", path)

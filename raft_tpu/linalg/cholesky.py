@@ -14,6 +14,8 @@ import jax.scipy.linalg as jsl
 
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
+
 
 def _checked_sqrt(d: jnp.ndarray, eps: float | None) -> jnp.ndarray:
     """sqrt of the new diagonal element with the reference's
@@ -30,6 +32,7 @@ def _checked_sqrt(d: jnp.ndarray, eps: float | None) -> jnp.ndarray:
     return jnp.sqrt(d)
 
 
+@takes_handle
 def cholesky_rank1_update(
     l_full: jnp.ndarray, n: int, lower: bool = True, eps: float | None = None
 ) -> jnp.ndarray:

@@ -19,11 +19,14 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
+
 
 def _check_square(a: jnp.ndarray, name: str) -> None:
     expects(a.ndim == 2 and a.shape[0] == a.shape[1], "%s: matrix must be square", name)
 
 
+@takes_handle
 def eig_dc(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Full symmetric eigendecomposition (reference eig.cuh:90 ``eigDC``).
 
@@ -35,6 +38,7 @@ def eig_dc(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return v, w
 
 
+@takes_handle
 def eig_sel_dc(
     a: jnp.ndarray, n_eig_vals: int, largest: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -57,6 +61,7 @@ def eig_sel_dc(
     return v[:, :n_eig_vals], w[:n_eig_vals]
 
 
+@takes_handle
 def eig_jacobi(
     a: jnp.ndarray, tol: float = 1e-7, sweeps: int = 15
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -16,12 +16,16 @@ from typing import Callable
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def unary_op(x: jnp.ndarray, op: Callable) -> jnp.ndarray:
     """Apply ``op`` elementwise (reference unary_op.cuh:73)."""
     return op(x)
 
 
+@takes_handle
 def write_only_unary_op(shape, dtype, op: Callable) -> jnp.ndarray:
     """Generate an array from flat indices (reference unary_op.cuh:96:
     the lambda receives the output offset)."""
@@ -29,66 +33,79 @@ def write_only_unary_op(shape, dtype, op: Callable) -> jnp.ndarray:
     return op(idx).astype(dtype).reshape(shape)
 
 
+@takes_handle
 def binary_op(x: jnp.ndarray, y: jnp.ndarray, op: Callable) -> jnp.ndarray:
     """Apply a binary lambda elementwise (reference binary_op.cuh:84)."""
     return op(x, y)
 
 
+@takes_handle
 def map_op(op: Callable, *arrays: jnp.ndarray) -> jnp.ndarray:
     """Map an n-ary lambda over n same-shaped arrays (reference map.cuh:65)."""
     return op(*arrays)
 
 
+@takes_handle
 def eltwise_add(x, y):
     """(reference eltwise.cuh:37)"""
     return x + y
 
 
+@takes_handle
 def eltwise_sub(x, y):
     """(reference eltwise.cuh:63)"""
     return x - y
 
 
+@takes_handle
 def eltwise_multiply(x, y):
     """(reference eltwise.cuh:76)"""
     return x * y
 
 
+@takes_handle
 def eltwise_divide(x, y):
     """(reference eltwise.cuh:89)"""
     return x / y
 
 
+@takes_handle
 def eltwise_divide_check_zero(x, y):
     """Divide with 0 where divisor is 0 (reference eltwise.cuh:102)."""
     return jnp.where(y == 0, 0, x / jnp.where(y == 0, 1, y))
 
 
+@takes_handle
 def add(x, y):
     """(reference add.cuh:58 ``add``)"""
     return x + y
 
 
+@takes_handle
 def subtract(x, y):
     """(reference subtract.cuh:58)"""
     return x - y
 
 
+@takes_handle
 def add_scalar(x, scalar):
     """(reference add.cuh:40 ``addScalar``)"""
     return x + scalar
 
 
+@takes_handle
 def subtract_scalar(x, scalar):
     """(reference subtract.cuh:41 ``subtractScalar``)"""
     return x - scalar
 
 
+@takes_handle
 def multiply_scalar(x, scalar):
     """(reference multiply.cuh:38 ``multiplyScalar``)"""
     return x * scalar
 
 
+@takes_handle
 def divide_scalar(x, scalar):
     """(reference divide.cuh:38 ``divideScalar``)"""
     return x / scalar

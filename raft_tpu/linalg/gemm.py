@@ -14,7 +14,10 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def gemm(
     a: jnp.ndarray,
     b: jnp.ndarray,
@@ -47,6 +50,7 @@ def gemm(
     return out
 
 
+@takes_handle
 def gemv(
     a: jnp.ndarray,
     x: jnp.ndarray,

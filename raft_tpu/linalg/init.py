@@ -5,7 +5,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def range_init(start: int, end: int, dtype=jnp.int32) -> jnp.ndarray:
     """Fill with the integer range [start, end) (reference init.h:40)."""
     return jnp.arange(start, end, dtype=dtype)

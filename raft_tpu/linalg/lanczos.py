@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from raft_tpu.core.debug import check_finite
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
+
 Operator = Union[jnp.ndarray, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
@@ -169,6 +171,7 @@ def _lanczos(
     return vals[srt], vecs[:, srt], n_iter
 
 
+@takes_handle
 def compute_smallest_eigenvectors(
     a: Operator,
     n: int,
@@ -197,6 +200,7 @@ def compute_smallest_eigenvectors(
     return vals, vecs, iters
 
 
+@takes_handle
 def compute_largest_eigenvectors(
     a: Operator,
     n: int,

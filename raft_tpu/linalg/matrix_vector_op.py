@@ -16,7 +16,10 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def matrix_vector_op(
     mat: jnp.ndarray,
     vec: jnp.ndarray,

@@ -13,6 +13,8 @@ from typing import Callable, Optional
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
+
 
 class NormType(enum.IntEnum):
     """(reference norm.cuh:25)"""
@@ -42,6 +44,7 @@ def _norm(data: jnp.ndarray, axis: int, norm_type: NormType, do_sqrt: bool,
     return out
 
 
+@takes_handle
 def row_norm(
     data: jnp.ndarray,
     norm_type: NormType = NormType.L2Norm,
@@ -54,6 +57,7 @@ def row_norm(
     return _norm(data, -1, norm_type, do_sqrt, fin_op)
 
 
+@takes_handle
 def col_norm(
     data: jnp.ndarray,
     norm_type: NormType = NormType.L2Norm,
@@ -64,6 +68,7 @@ def col_norm(
     return _norm(data, 0, norm_type, do_sqrt, fin_op)
 
 
+@takes_handle
 def mean_squared_error(a: jnp.ndarray, b: jnp.ndarray, weight: float = 1.0) -> jnp.ndarray:
     """``weight * mean((a-b)^2)`` (reference mean_squared_error.cuh:36)."""
     diff = a - b

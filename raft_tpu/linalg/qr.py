@@ -7,13 +7,17 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def qr_get_q(a: jnp.ndarray) -> jnp.ndarray:
     """Orthonormal Q of the thin QR (reference qr.cuh:44 ``qrGetQ``)."""
     q, _ = jnp.linalg.qr(a, mode="reduced")
     return q
 
 
+@takes_handle
 def qr_get_qr(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Thin QR ``(q, r)`` (reference qr.cuh:88 ``qrGetQR``)."""
     return jnp.linalg.qr(a, mode="reduced")

@@ -18,6 +18,8 @@ from typing import Callable, Optional
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
+
 
 def _identity_main(x, idx):
     return x
@@ -39,6 +41,7 @@ def _apply_reduce(mapped: jnp.ndarray, axis: int, reduce_op, init):
     return out
 
 
+@takes_handle
 def coalesced_reduction(
     data: jnp.ndarray,
     main_op: Optional[Callable] = None,
@@ -61,6 +64,7 @@ def coalesced_reduction(
     return out
 
 
+@takes_handle
 def strided_reduction(
     data: jnp.ndarray,
     main_op: Optional[Callable] = None,
@@ -82,6 +86,7 @@ def strided_reduction(
     return out
 
 
+@takes_handle
 def reduce(
     data: jnp.ndarray,
     along_rows: bool = True,
@@ -104,6 +109,7 @@ def reduce(
     return fn(data, main_op=main_op, reduce_op=reduce_op, final_op=final_op, init=init)
 
 
+@takes_handle
 def map_then_reduce(
     op: Callable,
     reduce_op: Optional[Callable],
@@ -119,6 +125,7 @@ def map_then_reduce(
     return _apply_reduce(flat, 0, reduce_op, init)
 
 
+@takes_handle
 def map_then_sum_reduce(op: Callable, *arrays: jnp.ndarray) -> jnp.ndarray:
     """Map then sum-reduce (reference map_then_reduce.cuh:144)."""
     return jnp.sum(op(*arrays))

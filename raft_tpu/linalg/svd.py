@@ -16,7 +16,10 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def svd_qr(
     a: jnp.ndarray, gen_u: bool = True, gen_v: bool = True
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -29,6 +32,7 @@ def svd_qr(
     return (u if gen_u else None), s, (vt.T if gen_v else None)
 
 
+@takes_handle
 def svd_eig(a: jnp.ndarray, gen_left_vec: bool = True
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """SVD via symmetric eigendecomposition of AᵀA (reference svd.cuh:136).
@@ -51,6 +55,7 @@ def svd_eig(a: jnp.ndarray, gen_left_vec: bool = True
     return u, s, v
 
 
+@takes_handle
 def svd_jacobi(
     a: jnp.ndarray,
     gen_u: bool = True,
@@ -63,11 +68,13 @@ def svd_jacobi(
     return svd_qr(a, gen_u=gen_u, gen_v=gen_v)
 
 
+@takes_handle
 def svd_reconstruction(u: jnp.ndarray, s: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     """Rebuild ``u @ diag(s) @ v.T`` (reference svd.cuh:296)."""
     return (u * s[None, :]) @ v.T
 
 
+@takes_handle
 def evaluate_svd_by_l2_norm(
     a: jnp.ndarray, u: jnp.ndarray, s: jnp.ndarray, v: jnp.ndarray, tol: float
 ) -> bool:

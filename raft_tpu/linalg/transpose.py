@@ -5,7 +5,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def transpose(a: jnp.ndarray) -> jnp.ndarray:
     """Out-of-place transpose (reference transpose.h:36)."""
     return a.T

@@ -8,7 +8,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def power(inp: jnp.ndarray, scalar: float | None = None) -> jnp.ndarray:
     """Elementwise square, optionally scaled: ``scalar * x * x``
     (reference math.hpp:46,95 — "power" means x*x there)."""
@@ -18,6 +21,7 @@ def power(inp: jnp.ndarray, scalar: float | None = None) -> jnp.ndarray:
     return out
 
 
+@takes_handle
 def seq_root(inp: jnp.ndarray, scalar: float = 1.0, set_neg_zero: bool = False) -> jnp.ndarray:
     """Elementwise sqrt of ``scalar * x`` (reference math.hpp:113-175
     ``seqRoot``); ``set_neg_zero`` clamps negatives to 0 first like the
@@ -28,11 +32,13 @@ def seq_root(inp: jnp.ndarray, scalar: float = 1.0, set_neg_zero: bool = False) 
     return jnp.sqrt(x)
 
 
+@takes_handle
 def set_small_values_zero(inp: jnp.ndarray, thres: float = 1e-15) -> jnp.ndarray:
     """Zero out entries with |x| <= thres (reference math.hpp:182,209)."""
     return jnp.where(jnp.abs(inp) <= thres, 0.0, inp)
 
 
+@takes_handle
 def reciprocal(
     inp: jnp.ndarray,
     scalar: float = 1.0,
@@ -47,21 +53,25 @@ def reciprocal(
     return scalar / inp
 
 
+@takes_handle
 def set_value(inp: jnp.ndarray, scalar: float) -> jnp.ndarray:
     """Fill with a scalar (reference math.hpp:301 ``setValue``)."""
     return jnp.full_like(inp, scalar)
 
 
+@takes_handle
 def ratio(inp: jnp.ndarray) -> jnp.ndarray:
     """Each element divided by the sum of all (reference math.hpp:318)."""
     return inp / jnp.sum(inp)
 
 
+@takes_handle
 def argmax(inp: jnp.ndarray) -> jnp.ndarray:
     """Row index of the max per column (reference math.hpp:343)."""
     return jnp.argmax(inp, axis=0)
 
 
+@takes_handle
 def sign_flip(inp: jnp.ndarray) -> jnp.ndarray:
     """PCA sign stabilization (reference math.hpp:357 ``signFlip``): for each
     column, if the entry with the largest |value| is negative, negate the
@@ -75,11 +85,13 @@ def _bcast(vec: jnp.ndarray, along_rows: bool) -> jnp.ndarray:
     return vec[None, :] if along_rows else vec[:, None]
 
 
+@takes_handle
 def matrix_vector_binary_mult(data, vec, bcast_along_rows: bool = True):
     """(reference math.hpp:363)"""
     return data * _bcast(vec, bcast_along_rows)
 
 
+@takes_handle
 def matrix_vector_binary_mult_skip_zero(data, vec, bcast_along_rows: bool = True):
     """Multiply, leaving entries unchanged where vec == 0
     (reference math.hpp:384)."""
@@ -87,11 +99,13 @@ def matrix_vector_binary_mult_skip_zero(data, vec, bcast_along_rows: bool = True
     return jnp.where(v == 0, data, data * v)
 
 
+@takes_handle
 def matrix_vector_binary_div(data, vec, bcast_along_rows: bool = True):
     """(reference math.hpp:410)"""
     return data / _bcast(vec, bcast_along_rows)
 
 
+@takes_handle
 def matrix_vector_binary_div_skip_zero(data, vec, bcast_along_rows: bool = True,
                                        return_zero: bool = False):
     """Divide, skipping (or zeroing) where vec == 0 (reference math.hpp:431)."""
@@ -102,11 +116,13 @@ def matrix_vector_binary_div_skip_zero(data, vec, bcast_along_rows: bool = True,
     return jnp.where(v == 0, data, data / safe)
 
 
+@takes_handle
 def matrix_vector_binary_add(data, vec, bcast_along_rows: bool = True):
     """(reference math.hpp:476)"""
     return data + _bcast(vec, bcast_along_rows)
 
 
+@takes_handle
 def matrix_vector_binary_sub(data, vec, bcast_along_rows: bool = True):
     """(reference math.hpp:497)"""
     return data - _bcast(vec, bcast_along_rows)

@@ -8,12 +8,16 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def copy_rows(inp: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     """Gather rows by index (reference matrix.hpp:50 ``copyRows``)."""
     return jnp.take(inp, indices, axis=0)
 
 
+@takes_handle
 def trunc_zero_origin(inp: jnp.ndarray, n_rows: int, n_cols: int) -> jnp.ndarray:
     """Top-left submatrix copy (reference matrix.hpp:87 ``truncZeroOrigin``)."""
     expects(
@@ -24,16 +28,19 @@ def trunc_zero_origin(inp: jnp.ndarray, n_rows: int, n_cols: int) -> jnp.ndarray
     return inp[:n_rows, :n_cols]
 
 
+@takes_handle
 def col_reverse(inp: jnp.ndarray) -> jnp.ndarray:
     """Reverse column order (reference matrix.hpp:113 ``colReverse``)."""
     return inp[:, ::-1]
 
 
+@takes_handle
 def row_reverse(inp: jnp.ndarray) -> jnp.ndarray:
     """Reverse row order (reference matrix.hpp:143 ``rowReverse``)."""
     return inp[::-1, :]
 
 
+@takes_handle
 def print_host(inp, h_separator: str = ";", v_separator: str = ",") -> str:
     """Format like the reference's host printer (matrix.hpp:199
     ``printHost``); returns the string instead of writing stdout."""
@@ -44,6 +51,7 @@ def print_host(inp, h_separator: str = ";", v_separator: str = ",") -> str:
     return h_separator.join(rows)
 
 
+@takes_handle
 def slice_matrix(inp: jnp.ndarray, x1: int, y1: int, x2: int, y2: int) -> jnp.ndarray:
     """Submatrix [x1:x2, y1:y2] (reference matrix.hpp:223 ``sliceMatrix``)."""
     expects(
@@ -54,6 +62,7 @@ def slice_matrix(inp: jnp.ndarray, x1: int, y1: int, x2: int, y2: int) -> jnp.nd
     return inp[x1:x2, y1:y2]
 
 
+@takes_handle
 def copy_upper_triangular(src: jnp.ndarray) -> jnp.ndarray:
     """Copy the strictly-upper+diagonal part into the k×k output where
     k = min(rows, cols) (reference matrix.hpp:245 ``copyUpperTriangular``)."""
@@ -61,11 +70,13 @@ def copy_upper_triangular(src: jnp.ndarray) -> jnp.ndarray:
     return jnp.triu(src[:k, :k])
 
 
+@takes_handle
 def initialize_diagonal_matrix(vec: jnp.ndarray) -> jnp.ndarray:
     """Diagonal matrix from vector (reference matrix.hpp:259)."""
     return jnp.diag(vec)
 
 
+@takes_handle
 def get_diagonal_inverse_matrix(mat: jnp.ndarray) -> jnp.ndarray:
     """Invert the diagonal in place (reference matrix.hpp:272); off-diagonal
     entries are preserved, zeros on the diagonal invert to 0 like the
@@ -76,6 +87,7 @@ def get_diagonal_inverse_matrix(mat: jnp.ndarray) -> jnp.ndarray:
     return mat.at[jnp.arange(n), jnp.arange(n)].set(inv)
 
 
+@takes_handle
 def get_l2_norm(mat: jnp.ndarray) -> jnp.ndarray:
     """Frobenius norm (reference matrix.hpp:284 ``getL2Norm``)."""
     return jnp.sqrt(jnp.sum(mat * mat))

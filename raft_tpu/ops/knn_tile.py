@@ -96,7 +96,11 @@ def _bitonic_sort_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
             pv = jnp.where(upper, bwd_v, fwd_v)
             # ascending block → lower lane keeps the min
             want_min = ((lane & size) == 0) != upper
-            take = jnp.where(want_min, pk < keys, pk > keys)
+            # mask logical ops, NOT jnp.where(bool, bool, bool): a select
+            # producing an i1 vector makes Mosaic truncate i8→i1, which
+            # the real backend rejects ("Unsupported target bitwidth for
+            # truncation") even though lowering and interpret both pass
+            take = (want_min & (pk < keys)) | (~want_min & (pk > keys))
             keys = jnp.where(want_min, jnp.minimum(keys, pk),
                              jnp.maximum(keys, pk))
             vals = jnp.where(take, pv, vals)

@@ -311,11 +311,24 @@ def pairwise_distance(a: CSR, b: CSR,
     bm = min(batch_size_a, m)
     bn = min(batch_size_b, n)
     budget = 256 * 2**20
-    if batch_size_k is None and max(bm, bn) * a.n_cols * 4 > budget:
+    # the full-width driver densifies ONE a-block at a time but ALL of b
+    # up front (b_tiles below), so the footprint that must fit the budget
+    # is max(a-block, entire padded b) — gating on a single block would
+    # let a tall-and-wide b (e.g. 1M rows x 60k cols) through to a
+    # hundreds-of-GB b_tiles allocation
+    n_pad_b = -(-n // bn) * bn
+    full_width_bytes = max(bm, n_pad_b) * a.n_cols * 4
+    use_coltiled = batch_size_k is not None and batch_size_k < a.n_cols
+    if batch_size_k is None and full_width_bytes > budget:
         # derive the col tile from the row blocks so a densified
-        # (block, bk) tile actually fits the documented ~256 MB budget
+        # (block, bk) tile actually fits the documented ~256 MB budget.
+        # The engine also engages when b is tall but *narrow* (bk ==
+        # n_cols, a single col tile): its per-(bn, bk)-tile densify of b
+        # is what bounds memory, where this path's all-of-b b_tiles
+        # would not.
         batch_size_k = max(512, budget // (max(bm, bn) * 4) // 128 * 128)
-    if batch_size_k is not None and batch_size_k < a.n_cols:
+        use_coltiled = True
+    if use_coltiled:
         return _coltiled_pairwise(a, b, metric, metric_arg, bm, bn,
                                   min(batch_size_k, a.n_cols))
     n_tiles_a = -(-m // bm)

@@ -21,7 +21,10 @@ import jax.numpy as jnp
 
 from raft_tpu.sparse.formats import COO, CSR
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def coo_sort(coo: COO) -> COO:
     """Sort entries by (row, col); padding sorts last.
 
@@ -33,6 +36,7 @@ def coo_sort(coo: COO) -> COO:
                coo.shape, coo.nnz)
 
 
+@takes_handle
 def coo_sort_by_weight(coo: COO) -> COO:
     """Sort entries ascending by value (reference sparse/op/sort.hpp:67).
 
@@ -44,6 +48,7 @@ def coo_sort_by_weight(coo: COO) -> COO:
                coo.shape, coo.nnz)
 
 
+@takes_handle
 def compute_duplicates_mask(rows: jnp.ndarray, cols: jnp.ndarray,
                             n_rows: int) -> jnp.ndarray:
     """1 at the first occurrence of each (row, col) in sorted order, else 0.
@@ -57,6 +62,7 @@ def compute_duplicates_mask(rows: jnp.ndarray, cols: jnp.ndarray,
     return (first & (rows < n_rows)).astype(jnp.int32)
 
 
+@takes_handle
 def max_duplicates(coo: COO) -> COO:
     """Reduce duplicate coordinates keeping the max value.
 
@@ -88,6 +94,7 @@ def max_duplicates(coo: COO) -> COO:
     return COO(out_rows, out_cols, out_vals, s.shape, nnz=n_unique)
 
 
+@takes_handle
 def sum_duplicates(coo: COO) -> COO:
     """Reduce duplicate coordinates by summing (segment-sum variant of
     max_duplicates; the symmetrize path needs it)."""
@@ -111,6 +118,7 @@ def sum_duplicates(coo: COO) -> COO:
     return COO(out_rows, out_cols, out_vals, s.shape, nnz=n_unique)
 
 
+@takes_handle
 def coo_remove_scalar(coo: COO, scalar) -> COO:
     """Drop entries whose value equals ``scalar``.
 
@@ -125,11 +133,13 @@ def coo_remove_scalar(coo: COO, scalar) -> COO:
                nnz=jnp.sum(keep.astype(jnp.int32)))
 
 
+@takes_handle
 def coo_remove_zeros(coo: COO) -> COO:
     """Reference's coo_remove_zeros convenience wrapper."""
     return coo_remove_scalar(coo, 0)
 
 
+@takes_handle
 def csr_row_op(csr: CSR, fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
                ) -> jnp.ndarray:
     """Apply a per-entry function with its row id: fn(row_ids, data).
@@ -142,6 +152,7 @@ def csr_row_op(csr: CSR, fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
     return fn(csr.row_ids(), csr.data)
 
 
+@takes_handle
 def csr_row_slice(csr: CSR, start: int, stop: int) -> CSR:
     """Slice rows [start, stop) into a new CSR (eager; dynamic output size).
 

@@ -74,11 +74,25 @@ def fused_l2_knn(
     expects(index.ndim == 2 and queries.ndim == 2
             and index.shape[1] == queries.shape[1],
             "fused_l2_knn: shape mismatch")
+    requested = impl or os.environ.get("RAFT_TPU_FUSED_KNN_IMPL") or None
     if impl is None:
-        impl = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL") or (
-            "pallas" if is_tpu_backend() else "xla")
+        impl = requested or ("pallas" if is_tpu_backend() else "xla")
     expects(impl in ("xla", "pallas"),
             "fused_l2_knn: unknown impl %s", impl)
+    if impl == "pallas" and k > 128:
+        # the fused kernel's merge is a bitonic network over 2*kpad
+        # lanes; beyond kpad=128 the unrolled network blows up Mosaic
+        # compile time (measured: minutes at kpad=256 on v5e).  The
+        # reference draws the same line even tighter — fusedL2Knn serves
+        # only k <= 64 and larger k falls back to the general path
+        # (knn_brute_force_faiss.cuh:297-313).  Auto-selection falls
+        # back to the XLA tile-scan impl; an *explicit* pallas request
+        # (arg or env) errors rather than silently running another impl.
+        expects(requested != "pallas",
+                "fused_l2_knn: impl='pallas' supports k <= 128 (bitonic "
+                "merge width cap; got k=%d) — use impl='xla' or reduce k",
+                k)
+        impl = "xla"
     if impl == "pallas":
         from raft_tpu.ops.knn_tile import fused_knn_tile
 

@@ -9,7 +9,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from raft_tpu.core.handle import takes_handle
 
+
+@takes_handle
 def mean(data: jnp.ndarray, sample: bool = False, row_major: bool = True) -> jnp.ndarray:
     """Per-column mean (reference stats/mean.hpp:44).  ``sample`` selects the
     (n-1) divisor — kept for signature parity; for mean both divisors are n
@@ -18,12 +21,14 @@ def mean(data: jnp.ndarray, sample: bool = False, row_major: bool = True) -> jnp
     return jnp.mean(data, axis=0)
 
 
+@takes_handle
 def sum_cols(data: jnp.ndarray, row_major: bool = True) -> jnp.ndarray:
     """Per-column sum (reference stats/sum.hpp:41)."""
     del row_major
     return jnp.sum(data, axis=0)
 
 
+@takes_handle
 def vars_(
     data: jnp.ndarray,
     mu: jnp.ndarray | None = None,
@@ -39,6 +44,7 @@ def vars_(
     return ss / (n - 1 if sample else n)
 
 
+@takes_handle
 def stddev(
     data: jnp.ndarray,
     mu: jnp.ndarray | None = None,
@@ -49,11 +55,13 @@ def stddev(
     return jnp.sqrt(vars_(data, mu=mu, sample=sample, row_major=row_major))
 
 
+@takes_handle
 def mean_center(data: jnp.ndarray, mu: jnp.ndarray, bcast_along_rows: bool = True) -> jnp.ndarray:
     """Subtract the mean vector (reference stats/mean_center.hpp:41)."""
     return data - (mu[None, :] if bcast_along_rows else mu[:, None])
 
 
+@takes_handle
 def mean_add(data: jnp.ndarray, mu: jnp.ndarray, bcast_along_rows: bool = True) -> jnp.ndarray:
     """Add the mean vector back (reference stats/mean_center.hpp:77)."""
     return data + (mu[None, :] if bcast_along_rows else mu[:, None])

@@ -42,6 +42,51 @@ for name in sorted(dir(selftest)):
 assert local_handle(sess.sessionId) is sess.handle
 assert get_raft_comm_state(sess.sessionId)["nworkers"] == 2 * nprocs
 
+
+def _mnmg_knn_cross_process():
+    """Run the flagship MNMG algorithm across the real process boundary
+    (reference: the Dask-driven MNMG kNN of python/raft — here the global
+    mesh spans both OS processes, so the all_gather merge rides the
+    jax.distributed cluster) and check it against a host numpy reference.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.comms.host_comms import default_mesh
+    from raft_tpu.spatial import mnmg_knn
+
+    rng = np.random.default_rng(7)  # identical data on every process
+    n, d, nq, k = 103, 16, 8, 10
+    index = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((nq, d)).astype(np.float32)
+    mesh = default_mesh()
+    assert mesh.devices.size == 2 * nprocs, mesh
+    # replicated global placement; mnmg_knn row-shards over the axis
+    repl = NamedSharding(mesh, P(None, None))
+    ix = jax.device_put(jnp.asarray(index), repl)
+    q = jax.device_put(jnp.asarray(queries), repl)
+    d_got, i_got = mnmg_knn(ix, q, k, mesh=mesh, axis=mesh.axis_names[0])
+    d_got, i_got = np.asarray(d_got), np.asarray(i_got)
+
+    sq = ((queries[:, None, :] - index[None, :, :]) ** 2).sum(-1)
+    order = np.argsort(sq, axis=1, kind="stable")[:, :k]
+    d_ref = np.take_along_axis(sq, order, axis=1)
+    np.testing.assert_allclose(d_got, d_ref, rtol=1e-4, atol=1e-4)
+    # ids must agree except where the k-th boundary distance ties
+    mism = i_got != order
+    assert np.allclose(d_got[mism], d_ref[mism], rtol=1e-4, atol=1e-4), (
+        i_got, order)
+    return True
+
+
+try:
+    ok = _mnmg_knn_cross_process()
+except Exception as e:  # noqa: BLE001
+    ok = f"{type(e).__name__}: {e}"
+if ok is not True:
+    failures["mnmg_knn_cross_process"] = ok
+
 print(f"WORKER_RESULT {pid} failures={failures}", flush=True)
 sess.destroy()
 sys.exit(0 if not failures else 1)

@@ -1,0 +1,74 @@
+"""Handle threading across the primitive surface (handle.hpp:49 parity).
+
+The reference passes ``handle_t&`` to *every* primitive; round 3 only
+threaded knn/ann/pairwise/spectral/hierarchy.  ``takes_handle``
+(core/handle.py) extends the contract across linalg/matrix/stats/
+sparse-op: each call with ``handle=`` must record its outputs on the
+handle's main stream so ``sync_stream`` covers them.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu import Handle
+from raft_tpu.sparse.formats import COO
+
+
+def _recorded(handle, call):
+    before = len(handle.get_stream()._pending)
+    out = call(handle)
+    assert len(handle.get_stream()._pending) > before, call
+    handle.sync_stream()
+    return out
+
+
+CASES = {
+    "linalg.gemm": lambda h: __import__("raft_tpu.linalg", fromlist=["gemm"])
+    .gemm(jnp.ones((4, 3)), jnp.ones((3, 5)), handle=h),
+    "linalg.eig_dc": lambda h: __import__("raft_tpu.linalg", fromlist=["x"])
+    .eig_dc(jnp.eye(4), handle=h),
+    "linalg.row_norm": lambda h: __import__("raft_tpu.linalg", fromlist=["x"])
+    .row_norm(jnp.ones((4, 3)), handle=h),
+    "linalg.svd_qr": lambda h: __import__("raft_tpu.linalg", fromlist=["x"])
+    .svd_qr(jnp.ones((4, 3)), handle=h),
+    "linalg.transpose": lambda h: __import__("raft_tpu.linalg", fromlist=["x"])
+    .transpose(jnp.ones((4, 3)), handle=h),
+    "linalg.add": lambda h: __import__("raft_tpu.linalg", fromlist=["x"])
+    .add(jnp.ones(3), jnp.ones(3), handle=h),
+    "matrix.slice": lambda h: __import__("raft_tpu.matrix", fromlist=["x"])
+    .slice_matrix(jnp.ones((6, 6)), 1, 1, 3, 3, handle=h),
+    "matrix.math.power": lambda h: __import__("raft_tpu.matrix", fromlist=["x"])
+    .power(jnp.ones((2, 2)), handle=h),
+    "stats.mean": lambda h: __import__("raft_tpu.stats", fromlist=["x"])
+    .mean(jnp.ones((4, 3)), handle=h),
+    "sparse.coo_sort": lambda h: __import__(
+        "raft_tpu.sparse.op", fromlist=["x"]).coo_sort(
+        COO(jnp.asarray([1, 0], jnp.int32), jnp.asarray([0, 1], jnp.int32),
+            jnp.asarray([1.0, 2.0], jnp.float32), shape=(2, 2)), handle=h),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_records_on_handle(name):
+    h = Handle()
+    _recorded(h, CASES[name])
+
+
+def test_sync_stream_clears_pending():
+    from raft_tpu.linalg import gemm
+
+    h = Handle()
+    gemm(jnp.ones((4, 3)), jnp.ones((3, 5)), handle=h)
+    h.sync_stream()
+    assert not h.get_stream()._pending
+
+
+def test_decorated_result_unchanged():
+    from raft_tpu.linalg import gemm
+
+    h = Handle()
+    a = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    b = jnp.asarray(np.arange(15, dtype=np.float32).reshape(3, 5))
+    np.testing.assert_allclose(np.asarray(gemm(a, b, handle=h)),
+                               np.asarray(a) @ np.asarray(b), rtol=1e-6)

@@ -1,0 +1,62 @@
+"""C++ PJRT handle: build, load, and probe (SURVEY §7 step 1).
+
+The reference's C++-consumable surface is ``raft::handle_t``
+(handle.hpp:49); ours is ``raft_tpu::pjrt::Handle`` over the PJRT C API.
+These tests prove the C++ path end-to-end where a plugin exists: dlopen,
+GetPjrtApi, version negotiation, and error plumbing.  Client creation
+(device bring-up) is env-gated — it would contend for the real
+accelerator in CI.
+"""
+
+import os
+
+import pytest
+
+from raft_tpu.core.pjrt import (
+    default_plugin_path,
+    pjrt_native_available,
+    probe_api_version,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _require_toolchain():
+    if not pjrt_native_available():
+        pytest.skip("no C++ toolchain / PJRT library build failed")
+
+
+def test_probe_bad_path_raises_with_dlopen_message():
+    with pytest.raises(RuntimeError, match="dlopen failed"):
+        probe_api_version("/nonexistent-plugin.so")
+
+
+def test_probe_non_plugin_so_raises_no_symbol():
+    # a real .so that is not a PJRT plugin: symbol resolution must fail
+    # loudly, not crash
+    import numpy as np
+
+    core = os.path.join(os.path.dirname(np.__file__), "_core")
+    cands = [os.path.join(core, f) for f in os.listdir(core)
+             if f.endswith(".so")]
+    if not cands:
+        pytest.skip("no non-plugin .so available")
+    with pytest.raises(RuntimeError, match="GetPjrtApi"):
+        probe_api_version(cands[0])
+
+
+def test_probe_real_plugin_reports_api_version():
+    path = default_plugin_path()
+    if path is None or not os.path.exists(path):
+        pytest.skip("no PJRT plugin installed")
+    info = probe_api_version(path)
+    major, minor = info["api_version"]
+    assert major == 0 and minor >= 40, info
+
+
+def test_client_info_env_gated():
+    if os.environ.get("RAFT_TPU_PJRT_CREATE_CLIENT") != "1":
+        pytest.skip("device bring-up gated behind RAFT_TPU_PJRT_CREATE_CLIENT=1")
+    from raft_tpu.core.pjrt import client_info
+
+    info = client_info()
+    assert info["devices"], info

@@ -1,0 +1,99 @@
+"""Scale-stress for the graph pipeline (round-4 item 7).
+
+The reference stresses single_linkage / spectral at real sizes
+(cpp/test/sparse/linkage.cu end-to-end, cpp/bench/spatial/knn.cu);
+until round 3 ours were only exercised at m ~ 2k.  These run the same
+algorithms at 50k / 100k vertices on the virtual CPU mesh — minutes,
+not seconds, hence the ``slow`` marker (deselect with ``-m "not
+slow"``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _adjusted_rand_index(a: np.ndarray, b: np.ndarray) -> float:
+    """ARI between two label vectors (standard contingency formula)."""
+    a = np.asarray(a).ravel()
+    b = np.asarray(b).ravel()
+    n = a.size
+    _, ai = np.unique(a, return_inverse=True)
+    _, bi = np.unique(b, return_inverse=True)
+    c = np.zeros((ai.max() + 1, bi.max() + 1), np.int64)
+    np.add.at(c, (ai, bi), 1)
+
+    def comb2(x):
+        return x * (x - 1) / 2.0
+
+    sum_ij = comb2(c.astype(np.float64)).sum()
+    sum_a = comb2(c.sum(axis=1).astype(np.float64)).sum()
+    sum_b = comb2(c.sum(axis=0).astype(np.float64)).sum()
+    expected = sum_a * sum_b / comb2(float(n))
+    max_index = 0.5 * (sum_a + sum_b)
+    if max_index == expected:
+        return 1.0
+    return (sum_ij - expected) / (max_index - expected)
+
+
+def test_single_linkage_50k(rng):
+    """m=50k single-linkage: full-size run recovers the blob structure,
+    and agrees with scipy single linkage on a subsample (the reference's
+    linkage.cu expected-cluster methodology at bench scale)."""
+    import scipy.cluster.hierarchy as sch
+
+    from bench import make_blobs
+    from raft_tpu.sparse.hierarchy import single_linkage
+
+    m, d, n_blobs = 50_000, 2, 3
+    X, truth = make_blobs(rng, m, d, n_blobs)
+    t0 = time.perf_counter()
+    res = single_linkage(X, n_clusters=n_blobs)
+    dt = time.perf_counter() - t0
+    labels = np.asarray(res.labels)
+    assert labels.shape == (m,)
+    assert len(np.unique(labels)) == n_blobs
+    ari_truth = _adjusted_rand_index(labels, truth)
+    assert ari_truth > 0.99, ari_truth
+
+    # subsample cross-check vs scipy: cluster quality, not just shape
+    sub = rng.choice(m, 2000, replace=False)
+    Z = sch.linkage(X[sub], method="single")
+    scipy_labels = sch.fcluster(Z, t=n_blobs, criterion="maxclust")
+    ari_scipy = _adjusted_rand_index(labels[sub], scipy_labels)
+    assert ari_scipy > 0.99, ari_scipy
+    print(f"single_linkage 50k: {dt:.1f}s, ARI(truth)={ari_truth:.4f}, "
+          f"ARI(scipy@2k)={ari_scipy:.4f}")
+
+
+def test_spectral_partition_100k(rng):
+    """100k-vertex spectral partition of a two-community graph: the
+    partition must recover the communities and the edge cut must match
+    the number of planted cross edges (partition.hpp:65,133 at scale)."""
+    from bench import two_community_graph
+    from raft_tpu.spectral import analyze_partition, partition
+    from raft_tpu.spectral.eigen_solvers import EigenSolverConfig, LanczosSolver
+
+    n_half, n_cross = 50_000, 40
+    n = 2 * n_half
+    csr = two_community_graph(n_half, n_cross, rng)
+
+    t0 = time.perf_counter()
+    solver = LanczosSolver(EigenSolverConfig(n_eig_vecs=2, max_iter=6000,
+                                             restart_iter=80, tol=1e-3,
+                                             seed=42))
+    res = partition(csr, eigen_solver=solver, n_clusters=2)
+    dt = time.perf_counter() - t0
+    clusters = np.asarray(res.clusters)
+    truth = (np.arange(n) >= n_half).astype(np.int32)
+    ari = _adjusted_rand_index(clusters, truth)
+    assert ari > 0.95, ari
+    edge_cut, cost = analyze_partition(csr, 2, res.clusters)
+    # a perfect split cuts exactly the planted bridges (minus any that
+    # were deduped); imperfect splits cut community edges too
+    assert float(edge_cut) <= 3 * n_cross, float(edge_cut)
+    print(f"spectral partition 100k: {dt:.1f}s, ARI={ari:.4f}, "
+          f"edge_cut={float(edge_cut):.0f}, cost={float(cost):.4f}")

@@ -122,6 +122,55 @@ def test_coltiled_matches_fullwidth_extra_metrics(data, metric):
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+def test_auto_heuristic_engages_for_tall_b():
+    """A tall-but-narrow b must auto-engage the column-tiled engine: the
+    full-width driver densifies ALL of b up front (b_tiles), so gating
+    on a single block would let a 1M-row b through to a huge
+    allocation.  Checked via the compiled program's own peak memory."""
+    import jax
+
+    n_cols, m, n = 256, 8, 300_000
+    rng = np.random.default_rng(5)
+    a_dense = (rng.random((m, n_cols)) * (rng.random((m, n_cols)) < 0.05)
+               ).astype(np.float32)
+    # b: sparse tall matrix, ~4 nnz/row
+    nnz_row = 4
+    rows = np.repeat(np.arange(n), nnz_row)
+    cols = rng.integers(0, n_cols, n * nnz_row)
+    vals = rng.random(n * nnz_row).astype(np.float32)
+    import scipy.sparse as sp
+
+    sb = sp.coo_matrix((vals, (rows, cols)), shape=(n, n_cols))
+    sb.sum_duplicates()
+    sb = sb.tocsr()
+    sa = sp.csr_matrix(a_dense)
+
+    def f(aip, ai, ad, bip, bi, bd):
+        ca = CSR(aip, ai, ad, shape=(m, n_cols))
+        cb = CSR(bip, bi, bd, shape=(n, n_cols))
+        return pairwise_distance(ca, cb, D.L2Expanded)  # no batch_size_k
+
+    fn = jax.jit(f)
+    args = (sa.indptr.astype(np.int32), sa.indices.astype(np.int32),
+            sa.data.astype(np.float32),
+            sb.indptr.astype(np.int32), sb.indices.astype(np.int32),
+            sb.data.astype(np.float32))
+    mem = fn.lower(*args).compile().memory_analysis()
+    peak = (mem.temp_size_in_bytes + mem.argument_size_in_bytes
+            + mem.output_size_in_bytes)
+    # full-width b_tiles alone would be n * n_cols * 4 = 307 MB; the
+    # col-tiled engine keeps temps to tiles + the (m, n) output
+    assert peak < 150 * 2**20, f"peak {peak/2**20:.0f} MB"
+    got = np.asarray(fn(*args))
+    # sparse expanded-form reference (dense cdist at 300k rows would
+    # need a 1.2 GB f64 temp)
+    sqa = np.asarray(sa.multiply(sa).sum(axis=1)).ravel()
+    sqb = np.asarray(sb.multiply(sb).sum(axis=1)).ravel()
+    ref = sqa[:, None] + sqb[None, :] - 2.0 * (sa @ sb.T).toarray()
+    np.testing.assert_allclose(got, np.maximum(ref, 0.0), rtol=2e-3,
+                               atol=2e-3)
+
+
 def test_coltiled_wide_megacolumn():
     """The reference's load-balanced-SpMV regime (coo_spmv.cuh:49,106):
     n_cols = 2^20, nnz ~ 1e5.  A (block, n_cols) densification would

@@ -1,0 +1,210 @@
+"""On-chip cross-validation of the compiled Pallas kernels (round-4 item 2).
+
+Runs on a real TPU backend.  For each config, the compiled
+(interpret=False) kernel is checked against an independent reference:
+the XLA tile-scan path for fused kNN, a dense numpy evaluation for
+pairwise metrics.  Emits one JSON line per check to stdout and a summary
+at the end; any failure exits 1.
+
+Tie rule for kNN index comparison: an index mismatch at position p is
+accepted iff both kernels report (near-)equal distances there — k-th
+boundary ties may legitimately resolve to different ids
+(ops/knn_tile.py bitonic payload tie rule).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+
+import numpy as np  # noqa: E402
+
+T0 = time.time()
+RESULTS = []
+
+
+def emit(rec):
+    rec["t"] = round(time.time() - T0, 1)
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def rand(shape, seed, scale=1.0, positive=False):
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32) * scale
+    if positive:
+        x = jnp.abs(x) + 0.01
+    return x
+
+
+def check_knn(n, nq, d, k, seed=0):
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    x = rand((n, d), seed)
+    q = rand((nq, d), seed + 1)
+    t0 = time.time()
+    # k > 128: an explicit pallas request errors (bitonic width cap), so
+    # exercise the default dispatch, which on TPU resolves pallas→xla
+    d_p, i_p = fused_l2_knn(x, q, k, impl="pallas" if k <= 128 else None)
+    d_p, i_p = np.asarray(d_p), np.asarray(i_p)
+    t_pallas = time.time() - t0
+    t0 = time.time()
+    d_r, i_r = fused_l2_knn(x, q, k, impl="xla")
+    d_r, i_r = np.asarray(d_r), np.asarray(i_r)
+    t_xla = time.time() - t0
+    # distances: rtol 1e-5 on top of an absolute floor for catastrophic
+    # cancellation noise in the expanded form near zero
+    dist_ok = bool(np.allclose(d_p, d_r, rtol=1e-5, atol=1e-3))
+    mism = i_p != i_r
+    # every index mismatch must be a distance tie
+    tie_ok = bool(np.allclose(d_p[mism], d_r[mism], rtol=1e-5, atol=1e-3))
+    rec = {
+        "check": "fused_knn", "n": n, "nq": nq, "d": d, "k": k,
+        "dist_ok": dist_ok, "idx_mismatch_frac": float(mism.mean()),
+        "idx_ties_ok": tie_ok, "ok": dist_ok and tie_ok,
+        "t_pallas_incl_compile": round(t_pallas, 2),
+        "t_xla_incl_compile": round(t_xla, 2),
+    }
+    if not rec["ok"]:
+        bad = np.argwhere(mism)[:5]
+        rec["sample_mismatches"] = [
+            {"pos": p.tolist(), "d_pallas": float(d_p[tuple(p)]),
+             "d_xla": float(d_r[tuple(p)]),
+             "i_pallas": int(i_p[tuple(p)]), "i_xla": int(i_r[tuple(p)])}
+            for p in bad]
+        rec["max_abs_diff"] = float(np.max(np.abs(d_p - d_r)))
+    emit(rec)
+    return rec["ok"]
+
+
+def np_pairwise(x, y, metric, p=1.5):
+    """Dense numpy reference, blocked over rows to bound memory."""
+    out = np.empty((x.shape[0], y.shape[0]), np.float64)
+    xe = x.astype(np.float64)
+    ye = y.astype(np.float64)
+    for i0 in range(0, x.shape[0], 64):
+        xv = xe[i0:i0 + 64, None, :]
+        yv = ye[None, :, :]
+        if metric == "l1":
+            out[i0:i0 + 64] = np.abs(xv - yv).sum(-1)
+        elif metric == "linf":
+            out[i0:i0 + 64] = np.abs(xv - yv).max(-1)
+        elif metric == "l2sqrt_unexp":
+            out[i0:i0 + 64] = np.sqrt(((xv - yv) ** 2).sum(-1))
+        elif metric == "canberra":
+            den = np.abs(xv) + np.abs(yv)
+            out[i0:i0 + 64] = np.where(
+                den == 0, 0.0, np.abs(xv - yv) / np.where(den == 0, 1, den)
+            ).sum(-1)
+        elif metric == "lp":
+            out[i0:i0 + 64] = (np.abs(xv - yv) ** p).sum(-1) ** (1.0 / p)
+        elif metric == "hamming":
+            out[i0:i0 + 64] = (xv != yv).mean(-1)
+        elif metric == "js":
+            m = 0.5 * (xv + yv)
+            logm = np.log(np.where(m > 0, m, 1.0))
+
+            def term(v):
+                return np.where(
+                    v > 0, v * (np.log(np.where(v > 0, v, 1.0)) - logm), 0.0)
+            out[i0:i0 + 64] = np.sqrt(np.maximum(
+                0.5 * (term(xv) + term(yv)).sum(-1), 0.0))
+        else:
+            raise ValueError(metric)
+    return out
+
+
+_METRIC_MAP = None
+
+
+def _metric_map():
+    global _METRIC_MAP
+    if _METRIC_MAP is None:
+        from raft_tpu.distance import DistanceType as D
+        _METRIC_MAP = {
+            "l1": (D.L1, {}),
+            "linf": (D.Linf, {}),
+            "l2sqrt_unexp": (D.L2SqrtUnexpanded, {}),
+            "canberra": (D.Canberra, {}),
+            "lp": (D.LpUnexpanded, {"metric_arg": 1.5}),
+            "hamming": (D.HammingUnexpanded, {}),
+            "js": (D.JensenShannon, {}),
+        }
+    return _METRIC_MAP
+
+
+def check_pairwise(m, n, d, metric, seed=0):
+    from raft_tpu.distance import pairwise_distance
+
+    positive = metric in ("js",)
+    x = rand((m, d), seed, positive=positive)
+    y = rand((n, d), seed + 1, positive=positive)
+    if metric == "js":  # rows must be distributions
+        import jax.numpy as jnp
+        x = x / jnp.sum(x, axis=1, keepdims=True)
+        y = y / jnp.sum(y, axis=1, keepdims=True)
+    if metric == "hamming":
+        import jax.numpy as jnp
+        x = jnp.round(x)
+        y = jnp.round(y)
+    mt, kw = _metric_map()[metric]
+    t0 = time.time()
+    got = np.asarray(pairwise_distance(x, y, mt, **kw))
+    dt = time.time() - t0
+    ref = np_pairwise(np.asarray(x), np.asarray(y), metric)
+    ok = bool(np.allclose(got, ref, rtol=2e-4, atol=2e-4))
+    rec = {"check": "pairwise_tile", "metric": metric, "m": m, "n": n,
+           "d": d, "ok": ok, "t_incl_compile": round(dt, 2),
+           "max_abs_diff": float(np.max(np.abs(got - ref)))}
+    emit(rec)
+    return ok
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    emit({"check": "init", "device": str(dev.device_kind),
+          "platform": dev.platform, "ok": dev.platform == "tpu"})
+    if dev.platform != "tpu":
+        print("NOT A TPU BACKEND; aborting", file=sys.stderr)
+        return 1
+
+    ok = True
+    # fused kNN ladder: k sweep at a fixed shape (k=128 is the Pallas
+    # cap — beyond it fused_l2_knn dispatches to XLA, mirroring the
+    # reference's fusedL2Knn k<=64 gate), then ragged shapes, then the
+    # 100k timing shape.  k=256 exercises the fallback dispatch.
+    for k in (8, 64, 100, 128, 256):
+        ok &= check_knn(4096, 256, 128, k, seed=k)
+    ok &= check_knn(4097, 57, 33, 10, seed=100)     # ragged everything
+    ok &= check_knn(1000, 7, 17, 5, seed=101)       # tiny + ragged d
+    ok &= check_knn(4096, 256, 384, 64, seed=102)   # d > 128 (k-tiling)
+    ok &= check_knn(100_000, 1024, 128, 100, seed=103)
+
+    # pairwise metrics: aligned, ragged, and k > 128 (cross-k-tile
+    # accumulation) shapes
+    for metric in ("l1", "linf", "l2sqrt_unexp", "canberra", "lp",
+                   "hamming", "js"):
+        ok &= check_pairwise(256, 512, 128, metric, seed=1)
+    ok &= check_pairwise(193, 257, 77, "l1", seed=2)
+    ok &= check_pairwise(193, 257, 77, "canberra", seed=2)
+    ok &= check_pairwise(200, 300, 300, "l1", seed=3)
+    ok &= check_pairwise(200, 300, 300, "linf", seed=3)
+
+    summary = {"check": "SUMMARY", "ok": bool(ok),
+               "n_checks": len(RESULTS) - 1,
+               "n_failed": sum(1 for r in RESULTS if not r.get("ok", True))}
+    emit(summary)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Minimal TPU backend probe: timestamped init log to stdout."""
+import time, sys
+t0 = time.time()
+def log(e):
+    print(f"[{time.time()-t0:8.1f}s] {e}", flush=True)
+log("start; importing jax")
+import jax
+log("jax imported")
+import jax.numpy as jnp
+devs = jax.devices()
+log(f"devices: {[str(d) for d in devs]} platform={devs[0].platform} kind={devs[0].device_kind}")
+x = jnp.ones((128, 128), jnp.float32)
+v = float((x @ x)[0, 0])
+log(f"first matmul done: {v}")
