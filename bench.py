@@ -394,6 +394,72 @@ def _bench_pallas(state):
     return out
 
 
+def _bench_knn_bf16(n_index, n_query, iters):
+    """Informational rung: kNN with single-pass bf16 MXU matmuls
+    (precision='default') — the apples-to-apples mode against TF32-class
+    GPU tensor-core paths.  The headline stays f32-'highest'; this rung
+    reports the speed headroom AND the recall cost so the trade is
+    visible, not hidden."""
+    import numpy as np
+
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    dim, k = 128, 100
+    index = _rand((n_index, dim), 3)
+    queries = _rand((n_query, dim), 4)
+
+    def step(q):
+        d, _ = fused_l2_knn(index, q, k, impl="xla", precision="default")
+        return d
+
+    dt = _time_chained(step, queries, iters)
+    # recall@k of bf16 vs exact on a small probe slice
+    probe = queries[:256]
+    _, i_fast = fused_l2_knn(index, probe, k, impl="xla",
+                             precision="default")
+    _, i_ref = fused_l2_knn(index, probe, k, impl="xla")
+    i_fast, i_ref = np.asarray(i_fast), np.asarray(i_ref)
+    recall = float(np.mean([
+        len(set(i_fast[r]) & set(i_ref[r])) / k
+        for r in range(i_fast.shape[0])]))
+    qps = n_query / dt
+    return {
+        "qps": round(qps, 1),
+        "qps_1m_equiv": round(qps * n_index / 1_000_000, 1),
+        "seconds_per_batch": round(dt, 4),
+        "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
+        "precision": "default(bf16)",
+        "recall_at_k_vs_f32": round(recall, 4),
+        "mfu": _mfu(2.0 * n_query * n_index * dim, dt),
+        "note": "informational; headline rungs are f32-highest",
+    }
+
+
+def _bench_linalg_bundle(n, iters):
+    """BASELINE.md config #2: gemm + rowNorm + colReduce + transpose on
+    dense f32 (linalg/gemm.cuh:46, norm.cuh:48, reduce.cuh:61,
+    transpose.h:36) as one chained step; FLOPs dominated by the gemm."""
+    from raft_tpu.linalg import gemm, row_norm, strided_reduction, transpose
+
+    x = _rand((n, n), 7)
+    y = _rand((n, n), 8)
+
+    def step(a):
+        g = gemm(a, y)
+        rn = row_norm(g)
+        cs = strided_reduction(g)          # column sums (reduce.cuh:61)
+        t = transpose(g)
+        return t + rn[None, :] + cs[None, :]
+
+    dt = _time_chained(step, x, iters)
+    flops = 2.0 * n * n * n
+    return {
+        "seconds_per_call": round(dt, 5), "n": n,
+        "gemm_tflops": round(flops / dt / 1e12, 3),
+        "mfu": _mfu(flops, dt),
+    }
+
+
 def make_blobs(rng, m, d, n_blobs, spread=0.15):
     """(X, labels) Gaussian blobs — the canonical workload generator
     shared by the linkage bench rung and tests/test_scale_stress.py
@@ -541,6 +607,7 @@ def child_main():
             ("pairwise_1k", 25, lambda: _bench_pairwise(1024, 64, 4,
                                                         sqrt=True)),
             ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 4)),
+            ("linalg_bundle", 30, lambda: _bench_linalg_bundle(1024, 2)),
             ("knn_100k", 70, lambda: _bench_knn(100_000, 512, 2, "xla")),
             ("spectral", 40, _bench_spectral),
         ]
@@ -565,6 +632,7 @@ def child_main():
             ("pairwise_1k", 30, lambda: _bench_pairwise(1024, 64, 8,
                                                         sqrt=True)),
             ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 8)),
+            ("linalg_bundle", 40, lambda: _bench_linalg_bundle(4096, 8)),
             ("knn_100k", 80, lambda: _bench_knn(100_000, 4096, 4, "xla")),
             # gate = its own cost (60) PLUS the 1M rung's (140): the
             # comparison rung must never consume the budget that would
@@ -578,6 +646,8 @@ def child_main():
             ("pallas_check", 100, lambda: _bench_pallas(state)),
             ("knn_1m_pallas", 120, knn_pallas_1m),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
+            ("knn_100k_bf16", 60,
+             lambda: _bench_knn_bf16(100_000, 4096, 4)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),

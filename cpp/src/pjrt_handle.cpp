@@ -7,8 +7,11 @@
 
 #include <dlfcn.h>
 
+#include <cstddef>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
+#include <set>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
@@ -16,6 +19,31 @@ namespace raft_tpu {
 namespace pjrt {
 
 namespace {
+
+// The PJRT_Api is an append-only table gated by struct_size: a plugin
+// built against an older API allocates a SHORTER struct, so reading a
+// function pointer past its reported struct_size is undefined behavior
+// (the header: callers must check struct_size to learn which fields
+// exist).  Every table access after construction goes through this
+// guard; the error-path functions (the first three table entries,
+// present since API 0.1) are exempt so error rendering can't throw.
+template <typename Fn>
+Fn* require_fn(const PJRT_Api* api, size_t offset, Fn* PJRT_Api::*member,
+               const char* name) {
+  if (offset + sizeof(Fn*) > api->struct_size) {
+    throw Error(std::string("plugin PJRT_Api (struct_size=") +
+                std::to_string(api->struct_size) +
+                ") predates required function " + name);
+  }
+  Fn* fn = api->*member;
+  if (fn == nullptr) {
+    throw Error(std::string("plugin PJRT_Api exports null ") + name);
+  }
+  return fn;
+}
+
+#define RT_PJRT_FN(api, Name) \
+  require_fn((api), offsetof(PJRT_Api, Name), &PJRT_Api::Name, #Name)
 
 // Render and free a PJRT_Error.  Returns empty string when err is null.
 std::string consume_error(const PJRT_Api* api, PJRT_Error* err) {
@@ -53,7 +81,7 @@ struct Handle::Impl {
       args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
       args.extension_start = nullptr;
       args.client = client;
-      consume_error(api, api->PJRT_Client_Destroy(&args));
+      consume_error(api, RT_PJRT_FN(api, PJRT_Client_Destroy)(&args));
     }
     // The dso is intentionally never dlclosed: PJRT plugins register
     // global state (XLA flags, runtime singletons) that does not survive
@@ -78,11 +106,22 @@ Handle::Handle(const std::string& plugin_path) : impl_(new Impl) {
   if (impl_->api == nullptr) {
     throw Error("GetPjrtApi returned null");
   }
-  PJRT_Plugin_Initialize_Args init;
-  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
-  init.extension_start = nullptr;
-  check(impl_->api, impl_->api->PJRT_Plugin_Initialize(&init),
-        "PJRT_Plugin_Initialize");
+  // "One-time plugin setup" (pjrt_c_api.h): a second Handle over the
+  // same plugin (dlopen refcounts to the same PJRT_Api) must not
+  // re-initialize global state.  Keyed by the api pointer, which is
+  // stable per loaded plugin.
+  static std::mutex init_mu;
+  static std::set<const PJRT_Api*>* initialized =
+      new std::set<const PJRT_Api*>();
+  std::lock_guard<std::mutex> lock(init_mu);
+  if (initialized->count(impl_->api) == 0) {
+    PJRT_Plugin_Initialize_Args init;
+    init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    init.extension_start = nullptr;
+    check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Plugin_Initialize)(&init),
+          "PJRT_Plugin_Initialize");
+    initialized->insert(impl_->api);  // only a SUCCESSFUL init is final
+  }
 }
 
 Handle::~Handle() = default;
@@ -101,7 +140,7 @@ void Handle::create_client() {
   PJRT_Client_Create_Args args;
   std::memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
-  check(impl_->api, impl_->api->PJRT_Client_Create(&args),
+  check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Client_Create)(&args),
         "PJRT_Client_Create");
   impl_->client = args.client;
 }
@@ -114,7 +153,7 @@ std::string Handle::platform_name() const {
   args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
   args.extension_start = nullptr;
   args.client = impl_->client;
-  check(impl_->api, impl_->api->PJRT_Client_PlatformName(&args),
+  check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Client_PlatformName)(&args),
         "PJRT_Client_PlatformName");
   return std::string(args.platform_name, args.platform_name_size);
 }
@@ -125,7 +164,7 @@ std::string Handle::platform_version() const {
   args.struct_size = PJRT_Client_PlatformVersion_Args_STRUCT_SIZE;
   args.extension_start = nullptr;
   args.client = impl_->client;
-  check(impl_->api, impl_->api->PJRT_Client_PlatformVersion(&args),
+  check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Client_PlatformVersion)(&args),
         "PJRT_Client_PlatformVersion");
   return std::string(args.platform_version, args.platform_version_size);
 }
@@ -136,7 +175,7 @@ std::vector<DeviceInfo> Handle::devices() const {
   args.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
   args.extension_start = nullptr;
   args.client = impl_->client;
-  check(impl_->api, impl_->api->PJRT_Client_Devices(&args),
+  check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Client_Devices)(&args),
         "PJRT_Client_Devices");
   std::vector<DeviceInfo> out;
   out.reserve(args.num_devices);
@@ -146,7 +185,7 @@ std::vector<DeviceInfo> Handle::devices() const {
     desc.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
     desc.extension_start = nullptr;
     desc.device = args.devices[i];
-    check(impl_->api, impl_->api->PJRT_Device_GetDescription(&desc),
+    check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Device_GetDescription)(&desc),
           "PJRT_Device_GetDescription");
     // global PJRT device id, NOT the enumeration index: on a multi-host
     // slice PJRT_Client_Devices interleaves remote devices and ids are
@@ -155,28 +194,28 @@ std::vector<DeviceInfo> Handle::devices() const {
     id_args.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
     id_args.extension_start = nullptr;
     id_args.device_description = desc.device_description;
-    check(impl_->api, impl_->api->PJRT_DeviceDescription_Id(&id_args),
+    check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_DeviceDescription_Id)(&id_args),
           "PJRT_DeviceDescription_Id");
     info.id = id_args.id;
     PJRT_DeviceDescription_Kind_Args kind;
     kind.struct_size = PJRT_DeviceDescription_Kind_Args_STRUCT_SIZE;
     kind.extension_start = nullptr;
     kind.device_description = desc.device_description;
-    check(impl_->api, impl_->api->PJRT_DeviceDescription_Kind(&kind),
+    check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_DeviceDescription_Kind)(&kind),
           "PJRT_DeviceDescription_Kind");
     info.kind.assign(kind.device_kind, kind.device_kind_size);
     PJRT_DeviceDescription_DebugString_Args dbg;
     dbg.struct_size = PJRT_DeviceDescription_DebugString_Args_STRUCT_SIZE;
     dbg.extension_start = nullptr;
     dbg.device_description = desc.device_description;
-    check(impl_->api, impl_->api->PJRT_DeviceDescription_DebugString(&dbg),
+    check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_DeviceDescription_DebugString)(&dbg),
           "PJRT_DeviceDescription_DebugString");
     info.debug_string.assign(dbg.debug_string, dbg.debug_string_size);
     PJRT_Device_IsAddressable_Args addr;
     addr.struct_size = PJRT_Device_IsAddressable_Args_STRUCT_SIZE;
     addr.extension_start = nullptr;
     addr.device = args.devices[i];
-    check(impl_->api, impl_->api->PJRT_Device_IsAddressable(&addr),
+    check(impl_->api, RT_PJRT_FN(impl_->api, PJRT_Device_IsAddressable)(&addr),
           "PJRT_Device_IsAddressable");
     info.addressable = addr.is_addressable;
     out.push_back(std::move(info));
@@ -194,9 +233,17 @@ std::vector<DeviceInfo> Handle::devices() const {
 
 namespace {
 
+// 0 = written whole; 2 = truncated (caller's buffer too small) — a
+// truncated JSON payload must NOT be reported as success, or the Python
+// side json.loads()es garbage.
 int fill(char* out, size_t out_len, const std::string& s) {
   if (out == nullptr || out_len == 0) return 1;
   std::snprintf(out, out_len, "%s", s.c_str());
+  if (s.size() + 1 > out_len) {
+    std::snprintf(out, out_len, "result truncated: needs %zu bytes",
+                  s.size() + 1);
+    return 2;
+  }
   return 0;
 }
 
@@ -236,10 +283,9 @@ int raft_tpu_pjrt_probe(const char* plugin_path, char* out, size_t out_len) {
   try {
     raft_tpu::pjrt::Handle h(plugin_path);
     auto v = h.api_version();
-    fill(out, out_len,
-         "{\"api_version\": [" + std::to_string(v.major_version) + ", " +
-             std::to_string(v.minor_version) + "]}");
-    return 0;
+    return fill(out, out_len,
+                "{\"api_version\": [" + std::to_string(v.major_version) +
+                    ", " + std::to_string(v.minor_version) + "]}");
   } catch (const std::exception& e) {
     fill(out, out_len, e.what());
     return 1;
@@ -265,8 +311,7 @@ int raft_tpu_pjrt_client_info(const char* plugin_path, char* out,
               (d.addressable ? "true" : "false") + "}";
     }
     json += "]}";
-    fill(out, out_len, json);
-    return 0;
+    return fill(out, out_len, json);
   } catch (const std::exception& e) {
     fill(out, out_len, e.what());
     return 1;

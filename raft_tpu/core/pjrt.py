@@ -99,7 +99,7 @@ def _call(fn_name: str, plugin_path: str) -> dict:
     lib = _load()
     if lib is None:
         raise RuntimeError("native PJRT layer unavailable (no toolchain?)")
-    buf = ctypes.create_string_buffer(1 << 16)
+    buf = ctypes.create_string_buffer(1 << 20)
     rc = getattr(lib, fn_name)(plugin_path.encode(), buf, len(buf))
     text = buf.value.decode(errors="replace")
     if rc != 0:
