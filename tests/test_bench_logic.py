@@ -1,0 +1,128 @@
+"""Pure-logic tests for bench.py's reporting machinery.
+
+The bench is the round's perf evidence; its headline assembly,
+device-peak detection, and honest-status notes must not regress.  These
+test the JAX-free functions only (the parent process never imports JAX
+by design, so neither do these tests).
+"""
+
+import bench
+
+
+class TestChipPeakFlops:
+    def test_v5e_from_device_kind(self):
+        peak, gen = bench.chip_peak_flops("TPU v5 lite", "tpu")
+        assert gen == "v5e" and peak == bench.TPU_PEAK_BF16["v5e"]
+
+    def test_v5p(self):
+        peak, gen = bench.chip_peak_flops("TPU v5p", "tpu")
+        assert gen == "v5p"
+
+    def test_v6_trillium_maps_to_v6e_not_v5e(self):
+        _, gen = bench.chip_peak_flops("TPU v6 lite", "tpu")
+        assert gen == "v6e"
+
+    def test_cpu_unrecognized(self):
+        peak, gen = bench.chip_peak_flops("", "cpu")
+        assert peak is None and gen is None
+
+    def test_env_hint_only_for_non_cpu(self, monkeypatch):
+        monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+        peak, gen = bench.chip_peak_flops("mystery-accel", "tpu")
+        assert gen == "v5e(env)"
+        peak, gen = bench.chip_peak_flops("", "cpu")
+        assert peak is None
+
+
+class TestAssemble:
+    def test_accelerator_knn_beats_everything(self):
+        tpu = {"knn_1m": {"qps": 5000.0, "n_index": 1_000_000},
+               "pairwise_2k": {"gpairs_per_sec": 10.0,
+                               "shape": [2048, 2048, 128]}}
+        cpu = {"knn_100k": {"qps": 900.0, "n_index": 100_000}}
+        out = bench.assemble(tpu, cpu)
+        assert out["metric"] == "knn_qps_1M_128d_k100"
+        assert out["value"] == 5000.0
+        assert out["vs_baseline"] == round(5000.0 / 20000.0, 4)
+        assert out["detail"]["cpu_fallback"] == cpu
+
+    def test_pallas_rung_supersedes_when_faster(self):
+        tpu = {"knn_1m": {"qps": 5000.0, "n_index": 1_000_000},
+               "knn_1m_pallas": {"qps": 7000.0, "n_index": 1_000_000}}
+        out = bench.assemble(tpu, {})
+        assert out["value"] == 7000.0
+
+    def test_100k_rung_scales_vs_baseline_by_index_size(self):
+        tpu = {"knn_100k": {"qps": 10_000.0, "n_index": 100_000}}
+        out = bench.assemble(tpu, {})
+        assert out["metric"] == "knn_qps_100k_128d_k100"
+        # 10k QPS at 100k index = 1k QPS-equivalent at 1M
+        assert out["vs_baseline"] == round(10_000.0 * 0.1 / 20000.0, 4)
+
+    def test_pairwise_fallback_normalizes_dim(self):
+        tpu = {"pairwise_1k": {"gpairs_per_sec": 100.0,
+                               "shape": [1024, 1024, 64]}}
+        out = bench.assemble(tpu, {})
+        assert out["unit"] == "Gpairs/s"
+        # d=64 halves the FLOP-equivalent rate vs the d=128 constant
+        assert out["vs_baseline"] == round(100.0 * 0.5 / 50.0, 4)
+
+    def test_cpu_fallback_when_no_accelerator_rung(self):
+        cpu = {"knn_100k": {"qps": 999.0, "n_index": 100_000}}
+        out = bench.assemble(None, cpu)
+        assert out["metric"].endswith("_cpu_fallback")
+
+    def test_zero_when_nothing_banked(self):
+        out = bench.assemble({}, {})
+        assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+
+class _FakeChild:
+    def __init__(self, rc=None, state=None, stderr_tail="", t_spawn=None):
+        import time
+
+        self.proc = _FakeProc(rc)
+        self.state = state or {}
+        self.stderr_tail = stderr_tail
+        self.t_spawn = t_spawn or time.time()
+
+
+class TestTpuAttemptNote:
+    def test_child_died_before_init(self):
+        note = bench._tpu_attempt_note(_FakeChild(rc=1), deadline=0)
+        assert note["status"] == "child_died_rc=1_before_init"
+
+    def test_killed_at_deadline_during_init(self):
+        import time
+
+        child = _FakeChild(rc=None, state={
+            "init_log": [{"t": 1.0, "event": "backend_init_start"}]})
+        note = bench._tpu_attempt_note(child, deadline=time.time() - 5)
+        assert note["status"] == "killed_at_deadline_during_backend_init"
+        assert note["stuck_after"] == "backend_init_start"
+
+    def test_init_ok_but_no_rung(self):
+        child = _FakeChild(rc=None, state={
+            "init": {"is_tpu": True},
+            "errors": {"knn_100k": "Traceback..."}})
+        note = bench._tpu_attempt_note(child, deadline=0)
+        assert note["status"] == "init_ok_but_no_accelerator_rung_completed"
+        assert "errors" in note
+
+    def test_non_accelerator_backend(self):
+        child = _FakeChild(rc=None, state={"init": {"is_tpu": False}})
+        note = bench._tpu_attempt_note(child, deadline=0)
+        assert note["status"] == "init_on_non_accelerator_backend"
+
+    def test_stderr_tail_preserved(self):
+        note = bench._tpu_attempt_note(
+            _FakeChild(rc=2, stderr_tail="boom"), deadline=0)
+        assert note["stderr_tail"] == "boom"

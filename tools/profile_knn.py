@@ -1,0 +1,66 @@
+"""Profile the hot kNN/pairwise paths on the current backend.
+
+Captures an XLA profiler trace (view with tensorboard or xprof) and
+prints per-op wall times for the north-star shapes, so kernel tuning is
+driven by measurements instead of guesses.  Usage:
+
+    python tools/profile_knn.py [outdir] [--small]
+
+The trace directory defaults to /tmp/raft_tpu_trace.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 and not sys.argv[1].startswith(
+        "--") else "/tmp/raft_tpu_trace"
+    small = "--small" in sys.argv
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+
+    n, nq, d, k = (100_000, 1024, 128, 100) if small else \
+        (1_000_000, 10_000, 128, 100)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (n, d), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+
+    impls = ["xla"]
+    if dev.platform == "tpu":
+        impls.append("pallas")
+
+    # warm both compiles outside the trace
+    for impl in impls:
+        t0 = time.time()
+        jax.block_until_ready(fused_l2_knn(x, q, k, impl=impl))
+        print(f"{impl}: compile+first run {time.time() - t0:.1f}s",
+              flush=True)
+
+    with jax.profiler.trace(outdir):
+        for impl in impls:
+            for _ in range(3):
+                t0 = time.time()
+                jax.block_until_ready(fused_l2_knn(x, q, k, impl=impl))
+                dt = time.time() - t0
+                qps = nq / dt
+                mfu_flops = 2.0 * nq * n * d / dt
+                print(f"{impl}: {dt:.4f}s  {qps:,.0f} QPS  "
+                      f"{mfu_flops / 1e12:.2f} TFLOP/s", flush=True)
+    print(f"trace written to {outdir}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
