@@ -24,6 +24,7 @@ harmless, and padded rows/cols are sliced away by the wrapper.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Optional
 
 import jax
@@ -96,7 +97,12 @@ def pairwise_tile(
         bk = ceildiv(k, 8) * 8
     else:
         bk = max(128, block_k // 128 * 128)
-    vmem_budget = 4 << 20
+    # budget for the (bm, bk, bn) broadcast intermediate.  4 MB default
+    # is deliberately conservative (v5e has 128 MB VMEM but Mosaic needs
+    # headroom for double-buffered input windows); env-tunable so
+    # on-chip sweeps can find the knee without code edits.
+    vmem_budget = int(os.environ.get("RAFT_TPU_PAIRWISE_VMEM_BUDGET",
+                                     4 << 20))
     bm_cap = max(8, (vmem_budget // (bk * bn * 4)) // 8 * 8)
     bm = min(block_m, m, bm_cap) if m < 8 else min(max(8, min(block_m, m) // 8 * 8), bm_cap)
     # pad to tile multiples (zero padding is contribution-free, see module doc)

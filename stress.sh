@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Scale-stress suite (50k single-linkage, 100k spectral partition) —
+# minutes, not seconds, so opt-in and separate from run_tests.sh.
+set -euo pipefail
+cd "$(dirname "$0")"
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+export RAFT_TPU_TEST_PLATFORM="${RAFT_TPU_TEST_PLATFORM:-cpu}"
+exec python -m pytest tests/ -q -m slow "$@"

@@ -1,9 +1,10 @@
 """Pure-logic tests for bench.py's reporting machinery.
 
 The bench is the round's perf evidence; its headline assembly,
-device-peak detection, and honest-status notes must not regress.  These
-test the JAX-free functions only (the parent process never imports JAX
-by design, so neither do these tests).
+device-peak detection, and honest-status notes must not regress.  Only
+the JAX-free functions are under test here — the ones bench.py's parent
+process (which never imports JAX by design) relies on.  The test
+*session* still has JAX loaded via conftest.py.
 """
 
 import bench

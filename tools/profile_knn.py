@@ -20,8 +20,8 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 
 
 def main():
-    outdir = sys.argv[1] if len(sys.argv) > 1 and not sys.argv[1].startswith(
-        "--") else "/tmp/raft_tpu_trace"
+    positional = [a for a in sys.argv[1:] if not a.startswith("--")]
+    outdir = positional[0] if positional else "/tmp/raft_tpu_trace"
     small = "--small" in sys.argv
 
     import jax
