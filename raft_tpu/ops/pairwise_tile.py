@@ -100,9 +100,16 @@ def pairwise_tile(
     # budget for the (bm, bk, bn) broadcast intermediate.  4 MB default
     # is deliberately conservative (v5e has 128 MB VMEM but Mosaic needs
     # headroom for double-buffered input windows); env-tunable so
-    # on-chip sweeps can find the knee without code edits.
-    vmem_budget = int(os.environ.get("RAFT_TPU_PAIRWISE_VMEM_BUDGET",
-                                     4 << 20))
+    # on-chip sweeps can find the knee without code edits.  bm is ALSO
+    # capped by block_m (default 128), so a sweep above ~8 MB must raise
+    # block_m together with the budget (pairwise_distance forwards it).
+    budget_env = os.environ.get("RAFT_TPU_PAIRWISE_VMEM_BUDGET")
+    try:
+        vmem_budget = int(budget_env) if budget_env else 4 << 20
+    except ValueError:
+        raise ValueError(
+            "RAFT_TPU_PAIRWISE_VMEM_BUDGET must be an integer byte count, "
+            f"got {budget_env!r}") from None
     bm_cap = max(8, (vmem_budget // (bk * bn * 4)) // 8 * 8)
     bm = min(block_m, m, bm_cap) if m < 8 else min(max(8, min(block_m, m) // 8 * 8), bm_cap)
     # pad to tile multiples (zero padding is contribution-free, see module doc)

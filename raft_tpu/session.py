@@ -108,6 +108,33 @@ class Comms:
             self._owns_distributed = False
         self.initialized = False
 
+    def worker_info(self, workers=None) -> Dict:
+        """Rank/device map per "worker" (reference Comms.worker_info,
+        comms.py:154, which maps each Dask worker to its NCCL rank and
+        UCX port).  Here a worker is a mesh device: the map is keyed by
+        device id and carries the *communicator* rank — the device's
+        coordinate along the comms axis, i.e. the rank space
+        ``HostComms.get_rank()`` reports — plus its position on any
+        other mesh axes, process index, and platform.  ``workers``
+        optionally restricts to those device ids."""
+        import numpy as np
+
+        expects(self.initialized, "worker_info: session not initialized")
+        mesh = self.comms.mesh
+        axis_idx = mesh.axis_names.index(self.comms.axis)
+        info = {}
+        for coords in np.ndindex(*mesh.devices.shape):
+            d = mesh.devices[coords]
+            if workers is not None and d.id not in workers:
+                continue
+            info[d.id] = {"rank": int(coords[axis_idx]),
+                          "mesh_coords": dict(zip(mesh.axis_names,
+                                                  map(int, coords))),
+                          "process_index": d.process_index,
+                          "platform": d.platform,
+                          "device_kind": d.device_kind}
+        return info
+
     def __enter__(self) -> "Comms":
         return self.init()
 

@@ -42,6 +42,33 @@ class TestSession:
         with pytest.raises(Exception):
             local_handle("nope")
 
+    def test_worker_info(self):
+        """Reference Comms.worker_info (comms.py:154): rank/placement
+        map per worker; here per mesh device."""
+        with Comms() as c:
+            info = c.worker_info()
+            assert len(info) == 8
+            assert sorted(v["rank"] for v in info.values()) == list(range(8))
+            some_id = next(iter(info))
+            only = c.worker_info(workers=[some_id])
+            assert list(only) == [some_id]
+            assert all("process_index" in v and "device_kind" in v
+                       for v in info.values())
+
+    def test_worker_info_2d_mesh_ranks_in_comms_space(self):
+        """On a 2-D mesh the rank must be the device's coordinate along
+        the COMMS axis (HostComms rank space), not flat enumeration."""
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        with Comms(mesh=Mesh(devs, ("ranks", "aux"))) as c:
+            info = c.worker_info()
+            ranks = sorted(v["rank"] for v in info.values())
+            assert ranks == [0] * 4 + [1] * 4          # comm size 2
+            assert all(v["mesh_coords"]["ranks"] == v["rank"]
+                       for v in info.values())
+
 
 class TestSpecializations:
     def test_cache_dir(self, tmp_path):
