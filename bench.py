@@ -363,9 +363,11 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None):
 
 
 def _bench_pallas(state):
-    """Compiled (interpret=False) Pallas fused kNN: correctness vs the XLA
-    impl, then a timed comparison at 100k.  Loud status either way —
-    this is the kernel that must not ship unmeasured silently."""
+    """Compiled (interpret=False) Pallas kernels: correctness of BOTH
+    the fused kNN kernel (vs the XLA impl) and the pairwise tile kernel
+    (vs host numpy), then a timed kNN comparison at 100k.  Loud status
+    either way — these are the kernels that must not ship unmeasured
+    silently."""
     import numpy as np
 
     if not state.get("init", {}).get("is_tpu"):
@@ -380,6 +382,38 @@ def _bench_pallas(state):
     ok_i = bool(np.mean(np.asarray(i_p) == np.asarray(i_r)) > 0.999)
     out = {"status": "ok" if (ok_d and ok_i) else "mismatch",
            "dist_close": ok_d, "idx_match": ok_i}
+
+    # pairwise_tile (the unexpanded-metric kernel): compiled L1 at a
+    # host-checkable shape, plus a timed 2k x 2k call
+    try:
+        from raft_tpu.distance import DistanceType, pairwise_distance
+
+        xs = _rand((512, 128), 9)
+        ys = _rand((384, 128), 10)
+        got = np.asarray(pairwise_distance(xs, ys, DistanceType.L1))
+        ref = np.abs(np.asarray(xs)[:, None, :]
+                     - np.asarray(ys)[None, :, :]).sum(-1)
+        out["pairwise_tile_l1_ok"] = bool(
+            np.allclose(got, ref, rtol=2e-4, atol=2e-3))
+        xt = _rand((2048, 128), 11)
+        yt = _rand((2048, 128), 12)
+
+        def pstep(a):
+            return pairwise_distance(a, yt, DistanceType.L1)
+
+        dt = _time_chained(pstep, xt, 4)
+        out["pairwise_tile_l1_gpairs"] = round(2048 * 2048 / dt / 1e9, 3)
+        # VPU elementwise kernel (never touches the MXU): report the
+        # achieved elementwise rate only — an MXU-peak mfu here would be
+        # meaningless
+        out["pairwise_tile_l1_gops"] = round(
+            3.0 * 2048 * 2048 * 128 / dt / 1e9, 1)  # sub+abs+add / elt
+        if not out["pairwise_tile_l1_ok"]:
+            out["status"] = "mismatch"
+    except Exception:
+        out["pairwise_tile_error"] = traceback.format_exc()[-400:]
+        if out["status"] == "ok":  # never mask a fused-kNN mismatch
+            out["status"] = "pairwise_tile_error"
     if _remaining() > 90:
         index = _rand((100_000, 128), 3)
         queries = _rand((1024, 128), 4)

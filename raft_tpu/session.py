@@ -113,8 +113,9 @@ class Comms:
         comms.py:154, which maps each Dask worker to its NCCL rank and
         UCX port).  Here a worker is a mesh device: the map is keyed by
         device id and carries the *communicator* rank — the device's
-        coordinate along the comms axis, i.e. the rank space
-        ``HostComms.get_rank()`` reports — plus its position on any
+        coordinate along the comms axis, the same rank space
+        ``lax.axis_index(comms.axis)`` reports in-trace — plus its
+        position on any
         other mesh axes, process index, and platform.  ``workers``
         optionally restricts to those device ids."""
         import numpy as np
