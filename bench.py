@@ -687,18 +687,39 @@ def child_main():
             ("spectral_100k", 80, _bench_spectral_100k),
         ]
 
-    for name, est, fn in rungs:
+    # gRPC-status tokens of a dead/hung device — matched against the
+    # exception MESSAGE only (a full traceback mentions benign words
+    # like "backend" in rendered source lines of ordinary bugs)
+    dead_signs = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                  "Unable to initialize backend")
+    consecutive_dead = 0
+    for idx, (name, est, fn) in enumerate(rungs):
         if _remaining() < est:
             skipped.append(name)
             _emit("skipped", skipped)
             continue
         try:
             state[name] = _tag(fn())
-        except Exception:
+        except Exception as e:
             state.setdefault("errors", {})[name] = \
                 traceback.format_exc()[-600:]
             _emit("errors", state["errors"])
+            # a dead/hung device fails every later rung too (observed:
+            # tunnel died mid-session after a healthy init) — after two
+            # consecutive device-level failures, stop burning the budget
+            # on timeouts and emit what's banked
+            if any(s in str(e) for s in dead_signs):
+                consecutive_dead += 1
+                if consecutive_dead >= 2:
+                    state["aborted"] = "device_unavailable_mid_ladder"
+                    skipped.extend(n for n, _, _ in rungs[idx + 1:])
+                    _emit("skipped", skipped)
+                    _emit("aborted", state["aborted"])
+                    break
+            else:
+                consecutive_dead = 0
             continue
+        consecutive_dead = 0
         _emit(name, state[name])
     if skipped:
         state["skipped"] = skipped
@@ -775,10 +796,10 @@ def _tpu_attempt_note(tpu, deadline):
             "init_ok_but_no_accelerator_rung_completed"
             if tpu.state["init"].get("is_tpu")
             else "init_on_non_accelerator_backend")
-        # keep the child's evidence: which rungs errored/skipped and
-        # anything it did bank — 'init ok, all rungs died' must stay
+        # keep the child's evidence: which rungs errored/skipped/aborted
+        # and anything it did bank — 'init ok, all rungs died' must stay
         # diagnosable from the report alone
-        for key in ("init", "errors", "skipped"):
+        for key in ("init", "errors", "skipped", "aborted"):
             if tpu.state.get(key) is not None:
                 note[key] = tpu.state[key]
     elif rc is None:
