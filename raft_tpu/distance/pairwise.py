@@ -137,9 +137,18 @@ def _c_l2(xv, yv):
 
 
 def _c_canberra(xv, yv):
+    # dtype-matched constants: under jax_enable_x64 a Python-float where
+    # branch traces as a weak-f64 literal whose f64->f32 convert lands
+    # INSIDE the Pallas kernel, and Mosaic lowering rejects it
+    # ("Unsupported cast: float64 -> float32", mosaic/lowering.py) even
+    # though the op's *result* dtype is f32 — caught by
+    # test_every_unexpanded_metric_combine_lowers, which fails on the
+    # literal form and passes on this one
     d = jnp.abs(xv - yv)
     s = jnp.abs(xv) + jnp.abs(yv)
-    return jnp.where(s == 0, 0.0, d / jnp.where(s == 0, 1.0, s))
+    zero = jnp.zeros((), d.dtype)
+    one = jnp.ones((), d.dtype)
+    return jnp.where(s == 0, zero, d / jnp.where(s == 0, one, s))
 
 
 def _c_minkowski(p):
@@ -155,12 +164,18 @@ def _c_hamming(xv, yv):
 
 def _c_jensen_shannon(xv, yv):
     # KL(x||m) + KL(y||m) with m = (x+y)/2 and 0log0 = 0
-    # (jensen_shannon.cuh:85)
+    # (jensen_shannon.cuh:85).  Constants are dtype-matched — see
+    # _c_canberra: a Python-float where branch traces as weak f64 under
+    # jax_enable_x64 and the resulting in-kernel f64->f32 convert fails
+    # Mosaic lowering.
     m = 0.5 * (xv + yv)
-    logm = jnp.log(jnp.where(m > 0, m, 1.0))
+    zero = jnp.zeros((), m.dtype)
+    one = jnp.ones((), m.dtype)
+    logm = jnp.log(jnp.where(m > 0, m, one))
 
     def term(v):
-        return jnp.where(v > 0, v * (jnp.log(jnp.where(v > 0, v, 1.0)) - logm), 0.0)
+        return jnp.where(
+            v > 0, v * (jnp.log(jnp.where(v > 0, v, one)) - logm), zero)
 
     return term(xv) + term(yv)
 

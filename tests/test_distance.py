@@ -136,6 +136,21 @@ def test_fin_op(rng):
     np.testing.assert_allclose(np.diag(got), 1.0, atol=1e-5)
 
 
+def test_fin_op_adjacency(rng):
+    """Epsilon-neighborhood adjacency via fin_op (reference dist_adj.cu:
+    the distance kernel's FinalLambda thresholds into a bool matrix)."""
+    x = rng.standard_normal((20, 6)).astype(np.float32)
+    y = rng.standard_normal((15, 6)).astype(np.float32)
+    eps = 6.0
+    adj = np.asarray(pairwise_distance(
+        jnp.array(x), jnp.array(y), D.L2Expanded,
+        fin_op=lambda d: d <= eps))
+    ref = naive(x.astype(np.float64), y.astype(np.float64),
+                D.L2Expanded) <= eps
+    assert adj.dtype == np.bool_
+    np.testing.assert_array_equal(adj, ref)
+
+
 def test_unsupported_metric(rng):
     x = jnp.zeros((4, 4))
     with pytest.raises(RaftError, match="Unknown or unsupported"):

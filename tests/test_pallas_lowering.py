@@ -78,3 +78,23 @@ class TestPairwiseTileLowersForTPU:
                                  epilog=jnp.sqrt, interpret=False)
 
         _export_tpu(f, (512, 64), (512, 64))
+
+    @pytest.mark.parametrize("metric_name", [
+        "L1", "L2SqrtUnexpanded", "Linf", "Canberra", "LpUnexpanded",
+        "HammingUnexpanded", "JensenShannon", "BrayCurtis",
+    ])
+    def test_every_unexpanded_metric_combine_lowers(self, metric_name):
+        """Each metric's combine lambda is a different elementwise
+        program inside the kernel (where-guards, pow, log, != casts) —
+        any one of them can hit a Mosaic-unsupported op even when the
+        L1/L2 combines lower fine.  Export the PUBLIC dispatch so the
+        exact shipped kernel is what lowers."""
+        from raft_tpu.distance import DistanceType, pairwise_distance
+
+        metric = getattr(DistanceType, metric_name)
+
+        def f(x, y):
+            return pairwise_distance(x, y, metric, metric_arg=1.5,
+                                     interpret=False)
+
+        _export_tpu(f, (256, 96), (192, 96))
