@@ -98,3 +98,48 @@ class TestPairwiseTileLowersForTPU:
                                      interpret=False)
 
         _export_tpu(f, (256, 96), (192, 96))
+
+
+class TestXlaPathsExportForTPU:
+    """The XLA-path entry points can hide TPU-hostile dtypes too (f64
+    promotions under x64 have no TPU lowering); export them for the tpu
+    platform hardware-free.  No Mosaic assert — these are plain XLA."""
+
+    def _export(self, fn, *shapes, dtypes=None):
+        dtypes = dtypes or [jnp.float32] * len(shapes)
+        args = [jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)]
+        jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+    def test_brute_force_knn_xla(self):
+        from raft_tpu.spatial import brute_force_knn
+
+        self._export(
+            lambda x, q: brute_force_knn([x], q, 100),
+            (100_000, 128), (1024, 128))
+
+    def test_fused_l2_nn(self):
+        from raft_tpu.distance import fused_l2_nn
+
+        self._export(lambda x, y: fused_l2_nn(x, y), (4096, 64), (4096, 64))
+
+    def test_select_k_approx(self):
+        from raft_tpu.spatial.select_k import select_k
+
+        self._export(lambda d: select_k(d, 100, impl="approx"),
+                     (512, 8192))
+
+    def test_mnmg_knn_single_axis(self):
+        """The SPMD program (shard_map + all_gather + reselect) must
+        export for tpu; uses a 1-device mesh (the program is the same
+        module for any axis size)."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from raft_tpu.spatial.mnmg_knn import mnmg_knn
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("ranks",))
+
+        def f(x, q):
+            return mnmg_knn(x, q, 10, mesh=mesh, axis="ranks")
+
+        self._export(f, (1000, 32), (64, 32))
