@@ -128,6 +128,23 @@ class TestXlaPathsExportForTPU:
         self._export(lambda d: select_k(d, 100, impl="approx"),
                      (512, 8192))
 
+    def test_sparse_coltiled_distance(self):
+        """The column-tiled sparse engine (round-4 scalability fix) must
+        export for tpu — its densify/segment-sum drivers are the most
+        scatter-heavy programs in the package."""
+        from raft_tpu.distance import DistanceType
+        from raft_tpu.sparse.distance import pairwise_distance as spw
+        from raft_tpu.sparse.formats import CSR
+
+        def f(aip, ai, ad, bip, bi, bd):
+            ca = CSR(aip, ai, ad, shape=(64, 4096))
+            cb = CSR(bip, bi, bd, shape=(48, 4096))
+            return spw(ca, cb, DistanceType.L2Expanded, batch_size_k=512)
+
+        self._export(f, (65,), (640,), (640,), (49,), (480,), (480,),
+                     dtypes=[jnp.int32, jnp.int32, jnp.float32,
+                             jnp.int32, jnp.int32, jnp.float32])
+
     def test_mnmg_knn_single_axis(self):
         """The SPMD program (shard_map + all_gather + reselect) must
         export for tpu; uses a 1-device mesh (the program is the same
