@@ -99,6 +99,7 @@ def _search_one_partition(
     metric: DistanceType,
     metric_arg: float,
     tile_n: int,
+    precision: str = "highest",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search a single index partition; returns (distances, int32 indices).
 
@@ -107,7 +108,8 @@ def _search_one_partition(
     """
     if metric in _L2_FAMILY:
         # fast path, reference :297-313; squared distances
-        return fused_l2_knn(part, queries, k, tile_n=tile_n)
+        return fused_l2_knn(part, queries, k, tile_n=tile_n,
+                            precision=precision)
     if metric == D.Haversine:
         expects(queries.shape[1] == 2,
                 "Haversine distance requires 2 dimensions (latitude / longitude).")
@@ -116,15 +118,27 @@ def _search_one_partition(
         proc = create_processor(metric)
         q = proc.preprocess(queries)
         p = proc.preprocess(part)
-        sim = jnp.matmul(q, p.T, precision="highest")
+        sim = jnp.matmul(q, p.T, precision=precision)
         # 1 - sim before selection: monotone-reversing, so min-select on
         # distances == the reference's max-select on similarities
         return select_k(proc.postprocess(sim), k, select_min=True)
     if metric in _IP_FAMILY:
-        ip = jnp.matmul(queries, part.T, precision="highest")
+        ip = jnp.matmul(queries, part.T, precision=precision)
         return select_k(ip, k, select_min=False)
-    # generic metric: full pairwise tile + selection (FAISS bfKnn analog)
-    dist = pairwise_distance(queries, part, metric, metric_arg=metric_arg)
+    # generic metric: full pairwise tile + selection (FAISS bfKnn
+    # analog).  pairwise_distance's matmul-backed metrics read the
+    # module-global precision, so pin it to this call's request for the
+    # duration — otherwise precision= would be a silent no-op here
+    from raft_tpu.distance.pairwise import (_DEFAULT_PRECISION,
+                                            set_default_precision)
+
+    prev = _DEFAULT_PRECISION
+    set_default_precision(precision)
+    try:
+        dist = pairwise_distance(queries, part, metric,
+                                 metric_arg=metric_arg)
+    finally:
+        set_default_precision(prev)
     return select_k(dist, k, select_min=True)
 
 
@@ -136,6 +150,7 @@ def brute_force_knn(
     metric_arg: float = 2.0,
     translations: Optional[Sequence[int]] = None,
     tile_n: int = 8192,
+    precision: str = "highest",
     handle=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN of ``queries`` against one or more index partitions.
@@ -157,6 +172,11 @@ def brute_force_knn(
         partition starts (reference id_ranges, :241-255).
     tile_n:
         Index tile size for the scanned L2/haversine paths.
+    precision:
+        MXU matmul precision for the distance dot products: "highest"
+        (default, f32-accurate via multi-pass bf16) or "default"
+        (single-pass bf16 — the TF32-tensor-core-class speed/accuracy
+        trade; the reference's cublas math-mode analog).
     handle:
         Optional :class:`raft_tpu.core.handle.Handle`.  Each partition's
         search is recorded on the next pool stream (the reference forks
@@ -186,7 +206,8 @@ def brute_force_knn(
     select_min = metric not in _IP_FAMILY
     results = []
     for i, p in enumerate(parts):
-        r = _search_one_partition(p, queries, k, metric, metric_arg, tile_n)
+        r = _search_one_partition(p, queries, k, metric, metric_arg, tile_n,
+                                  precision)
         if handle is not None:
             handle.get_next_usable_stream(i).record(*r)
         results.append(r)

@@ -86,6 +86,7 @@ def mnmg_knn(
     axis: Optional[str] = None,
     query_axis: Optional[str] = None,
     tile_n: int = 8192,
+    precision: str = "highest",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN with the index row-sharded across a mesh axis.
 
@@ -104,6 +105,9 @@ def mnmg_knn(
     query_axis:
         Optional second mesh axis to shard queries over; nq must divide
         by its size.
+    precision:
+        MXU matmul precision for the local searches ("highest" default;
+        "default" = single-pass bf16, see ``brute_force_knn``).
 
     Returns
     -------
@@ -140,7 +144,7 @@ def mnmg_knn(
     def shard_fn(ix, q):
         # local partition search (reference per-partition stream search)
         d_loc, i_loc = _search_one_partition(ix, q, k_local, metric,
-                                             metric_arg, tile_n)
+                                             metric_arg, tile_n, precision)
         # translate to global ids; mask this shard's padding rows
         base = lax.axis_index(axis_) * rows
         gid = (i_loc + base).astype(jnp.int32)

@@ -359,3 +359,26 @@ class TestSelectKImpl:
         monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "bogus")
         with pytest.raises(Exception, match="unknown impl"):
             select_k(jnp.ones((2, 8)), 2)
+
+
+def test_brute_force_knn_precision_kwarg(rng):
+    """precision= threads through to the distance matmuls (the cublas
+    math-mode analog); on CPU all precisions are exact f32, so results
+    must match the default exactly."""
+    from raft_tpu.spatial import brute_force_knn
+
+    import jax
+
+    x = jnp.asarray(rng.standard_normal((300, 24)).astype(np.float32))
+    q = jnp.asarray(rng.standard_normal((17, 24)).astype(np.float32))
+    d_hi, i_hi = brute_force_knn([x], q, 8)
+    d_df, i_df = brute_force_knn([x], q, 8, precision="default")
+    if jax.default_backend() == "cpu":
+        # exact-equality is a CPU-only property: on TPU 'default' is
+        # genuinely single-pass bf16 and near ties may reorder
+        np.testing.assert_array_equal(np.asarray(i_hi), np.asarray(i_df))
+    else:
+        assert i_df.shape == i_hi.shape
+    d_ip, i_ip = brute_force_knn(
+        [x], q, 8, metric=D.InnerProduct, precision="default")
+    assert d_ip.shape == (17, 8)
