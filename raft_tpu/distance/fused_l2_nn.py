@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.utils import is_tpu_backend
 
 IDX_SENTINEL = jnp.iinfo(jnp.int32).max
 
@@ -123,6 +124,7 @@ def fused_l2_nn(
     tile_n: int = 4096,
     mask: Optional[jnp.ndarray] = None,
     precision: str = "highest",
+    impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """For each row of x (m, k): min L2 distance to rows of y (n, k) and its
     index.  Returns ``(min_dists (m,), min_idx (m,) int32)``.
@@ -133,6 +135,30 @@ def fused_l2_nn(
     ``(inf, IDX_SENTINEL)``.  (connect_components uses the per-tile
     ``tile_mask_fn`` hook of :func:`fused_l2_nn_min_reduce` instead, which
     avoids materializing m×n.)
+
+    ``impl``: "pallas" (the fully fused kernel,
+    :mod:`raft_tpu.ops.nn_tile` — default on a real TPU backend for the
+    plain f32 min-reduce case), "xla" (the tiled scan), or None = pick
+    per backend.  Auto-selection routes the mask / f64 cases to the XLA
+    scan; an *explicit* pallas request for them errors rather than
+    silently running another impl (same convention as fused_l2_knn).
     """
+    requested = impl
+    if impl is None:
+        impl = "pallas" if is_tpu_backend() else "xla"
+    expects(impl in ("xla", "pallas"), "fused_l2_nn: unknown impl %s", impl)
+    plain_f32 = (mask is None
+                 and jnp.result_type(x.dtype, jnp.float32) == jnp.float32)
+    expects(not (requested == "pallas" and not plain_f32),
+            "fused_l2_nn: impl='pallas' serves the plain f32 min-reduce "
+            "only (no mask, no f64) — use impl='xla' for this case")
+    if impl == "pallas" and plain_f32:
+        from raft_tpu.ops.nn_tile import fused_nn_tile
+
+        vals, idx = fused_nn_tile(x, y, block_n=min(tile_n, 1024),
+                                  precision=precision)
+        if sqrt:
+            vals = jnp.sqrt(vals)
+        return vals, idx
     return fused_l2_nn_min_reduce(
         x, y, sqrt=sqrt, tile_n=tile_n, mask=mask, precision=precision)

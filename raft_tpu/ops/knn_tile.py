@@ -56,6 +56,30 @@ from raft_tpu.core.utils import ceildiv, is_tpu_backend
 _INF = float("inf")
 
 
+def tile_geometry(m_rows: int, n_rows: int, d: int, block_rows: int,
+                  block_n: int, unit: int):
+    """Shared tiling/padding geometry of the fused distance kernels
+    (this one and :mod:`raft_tpu.ops.nn_tile`): index-block size ``bn``
+    as a multiple of ``unit`` (the lane-group width), group count ``g``,
+    row-block ``bm`` (8-aligned), padded depth ``dp`` (128-aligned above
+    128, else full), and the padded totals.  One definition so the
+    padding rules cannot drift between the kernels."""
+    bn = max(block_n // unit, 2) * unit if block_n >= 2 * unit else 2 * unit
+    bn = min(bn, ceildiv(n_rows, unit) * unit)
+    g = bn // unit
+    bm = max(8, min(block_rows, ceildiv(m_rows, 8) * 8) // 8 * 8)
+    dp = ceildiv(d, 128) * 128 if d > 128 else d
+    return bm, bn, g, dp, ceildiv(m_rows, bm) * bm, ceildiv(n_rows, bn) * bn
+
+
+def pad_with_norms(a: jnp.ndarray, rows_pad: int, dp: int):
+    """f32-cast, zero-pad to (rows_pad, dp), and return (padded, row
+    squared-norms) — the expanded-form precompute both kernels share."""
+    af = jnp.pad(a.astype(jnp.float32),
+                 ((0, rows_pad - a.shape[0]), (0, dp - a.shape[1])))
+    return af, jnp.sum(af * af, axis=1)
+
+
 def _roll_lanes(x: jnp.ndarray, shift: int, interpret: bool) -> jnp.ndarray:
     """Circular shift along the lane (last) axis.
 
@@ -205,17 +229,13 @@ def fused_knn_tile(
     kpad = 128
     while kpad < k:
         kpad *= 2
-    bn = max(block_n // kpad, 2) * kpad if block_n >= 2 * kpad else 2 * kpad
-    bn = min(bn, ceildiv(n, kpad) * kpad)
-    g = bn // kpad
-    bm = max(8, min(block_q, ceildiv(nq, 8) * 8) // 8 * 8)
-    dp = ceildiv(d, 128) * 128 if d > 128 else d
-    np_, mp = ceildiv(n, bn) * bn, ceildiv(nq, bm) * bm
+    bm, bn, g, dp, mp, np_ = tile_geometry(nq, n, d, block_q, block_n,
+                                           unit=kpad)
 
-    xf = jnp.pad(index.astype(jnp.float32), ((0, np_ - n), (0, dp - d)))
-    qf = jnp.pad(queries.astype(jnp.float32), ((0, mp - nq), (0, dp - d)))
-    xn = jnp.sum(xf * xf, axis=1)[None, :]               # (1, np_)
-    qn = jnp.sum(qf * qf, axis=1)[:, None]               # (mp, 1)
+    xf, xn_row = pad_with_norms(index, np_, dp)
+    qf, qn_row = pad_with_norms(queries, mp, dp)
+    xn = xn_row[None, :]                                 # (1, np_)
+    qn = qn_row[:, None]                                 # (mp, 1)
 
     grid = (mp // bm, np_ // bn)
     kern = functools.partial(

@@ -1,0 +1,166 @@
+"""Fused L2-distance + 1-nearest-neighbor Pallas kernel.
+
+TPU re-design of the reference's second fused crown jewel:
+``fusedL2NN`` (cpp/include/raft/distance/detail/fused_l2_nn.cuh:134,267)
+— one CUDA kernel computes an L2 tile and immediately argmin-reduces
+each row into a running (value, index) pair guarded by per-row mutexes.
+
+This kernel keeps the structure of the proven fused kNN kernel
+(:mod:`raft_tpu.ops.knn_tile` — grid (query_tiles, index_tiles), index
+innermost, VMEM-resident running state, MXU distance tile) but the
+selection degenerates from a bitonic top-k merge to a lane-parallel
+running minimum:
+
+- the running state is a (bm, 128) value lane-vector plus its int32 id
+  payload — one candidate minimum per lane column, strided over the
+  index tile exactly like the kNN kernel's groups;
+- each index tile: MXU computes ``xn + yn - 2 x@yT``; a (bm, g, 128)
+  reshape group-mins down to (bm, 128) with the owning group recovered
+  by a masked min over the group iota; the lane-parallel merge takes
+  the candidate on strict improvement or an equal-value smaller id
+  (the deterministic tie rule of the XLA path; the reference's atomic
+  version is first-writer-wins);
+- the final 128→1 reduction per row happens OUTSIDE the kernel in XLA
+  (an (m, 128) lexicographic min — negligible), so the kernel needs no
+  cross-lane reduction at all.
+
+The (m, n) distance matrix never exists anywhere, and unlike the XLA
+scan path the (bm, bn) tile never round-trips HBM.  Serves the default
+min-reduce contract only; custom reduce ops / masks / f64 stay on the
+XLA scan (:mod:`raft_tpu.distance.fused_l2_nn`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.utils import is_tpu_backend
+from raft_tpu.ops.knn_tile import pad_with_norms, tile_geometry
+
+_INF = float("inf")
+# the same untouched-init sentinel the XLA reduce uses
+# (raft_tpu/distance/fused_l2_nn.py, imported there as IDX_SENTINEL;
+# redeclared by value here to keep ops/ free of distance/ imports)
+IDX_SENTINEL = jnp.iinfo(jnp.int32).max
+
+
+def _nn_kernel(x_ref, y_ref, xn_ref, yn_ref, ov_ref, oi_ref,
+               bv_ref, bi_ref, *, bn, n_index, n_j_tiles, g, precision):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        bv_ref[:] = jnp.full_like(bv_ref, _INF)
+        bi_ref[:] = jnp.full_like(bi_ref, IDX_SENTINEL)
+
+    acc = jax.lax.dot_general(
+        x_ref[:], y_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dist = xn_ref[:] + yn_ref[:] - 2.0 * acc
+    dist = jnp.maximum(dist, 0.0)
+    inf32 = jnp.float32(_INF)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    dist = jnp.where(j * bn + col < n_index, dist, inf32)
+
+    bm = dist.shape[0]
+    d3 = dist.reshape(bm, g, 128)
+    gmin = jnp.min(d3, axis=1)                                # (bm, 128)
+    gg_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, g, 128), 1)
+    is_min = d3 == jnp.expand_dims(gmin, 1)
+    gg_star = jnp.min(jnp.where(is_min, gg_iota, jnp.int32(g)), axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bm, 128), 1)
+    cand_i = j * bn + gg_star * 128 + lane
+    cand_i = jnp.where(gmin < inf32, cand_i, jnp.int32(IDX_SENTINEL))
+
+    bv, bi = bv_ref[:], bi_ref[:]
+    # strict improvement, or an equal finite value with a smaller id —
+    # mask logical ops, not boolean-valued selects (Mosaic rejects
+    # i8->i1 truncations; see knn_tile.py)
+    take = (gmin < bv) | ((gmin == bv) & (gmin < inf32) & (cand_i < bi))
+    bv_ref[:] = jnp.where(take, gmin, bv)
+    bi_ref[:] = jnp.where(take, cand_i, bi)
+
+    @pl.when(j == n_j_tiles - 1)
+    def _emit():
+        ov_ref[:] = bv_ref[:]
+        oi_ref[:] = bi_ref[:]
+
+
+def fused_nn_tile(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    block_m: int = 256,
+    block_n: int = 1024,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per row of x: (min squared-L2 distance to rows of y, its index).
+
+    Returns ``(vals (m,), idx (m,) int32)``; ties break toward the
+    smaller index; with n == 0 nothing is admissible (callers guard).
+    Squared distances — the sqrt epilogue is the caller's (monotonic,
+    so the argmin is unchanged), matching fused_l2_nn.cuh's Sqrt
+    template parameter handling.
+    """
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
+            "fused_nn_tile: shape mismatch")
+    m, d = x.shape
+    n = y.shape[0]
+    expects(n > 0, "fused_nn_tile: empty index")
+    if interpret is None:
+        interpret = not is_tpu_backend()
+
+    bm, bn, g, dp, mp, np_ = tile_geometry(m, n, d, block_m, block_n,
+                                           unit=128)
+
+    xf, xn_row = pad_with_norms(x, mp, dp)
+    yf, yn_row = pad_with_norms(y, np_, dp)
+    xn = xn_row[:, None]                                 # (mp, 1)
+    yn = yn_row[None, :]                                 # (1, np_)
+
+    grid = (mp // bm, np_ // bn)
+    kern = functools.partial(
+        _nn_kernel, bn=bn, n_index=n, n_j_tiles=grid[1], g=g,
+        precision=jax.lax.Precision(precision) if precision else None)
+    out_v, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, dp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, dp), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, 128), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, 128), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, 128), jnp.float32),
+            jax.ShapeDtypeStruct((mp, 128), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bm, 128), jnp.float32),
+            pltpu.VMEM((bm, 128), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(xf, yf, xn, yn)
+
+    # final 128->1 lexicographic (value, id) min per row, in XLA: among
+    # equal minimal lanes choose the smallest id
+    vals128 = out_v[:m]
+    ids128 = out_i[:m]
+    vmin = jnp.min(vals128, axis=1)
+    at_min = vals128 == vmin[:, None]
+    best_i = jnp.min(jnp.where(at_min, ids128, IDX_SENTINEL), axis=1)
+    return vmin, best_i.astype(jnp.int32)

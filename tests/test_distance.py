@@ -251,3 +251,51 @@ class TestReviewRegressions:
                             lambda a, b: jnp.abs(a - b), block_k=256)
         ref = np.abs(x[:, None, :] - x[None, :, :]).sum(-1)
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestFusedNnTile:
+    """The Pallas fused 1-NN kernel (ops/nn_tile.py) vs the XLA scan —
+    the fused_l2_nn.cuh:134 analog, interpret-mode on CPU."""
+
+    def _check(self, rng, m, n, d, block_n=1024):
+        from raft_tpu.ops.nn_tile import fused_nn_tile
+
+        x = jnp.asarray(rng.standard_normal((m, d)).astype(np.float32))
+        y = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+        v_p, i_p = fused_nn_tile(x, y, block_n=block_n)
+        v_r, i_r = fused_l2_nn(x, y, impl="xla")
+        np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_r),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_r))
+
+    def test_aligned(self, rng):
+        self._check(rng, 64, 512, 32)
+
+    def test_ragged(self, rng):
+        self._check(rng, 57, 1000, 17, block_n=256)
+
+    def test_wide_d(self, rng):
+        self._check(rng, 32, 300, 200)
+
+    def test_multi_tile(self, rng):
+        self._check(rng, 40, 5000, 8, block_n=512)
+
+    def test_tie_breaks_to_smaller_index(self):
+        from raft_tpu.ops.nn_tile import fused_nn_tile
+
+        # duplicate rows of y: the nearest is at distance 0 twice; the
+        # kernel must report the smaller id like the XLA reduce
+        y = jnp.asarray(np.array([[1.0, 0.0], [3.0, 0.0], [1.0, 0.0],
+                                  [5.0, 1.0]], np.float32))
+        x = y[:1]
+        v, i = fused_nn_tile(x, y)
+        assert float(v[0]) == 0.0 and int(i[0]) == 0
+
+    def test_dispatch_sqrt(self, rng):
+        x = jnp.asarray(rng.standard_normal((20, 8)).astype(np.float32))
+        y = jnp.asarray(rng.standard_normal((50, 8)).astype(np.float32))
+        v_p, i_p = fused_l2_nn(x, y, sqrt=True, impl="pallas")
+        v_r, i_r = fused_l2_nn(x, y, sqrt=True, impl="xla")
+        np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_r),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_r))

@@ -58,6 +58,17 @@ class TestFusedKnnTileLowersForTPU:
             (5000, 64), (96, 64))
 
 
+class TestFusedNnTileLowersForTPU:
+    def test_default_and_ragged(self):
+        from raft_tpu.ops.nn_tile import fused_nn_tile
+
+        _export_tpu(lambda x, y: fused_nn_tile(x, y, interpret=False),
+                    (4096, 128), (100_000, 128))
+        _export_tpu(lambda x, y: fused_nn_tile(x, y, block_n=256,
+                                               interpret=False),
+                    (57, 33), (1000, 33))
+
+
 class TestPairwiseTileLowersForTPU:
     @pytest.mark.parametrize("reduce_kind", ["add", "max"])
     def test_unexpanded_tile(self, reduce_kind):

@@ -63,8 +63,14 @@ def check_knn(n, nq, d, k, seed=0):
     # cancellation noise in the expanded form near zero
     dist_ok = bool(np.allclose(d_p, d_r, rtol=1e-5, atol=1e-3))
     mism = i_p != i_r
-    # every index mismatch must be a distance tie
-    tie_ok = bool(np.allclose(d_p[mism], d_r[mism], rtol=1e-5, atol=1e-3))
+    # every index mismatch must be a genuine tie: RECOMPUTE the distance
+    # at the claimed index (comparing claimed values alone would pass a
+    # kernel with right values but garbage ids)
+    xh, qh = np.asarray(x, np.float64), np.asarray(q, np.float64)
+    rows, poss = np.nonzero(mism)
+    d_at_claim = ((qh[rows] - xh[i_p[rows, poss]]) ** 2).sum(axis=1)
+    tie_ok = bool(np.allclose(d_at_claim, d_r[rows, poss],
+                              rtol=1e-4, atol=1e-3))
     rec = {
         "check": "fused_knn", "n": n, "nq": nq, "d": d, "k": k,
         "dist_ok": dist_ok, "idx_mismatch_frac": float(mism.mean()),
@@ -80,6 +86,39 @@ def check_knn(n, nq, d, k, seed=0):
              "i_pallas": int(i_p[tuple(p)]), "i_xla": int(i_r[tuple(p)])}
             for p in bad]
         rec["max_abs_diff"] = float(np.max(np.abs(d_p - d_r)))
+    emit(rec)
+    return rec["ok"]
+
+
+def check_nn(m, n, d, seed=0):
+    """Compiled fused 1-NN kernel vs the XLA scan path."""
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+
+    x = rand((m, d), seed)
+    y = rand((n, d), seed + 1)
+    t0 = time.time()
+    v_p, i_p = fused_l2_nn(x, y, impl="pallas")
+    v_p, i_p = np.asarray(v_p), np.asarray(i_p)
+    t_pallas = time.time() - t0
+    t0 = time.time()
+    v_r, i_r = fused_l2_nn(x, y, impl="xla")
+    v_r, i_r = np.asarray(v_r), np.asarray(i_r)
+    t_xla = time.time() - t0
+    val_ok = bool(np.allclose(v_p, v_r, rtol=1e-5, atol=1e-3))
+    mism = i_p != i_r
+    # an index mismatch is only acceptable when the claimed neighbor is
+    # genuinely at the minimal distance — RECOMPUTE ||x - y[i_p]||^2 at
+    # mismatched rows (comparing the two claimed values would pass a
+    # kernel whose values are right but whose ids are garbage)
+    xh, yh = np.asarray(x, np.float64), np.asarray(y, np.float64)
+    rows = np.nonzero(mism)[0]
+    d_at_claim = ((xh[rows] - yh[i_p[rows]]) ** 2).sum(axis=1)
+    tie_ok = bool(np.allclose(d_at_claim, v_r[rows], rtol=1e-4, atol=1e-3))
+    rec = {"check": "fused_nn", "m": m, "n": n, "d": d,
+           "val_ok": val_ok, "idx_mismatch_frac": float(mism.mean()),
+           "idx_ties_ok": tie_ok, "ok": val_ok and tie_ok,
+           "t_pallas_incl_compile": round(t_pallas, 2),
+           "t_xla_incl_compile": round(t_xla, 2)}
     emit(rec)
     return rec["ok"]
 
@@ -188,6 +227,11 @@ def main():
     ok &= check_knn(1000, 7, 17, 5, seed=101)       # tiny + ragged d
     ok &= check_knn(4096, 256, 384, 64, seed=102)   # d > 128 (k-tiling)
     ok &= check_knn(100_000, 1024, 128, 100, seed=103)
+
+    # fused 1-NN kernel (fused_l2_nn.cuh analog): aligned, ragged, 100k
+    ok &= check_nn(256, 4096, 128, seed=200)
+    ok &= check_nn(57, 1000, 17, seed=201)
+    ok &= check_nn(1024, 100_000, 128, seed=202)
 
     # pairwise metrics: aligned, ragged, and k > 128 (cross-k-tile
     # accumulation) shapes
