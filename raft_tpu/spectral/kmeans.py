@@ -73,6 +73,17 @@ def _kmeans_jit(X, k, tol, max_iter, seed):
     C0 = init_plus_plus(X, k, key)
 
     def assign(C):
+        if k >= 256:
+            # large quantizers (IVF builds: nlist ~ sqrt(n)) must not
+            # materialize the (n, k) matrix — 4 GB at n=1M, k=1024.  The
+            # fused 1-NN matches argmin's smaller-index tie rule; on TPU
+            # the Pallas kernel keeps the tile VMEM-resident, and the
+            # explicit tile_n bounds the XLA fallback's high-water at
+            # O(n * 512) so the optimization isn't backend-dependent
+            from raft_tpu.distance import fused_l2_nn
+
+            vals, labels = fused_l2_nn(X, C, tile_n=512)
+            return labels, jnp.sum(vals)
         dm = _sq_dists(X, C, xn)
         labels = jnp.argmin(dm, axis=1).astype(jnp.int32)
         residual = jnp.sum(jnp.take_along_axis(dm, labels[:, None],
