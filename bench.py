@@ -473,6 +473,30 @@ def _bench_knn_bf16(n_index, n_query, iters):
     }
 
 
+def _bench_fused_nn(n, n_centroids, dim, iters):
+    """Fused 1-NN (fusedL2NN analog) at the IVF coarse-assign scale:
+    n points against n_centroids, the kmeans-assignment inner op."""
+    from raft_tpu.distance import fused_l2_nn
+
+    x = _rand((n, dim), 13)
+    c = _rand((n_centroids, dim), 14)
+
+    def step(a):
+        # tile_n=512: the exact configuration the kmeans large-k
+        # assignment runs (kmeans.py assign), so this rung measures the
+        # real IVF coarse-assign op, not a different block size
+        vals, _ = fused_l2_nn(a, c, tile_n=512)
+        return vals
+
+    dt = _time_chained(step, x, iters)
+    return {
+        "seconds_per_call": round(dt, 4),
+        "n": n, "n_centroids": n_centroids, "dim": dim,
+        "assigns_per_sec": round(n / dt, 1),
+        "mfu": _mfu(2.0 * n * n_centroids * dim, dt),
+    }
+
+
 def _bench_linalg_bundle(n, iters):
     """BASELINE.md config #2: gemm + rowNorm + colReduce + transpose on
     dense f32 (linalg/gemm.cuh:46, norm.cuh:48, reduce.cuh:61,
@@ -686,6 +710,8 @@ def child_main():
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
             ("knn_100k_bf16", 60,
              lambda: _bench_knn_bf16(100_000, 4096, 4)),
+            ("fused_nn_1m", 60,
+             lambda: _bench_fused_nn(1_000_000, 1024, 64, 4)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),

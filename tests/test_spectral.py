@@ -156,3 +156,24 @@ class TestFitEmbedding:
         f = emb[:, 0]
         assert (np.sign(f[:12]) == np.sign(f[0])).all() or \
                (np.sign(f[12:]) == np.sign(f[12])).all()
+
+
+def test_kmeans_large_k_fused_assignment(rng):
+    """k >= 256 routes assignment through the fused 1-NN (kmeans.py
+    assign) — labels and residual must match the dense argmin exactly."""
+    from raft_tpu.spectral.kmeans import kmeans
+
+    X = jnp.asarray(rng.standard_normal((2000, 8)).astype(np.float32))
+    res = kmeans(X, 256, max_iter=2, seed=3)
+    labels = np.asarray(res.labels)
+    C = np.asarray(res.centroids)
+    Xh = np.asarray(X, np.float64)
+    dm = ((Xh[:, None, :] - C[None].astype(np.float64)) ** 2).sum(-1)
+    ref = dm.argmin(axis=1)
+    mism = labels != ref
+    # any mismatch must be an exact distance tie
+    assert np.allclose(dm[np.arange(2000), labels][mism],
+                       dm[np.arange(2000), ref][mism], rtol=1e-6), \
+        mism.sum()
+    np.testing.assert_allclose(
+        float(res.residual), dm.min(axis=1).sum(), rtol=1e-3)
