@@ -86,15 +86,6 @@ def default_specializations() -> Dict[str, Tuple[Any, Tuple]]:
         knn_fn, (jax.ShapeDtypeStruct((65536, 128), f32),
                  jax.ShapeDtypeStruct((1024, 128), f32)))
 
-    from raft_tpu.distance import fused_l2_nn
-
-    # tile_n=512 matches the kmeans large-k assignment call exactly
-    # (kmeans.py assign): warming any other configuration would not
-    # pre-pay the cache entry the IVF coarse-assign path needs
-    nn_fn = lambda x, c: fused_l2_nn(x, c, tile_n=512)
-    specs["fused_l2_nn_assign"] = (
-        nn_fn, (jax.ShapeDtypeStruct((65536, 64), f32),
-                jax.ShapeDtypeStruct((1024, 64), f32)))
     return specs
 
 
