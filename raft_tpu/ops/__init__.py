@@ -7,6 +7,8 @@ hierarchy (HBM → VMEM → MXU/VPU) with explicit block shapes, and falls back
 to interpreter mode off-TPU so the full test suite runs on CPU.
 """
 
+from raft_tpu.ops.knn_tile import fused_knn_tile
+from raft_tpu.ops.nn_tile import fused_nn_tile
 from raft_tpu.ops.pairwise_tile import pairwise_tile
 
-__all__ = ["pairwise_tile"]
+__all__ = ["fused_knn_tile", "fused_nn_tile", "pairwise_tile"]
