@@ -497,47 +497,74 @@ def _bench_fused_nn(n, n_centroids, dim, iters):
     }
 
 
-def _bench_ivf_flat(n_index, n_query, iters):
-    """IVF-Flat ANN: build once (untimed), then search QPS at
-    nprobe=32 with recall@10 against brute force on a probe slice —
-    throughput without recall is not an ANN benchmark."""
+def _bench_ivf(n_index, n_query, iters, build, search, params):
+    """Shared IVF rung driver: build once (untimed), timed search, and
+    recall@10 against brute force on a probe slice — throughput without
+    recall is not an ANN benchmark.  Index and queries split from ONE
+    make_blobs call so both draw from the same 256 centers (the
+    realistic in-distribution ANN regime; pure random Gaussian has no
+    neighbor structure and understates every IVF index's recall —
+    measured 0.37 vs 1.0)."""
     import numpy as np
-
-    from raft_tpu.spatial import brute_force_knn
-    from raft_tpu.spatial.ann import (IVFFlatParams, ivf_flat_build,
-                                      ivf_flat_search)
-
     import jax.numpy as jnp
 
+    from raft_tpu.spatial import brute_force_knn
+
     dim, k, nprobe = 128, 10, 32
-    # clustered data, ONE make_blobs call split into index + queries so
-    # both draw from the same 256 centers (the realistic in-distribution
-    # ANN regime; pure random Gaussian has no neighbor structure and
-    # understates every IVF index's recall — measured 0.37 vs 1.0)
     X_all, _ = make_blobs(np.random.default_rng(15), n_index + n_query,
                           dim, 256, spread=0.35)
     index_data = jnp.asarray(X_all[:n_index])
     queries = jnp.asarray(X_all[n_index:])
-    idx = ivf_flat_build(index_data, IVFFlatParams(nlist=1024))
+    idx = build(index_data)
 
     def step(q):
-        d, _ = ivf_flat_search(idx, q, k=k, nprobe=nprobe)
+        d, _ = search(idx, q, k=k, nprobe=nprobe)
         return d
 
     dt = _time_chained(step, queries, iters)
     probe = queries[:256]
-    _, ii = ivf_flat_search(idx, probe, k=k, nprobe=nprobe)
+    _, ii = search(idx, probe, k=k, nprobe=nprobe)
     _, ri = brute_force_knn([index_data], probe, k)
     ii, ri = np.asarray(ii), np.asarray(ri)
     recall = float(np.mean([
         len(set(ii[r]) & set(ri[r])) / k for r in range(ii.shape[0])]))
-    return {
+    out = {
         "qps": round(n_query / dt, 1),
         "seconds_per_batch": round(dt, 4),
         "n_index": n_index, "n_query": n_query, "dim": dim,
-        "k": k, "nlist": 1024, "nprobe": nprobe,
+        "k": k, "nprobe": nprobe,
         "recall_at_10_vs_exact": round(recall, 4),
     }
+    out.update(params)
+    return out
+
+
+def _bench_ivf_flat(n_index, n_query, iters):
+    """IVF-Flat ANN (reference approx_knn IVFFlat path)."""
+    from raft_tpu.spatial.ann import (IVFFlatParams, ivf_flat_build,
+                                      ivf_flat_search)
+
+    nlist = 1024
+    return _bench_ivf(
+        n_index, n_query, iters,
+        build=lambda X: ivf_flat_build(X, IVFFlatParams(nlist=nlist)),
+        search=ivf_flat_search,
+        params={"nlist": nlist})
+
+
+def _bench_ivf_pq(n_index, n_query, iters):
+    """IVF-PQ with exact refinement (the FAISS IndexRefineFlat analog):
+    memory-compressed codes + re-rank."""
+    from raft_tpu.spatial.ann import (IVFPQParams, ivf_pq_build,
+                                      ivf_pq_search)
+
+    nlist, M, refine = 1024, 16, 4
+    return _bench_ivf(
+        n_index, n_query, iters,
+        build=lambda X: ivf_pq_build(
+            X, IVFPQParams(nlist=nlist, M=M, refine_ratio=refine)),
+        search=ivf_pq_search,
+        params={"nlist": nlist, "M": M, "refine_ratio": refine})
 
 
 def _bench_linalg_bundle(n, iters):
@@ -757,6 +784,8 @@ def child_main():
              lambda: _bench_fused_nn(1_000_000, 1024, 64, 4)),
             ("ivf_flat_100k", 90,
              lambda: _bench_ivf_flat(100_000, 4096, 4)),
+            ("ivf_pq_100k", 90,
+             lambda: _bench_ivf_pq(100_000, 4096, 4)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),
