@@ -567,6 +567,20 @@ def _bench_ivf_pq(n_index, n_query, iters):
         params={"nlist": nlist, "M": M, "refine_ratio": refine})
 
 
+def _bench_ivf_sq(n_index, n_query, iters):
+    """IVF-SQ (8-bit scalar-quantized residuals): the memory/speed
+    middle ground of the ANN trio."""
+    from raft_tpu.spatial.ann import (IVFSQParams, ivf_sq_build,
+                                      ivf_sq_search)
+
+    nlist = 1024
+    return _bench_ivf(
+        n_index, n_query, iters,
+        build=lambda X: ivf_sq_build(X, IVFSQParams(nlist=nlist)),
+        search=ivf_sq_search,
+        params={"nlist": nlist, "qtype": "QT_8bit"})
+
+
 def _bench_linalg_bundle(n, iters):
     """BASELINE.md config #2: gemm + rowNorm + colReduce + transpose on
     dense f32 (linalg/gemm.cuh:46, norm.cuh:48, reduce.cuh:61,
@@ -786,6 +800,8 @@ def child_main():
              lambda: _bench_ivf_flat(100_000, 4096, 4)),
             ("ivf_pq_100k", 90,
              lambda: _bench_ivf_pq(100_000, 4096, 4)),
+            ("ivf_sq_100k", 90,
+             lambda: _bench_ivf_sq(100_000, 4096, 4)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),
