@@ -236,23 +236,35 @@ def _time_chained(step, x, iters):
     import jax.numpy as jnp
 
     @jax.jit
-    def run(x0, n):
+    def run(x0, n, eps):
         def body(_, carry):
             out = step(carry)
-            # data dependency without changing the value: adds 0.0 derived
-            # from a FULL reduction of the output, so XLA cannot
-            # slice-narrow the benchmarked op
-            return carry + jnp.sum(out) * 0.0
+            # data dependency without changing the value: adds eps * a
+            # FULL reduction of the output, so XLA cannot slice-narrow
+            # the benchmarked op.  eps is a TRACED argument (0.0 at every
+            # call site), not a literal: a 0.0 literal lets the algebraic
+            # simplifier fold the product, turn the body into identity,
+            # and delete the whole loop — observed on the TPU backend as
+            # seconds_per_call == 0 (r4).
+            return carry + jnp.sum(out) * eps
         return jax.lax.fori_loop(0, n, body, x0).ravel()[0]
 
-    float(run(x, 1))  # compile + warm
-    t0 = time.perf_counter()
-    float(run(x, iters + 1))
-    t_n = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(run(x, 1))
-    t_1 = time.perf_counter() - t0
-    return max((t_n - t_1) / iters, 1e-9)
+    float(run(x, 1, 0.0))  # compile + warm
+    while True:
+        t0 = time.perf_counter()
+        float(run(x, iters + 1, 0.0))
+        t_n = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(run(x, 1, 0.0))
+        t_1 = time.perf_counter() - t0
+        diff = t_n - t_1
+        # resolvable above host/transport jitter, or past the point of
+        # cheap retries: accept.  Otherwise quadruple the chain (no
+        # recompile: n is traced) so per-call cost integrates upward.
+        if diff > 0.25 or iters >= 4096 or _remaining() < 4 * t_n + 10:
+            break
+        iters *= 4
+    return max(diff / iters, 1e-9)
 
 
 def _rand(shape, seed):

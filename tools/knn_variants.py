@@ -1,0 +1,112 @@
+"""One-off steady-state timing of kNN path variants on the live backend.
+
+Isolates where the time goes at the 100k x 4096 x 128 k=100 shape:
+matmul-only scan (selection removed), lax.top_k vs approx_max_k
+selection, tile size sweep, the compiled Pallas kernel, and bf16 MXU
+passes.  Prints one line per variant; informs which impl the bench
+ladder should default to.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+
+def timeit(fn, *args, iters=3):
+    out = jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / iters
+    return dt, out
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+    n, nq, d, k = 100_000, 4096, 128, 100
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+
+    def scan_variant(tile_n, select, prec="highest"):
+        n_tiles = -(-n // tile_n)
+        n_pad = n_tiles * tile_n
+        x_p = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        xn = (x_p * x_p).sum(1)
+
+        @jax.jit
+        def run(qq):
+            qn = (qq * qq).sum(1)
+
+            def step(carry, t):
+                best_d, best_i = carry
+                x_t = lax.dynamic_slice_in_dim(x_p, t * tile_n, tile_n, 0)
+                xn_t = lax.dynamic_slice_in_dim(xn, t * tile_n, tile_n, 0)
+                g = lax.dot_general(qq, x_t, (((1,), (1,)), ((), ())),
+                                    precision=prec)
+                dd = qn[:, None] + xn_t[None, :] - 2.0 * g
+                valid = (t * tile_n + jnp.arange(tile_n)) < n
+                dd = jnp.where(valid[None, :], dd, jnp.inf)
+                if select == "none":
+                    return (jnp.minimum(best_d, dd[:, :k]), best_i), None
+                if select == "topk":
+                    tv, ti = lax.top_k(-dd, k)
+                elif select == "approx":
+                    tv, ti = lax.approx_max_k(-dd, k, recall_target=0.95)
+                elif select == "approx1":
+                    tv, ti = lax.approx_max_k(-dd, k, recall_target=1.0)
+                ti = (t * tile_n + ti).astype(jnp.int32)
+                cd = jnp.concatenate([best_d, -tv], axis=1)
+                ci = jnp.concatenate([best_i, ti], axis=1)
+                mv, mp = lax.top_k(-cd, k)
+                return (-mv, jnp.take_along_axis(ci, mp, axis=1)), None
+
+            init = (jnp.full((nq, k), jnp.inf, jnp.float32),
+                    jnp.zeros((nq, k), jnp.int32))
+            (bd, bi), _ = lax.scan(step, init, jnp.arange(n_tiles))
+            return bd, bi
+
+        return run
+
+    for name, fn in [
+        ("matmul_only_t8k", scan_variant(8192, "none")),
+        ("topk_t8k", scan_variant(8192, "topk")),
+        ("approx95_t8k", scan_variant(8192, "approx")),
+        ("approx100_t8k", scan_variant(8192, "approx1")),
+        ("topk_t32k", scan_variant(32768, "topk")),
+        ("approx95_t32k", scan_variant(32768, "approx")),
+        ("approx95_t100k", scan_variant(100_000, "approx")),
+        ("topk_t8k_bf16", scan_variant(8192, "topk", "default")),
+    ]:
+        try:
+            dt, _ = timeit(fn, q)
+            print(f"{name:18s} {dt*1e3:9.2f} ms/batch  {nq/dt:10,.0f} QPS",
+                  flush=True)
+        except Exception as e:
+            print(f"{name:18s} FAILED {type(e).__name__}: {str(e)[:160]}",
+                  flush=True)
+
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    for impl in ("xla", "pallas"):
+        try:
+            dt, _ = timeit(lambda qq, i=impl: fused_l2_knn(x, qq, k, impl=i),
+                           q)
+            print(f"fused_{impl:12s} {dt*1e3:9.2f} ms/batch  "
+                  f"{nq/dt:10,.0f} QPS", flush=True)
+        except Exception as e:
+            print(f"fused_{impl:12s} FAILED {type(e).__name__}: "
+                  f"{str(e)[:160]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Probe the accelerator endpoint until it answers; one timestamped line
+# per attempt.  Run detached; tail the log to see recovery.
+LOG="${1:-/root/repo/.probe_r04.log}"
+while true; do
+  T=$(date +%H:%M:%S)
+  OUT=$(timeout 45 python /root/repo/tools/tpu_probe.py 2>&1 | tail -1)
+  RC=$?
+  echo "$T rc=$RC $OUT" >> "$LOG"
+  if [ $RC -eq 0 ]; then
+    echo "$T BACKEND UP" >> "$LOG"
+  fi
+  sleep 45
+done
