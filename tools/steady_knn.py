@@ -1,0 +1,55 @@
+"""Steady-state XLA vs Pallas fused-kNN timing at the 100k shape.
+
+Writes progress lines to stdout (run with output redirected to a file;
+every line is flushed).  Shapes chosen to hit the compile cache warmed
+by tools/onchip_check.py.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-T0:7.1f}s] {msg}", flush=True)
+
+
+def main():
+    log("importing jax")
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    log(f"backend: {dev.platform} ({dev.device_kind})")
+
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    n, nq, d, k = 100_000, 1024, 128, 100
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+    jax.block_until_ready((x, q))
+    log("data ready")
+
+    for impl in ("xla", "pallas"):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fused_l2_knn(x, q, k, impl=impl))
+        log(f"{impl} compile+first: {time.perf_counter()-t0:.2f}s")
+        ts = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fused_l2_knn(x, q, k, impl=impl))
+            ts.append(time.perf_counter() - t0)
+            log(f"{impl} iter {i}: {ts[-1]*1e3:.1f} ms")
+        dt = min(ts)
+        log(f"{impl} steady: {dt*1e3:.2f} ms  {nq/dt:,.0f} QPS")
+
+
+if __name__ == "__main__":
+    main()
