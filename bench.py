@@ -830,8 +830,14 @@ def child_main():
             skipped.append(name)
             _emit("skipped", skipped)
             continue
+        t_rung = time.time()
         try:
             state[name] = _tag(fn())
+            if isinstance(state[name], dict):
+                # wall seconds the rung consumed (compile + warmup +
+                # timing chains): makes budget forensics readable from
+                # the report itself
+                state[name]["t_rung_s"] = round(time.time() - t_rung, 1)
         except Exception as e:
             state.setdefault("errors", {})[name] = \
                 traceback.format_exc()[-600:]
