@@ -485,6 +485,41 @@ def _bench_knn_bf16(n_index, n_query, iters):
     }
 
 
+def _bench_knn_recall95(n_index, n_query, iters):
+    """Informational rung: kNN with the ``approx95`` selection impl
+    (``approx_max_k`` at recall_target 0.95) — unlike ``approx``/recall
+    1.0, whose partial reduce cannot drop anything and degenerates to
+    the same sort as top_k (measured identical QPS), this genuinely
+    shrinks the PartialReduce width.  Reports measured recall so the
+    speed/accuracy trade is visible; headline rungs stay exact."""
+    import numpy as np
+
+    from raft_tpu.spatial import brute_force_knn
+
+    out = _bench_knn(n_index, n_query, iters, "xla",
+                     select_impl="approx95")
+    # recall probe traced with the same selection impl as the timing
+    # (fresh env pin + fresh trace, matching _bench_knn's mechanics)
+    index = _rand((n_index, 128), 3)
+    probe = _rand((n_query, 128), 4)[:256]
+    prev = os.environ.get("RAFT_TPU_SELECT_IMPL")
+    os.environ["RAFT_TPU_SELECT_IMPL"] = "approx95"
+    try:
+        _, i_fast = brute_force_knn([index], probe, 100)
+    finally:
+        if prev is None:
+            os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
+        else:
+            os.environ["RAFT_TPU_SELECT_IMPL"] = prev
+    _, i_ref = brute_force_knn([index], probe, 100)
+    i_fast, i_ref = np.asarray(i_fast), np.asarray(i_ref)
+    out["recall_at_k_vs_exact"] = round(float(np.mean([
+        len(set(i_fast[r]) & set(i_ref[r])) / 100
+        for r in range(i_fast.shape[0])])), 4)
+    out["note"] = "informational; headline rungs are exact"
+    return out
+
+
 def _bench_fused_nn(n, n_centroids, dim, iters):
     """Fused 1-NN (fusedL2NN analog) at the IVF coarse-assign scale:
     n points against n_centroids, the kmeans-assignment inner op."""
@@ -806,6 +841,8 @@ def child_main():
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
             ("knn_100k_bf16", 60,
              lambda: _bench_knn_bf16(100_000, 4096, 4)),
+            ("knn_100k_recall95", 60,
+             lambda: _bench_knn_recall95(100_000, 4096, 4)),
             ("fused_nn_1m", 60,
              lambda: _bench_fused_nn(1_000_000, 1024, 64, 4)),
             ("ivf_flat_100k", 90,
