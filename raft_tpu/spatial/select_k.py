@@ -44,6 +44,61 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.utils import ceildiv
+
+
+def chunked_top_k(sel: jnp.ndarray, k: int,
+                  chunk: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact per-row top-k (largest) as a merge tree of SMALL top-ks.
+
+    One wide ``lax.top_k`` over (rows, W) is a sort-shaped selection
+    whose cross-lane traffic grows with W.  This formulation splits each
+    row into ``W/chunk`` chunks, top-ks every chunk in one batched call
+    (the batch maps onto sublanes; the sort network spans only ``chunk``
+    lanes), then pairwise-merges sorted k-lists — each merge round is a
+    single batched top-k over 2k-wide rows.  Same results as
+    ``lax.top_k`` up to tie order (ties broken toward the smaller index
+    *within* the merge tree's bracket, not globally).
+
+    The reference hits the identical problem shape on GPUs and answers
+    with register-heap warp selection (knn.hpp:90 →
+    detail/warp_select_faiss.cuh); a TPU has no warps, but it DOES have
+    cheap batched small sorts — this is that answer.  Candidate for the
+    tile-scan kNN driver where selection, not the distance matmul,
+    bounds throughput (measured: the (4096, 8192) k=100 top_k costs
+    ~400x the tile's MXU time on v5e).
+    """
+    nq, w = sel.shape
+    if w <= max(2 * k, chunk):
+        return lax.top_k(sel, k)
+    c = ceildiv(w, chunk)
+    pad = c * chunk - w
+    if pad:
+        sel = jnp.pad(sel, ((0, 0), (0, pad)),
+                      constant_values=_neg_inf(sel.dtype))
+    kc = min(k, chunk)
+    x = sel.reshape(nq, c, chunk)
+    vals, idx = lax.top_k(x, kc)                    # (nq, c, kc) batched
+    idx = idx + (jnp.arange(c) * chunk)[None, :, None]
+    while c > 1:
+        if c % 2:
+            vals = jnp.pad(vals, ((0, 0), (0, 1), (0, 0)),
+                           constant_values=_neg_inf(vals.dtype))
+            idx = jnp.pad(idx, ((0, 0), (0, 1), (0, 0)))
+            c += 1
+        vals = vals.reshape(nq, c // 2, 2 * kc)
+        idx = idx.reshape(nq, c // 2, 2 * kc)
+        kc2 = min(k, 2 * kc)
+        vals, pos = lax.top_k(vals, kc2)            # (nq, c//2, kc2)
+        idx = jnp.take_along_axis(idx, pos, axis=2)
+        kc = kc2
+        c //= 2
+    return vals[:, 0, :k], idx[:, 0, :k]
+
+
+def _neg_inf(dtype):
+    return (jnp.finfo(dtype).min if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.iinfo(dtype).min)
 
 
 def top_k_rows(sel: jnp.ndarray, k: int,
@@ -52,18 +107,21 @@ def top_k_rows(sel: jnp.ndarray, k: int,
     """Raw per-row top-k (largest) with impl dispatch (module doc).
     Shared by :func:`select_k` and the tile-scan kNN driver.
 
-    ``"approx95"`` is the one deliberately APPROXIMATE mode
-    (recall_target 0.95): unlike ``"approx"``/recall 1.0 — whose
-    partial reduce cannot drop anything and degenerates to the same
-    sort as ``top_k`` (measured identical QPS on v5e) — it genuinely
-    shrinks the reduction width.  Exact-contract callers (the public
-    kNN/ANN paths) never default to it; it exists for consumers that
-    opt into recall-for-speed, and the bench reports its measured
-    recall next to its QPS."""
+    ``"chunked"`` is :func:`chunked_top_k` — exact, tie order local to
+    its merge bracket.  ``"approx95"`` is the one deliberately
+    APPROXIMATE mode (recall_target 0.95): unlike ``"approx"``/recall
+    1.0 — whose partial reduce cannot drop anything and degenerates to
+    the same sort as ``top_k`` (measured identical QPS on v5e) — it
+    genuinely shrinks the reduction width.  Exact-contract callers (the
+    public kNN/ANN paths) never default to approx95; it exists for
+    consumers that opt into recall-for-speed, and the bench reports its
+    measured recall next to its QPS."""
     if impl is None:
         impl = os.environ.get("RAFT_TPU_SELECT_IMPL", "topk")
-    expects(impl in ("topk", "approx", "approx95"),
+    expects(impl in ("topk", "approx", "approx95", "chunked"),
             "select_k: unknown impl %s", impl)
+    if impl == "chunked":
+        return chunked_top_k(sel, k)
     if impl == "approx95":
         return lax.approx_max_k(sel, k, recall_target=0.95,
                                 aggregate_to_topk=True)
