@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Gentle recovery watcher: probe the backend every 90 s (each probe is
+# allowed to finish or fail on its own; no kills mid-RPC), and the
+# moment one succeeds, run the steady-state kNN measurement.
+LOG="${1:-/root/repo/.wait_measure.log}"
+cd /root/repo
+while true; do
+  T=$(date +%H:%M:%S)
+  if python tools/tpu_probe.py >> "$LOG" 2>&1; then
+    echo "$T BACKEND UP — running steady_knn" >> "$LOG"
+    python tools/steady_knn.py > .steady_knn.log 2>&1
+    echo "$T steady_knn rc=$? done" >> "$LOG"
+    break
+  fi
+  echo "$T probe failed; sleeping" >> "$LOG"
+  sleep 90
+done
