@@ -291,7 +291,24 @@ def _rung_init():
         # interpreter startup; backend init is lazy, so re-pinning here
         # (before any device op) wins
         jax.config.update("jax_platforms", "cpu")
-    dev = jax.devices()[0]
+    while True:
+        try:
+            dev = jax.devices()[0]
+            break
+        except Exception as e:
+            # a flapping tunnel endpoint (observed r4: UNAVAILABLE for
+            # ~20-40 min, then healthy) must not kill the child while
+            # budget remains — clear the cached init failure and retry
+            if _remaining() < 120:
+                raise
+            _log_init("init_failed_retrying: %s" % str(e)[-120:])
+            time.sleep(45)
+            try:
+                from jax._src import xla_bridge as _xb
+
+                _xb._clear_backends()
+            except Exception:
+                pass
     _log_init("devices_ready")
     x = jnp.ones((128, 128), jnp.float32)
     v = float((x @ x)[0, 0])
