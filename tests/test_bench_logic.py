@@ -127,3 +127,51 @@ class TestTpuAttemptNote:
         note = bench._tpu_attempt_note(
             _FakeChild(rc=2, stderr_tail="boom"), deadline=0)
         assert note["stderr_tail"] == "boom"
+
+
+class TestInitRetry:
+    """The TPU child must survive a flapping endpoint: UNAVAILABLE at
+    t=0 with budget remaining retries instead of dying (r4 observed the
+    endpoint down for ~25 min then healthy within one budget)."""
+
+    def test_retries_until_devices_answer(self, monkeypatch):
+        import time as _time
+
+        import jax
+
+        calls = {"n": 0}
+        real_devices = jax.devices
+
+        def flaky_devices():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("UNAVAILABLE: backend setup error")
+            return real_devices()
+
+        monkeypatch.setattr(jax, "devices", flaky_devices)
+        monkeypatch.setattr(_time, "sleep", lambda s: None)
+        monkeypatch.setenv("RAFT_TPU_BENCH_DEADLINE",
+                           repr(_time.time() + 600))
+        monkeypatch.setenv("RAFT_TPU_BENCH_CPU", "1")
+        out = bench._rung_init()
+        # two failures + the successful third call (later init steps may
+        # consult jax.devices again)
+        assert calls["n"] >= 3
+        assert out["platform"] == "cpu"
+
+    def test_gives_up_near_deadline(self, monkeypatch):
+        import time as _time
+
+        import jax
+
+        def dead_devices():
+            raise RuntimeError("UNAVAILABLE: backend setup error")
+
+        monkeypatch.setattr(jax, "devices", dead_devices)
+        monkeypatch.setenv("RAFT_TPU_BENCH_DEADLINE",
+                           repr(_time.time() + 60))  # < 120 s margin
+        monkeypatch.setenv("RAFT_TPU_BENCH_CPU", "1")
+        import pytest
+
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            bench._rung_init()
