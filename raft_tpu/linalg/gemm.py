@@ -27,11 +27,18 @@ def gemm(
     beta: float = 0.0,
     c: Optional[jnp.ndarray] = None,
     preferred_element_type=None,
+    precision: str = "highest",
 ) -> jnp.ndarray:
     """``alpha * op(a) @ op(b) + beta * c`` (reference gemm.cuh:73).
 
     ``preferred_element_type`` controls MXU accumulation dtype (e.g. keep
-    float32 accumulation for bfloat16 inputs).
+    float32 accumulation for bfloat16 inputs).  ``precision`` is the MXU
+    pass mode: ``"highest"`` (default) keeps f32-faithful math, matching
+    cuBLAS SGEMM's default contract — on TPU the XLA *default* for f32
+    operands is single-pass bf16 (the TF32-math-mode analog, which
+    cuBLAS requires an explicit opt-IN for), so faithfulness must be the
+    default and speed the opt-out (``precision="default"`` ≈ 2-3x
+    faster; the bench's linalg rung reports both).
     """
     opa = a.T if trans_a else a
     opb = b.T if trans_b else b
@@ -41,7 +48,8 @@ def gemm(
         opa.shape[-1],
         opb.shape[-2 if opb.ndim > 1 else 0],
     )
-    out = jnp.matmul(opa, opb, preferred_element_type=preferred_element_type)
+    out = jnp.matmul(opa, opb, preferred_element_type=preferred_element_type,
+                     precision=precision)
     if alpha != 1.0:
         out = alpha * out
     if beta != 0.0:
@@ -58,8 +66,10 @@ def gemv(
     alpha: float = 1.0,
     beta: float = 0.0,
     y: Optional[jnp.ndarray] = None,
+    precision: str = "highest",
 ) -> jnp.ndarray:
-    """``alpha * op(a) @ x + beta * y`` (reference gemv.h:29-164)."""
+    """``alpha * op(a) @ x + beta * y`` (reference gemv.h:29-164).
+    ``precision``: see :func:`gemm` (same faithful-by-default rule)."""
     opa = a.T if trans_a else a
     expects(
         opa.shape[-1] == x.shape[0],
@@ -67,7 +77,7 @@ def gemv(
         opa.shape[-1],
         x.shape[0],
     )
-    out = opa @ x
+    out = jnp.matmul(opa, x, precision=precision)
     if alpha != 1.0:
         out = alpha * out
     if beta != 0.0:
