@@ -109,6 +109,16 @@ def _lanczos(
     tol: float,
     seed: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    # Krylov orthogonality is what convergence rests on: every matmul in
+    # the solver (projections, re-orthogonalization, Ritz rotation, and
+    # a matrix-operand mv) must run f32-faithful.  XLA's TPU default for
+    # f32 matmuls is single-pass bf16 — enough orthogonality loss to
+    # stall restarts — so pin the whole solver body.
+    with jax.default_matmul_precision("highest"):
+        return _lanczos_impl(a, n, k, which, ncv, max_restarts, tol, seed)
+
+
+def _lanczos_impl(a, n, k, which, ncv, max_restarts, tol, seed):
     mv = _as_mv(a)
     expects(0 < k < n, "lanczos: need 0 < k < n (k=%d, n=%d)", k, n)
     m = min(max(ncv, 2 * k + 1), n)
