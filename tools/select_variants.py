@@ -1,0 +1,95 @@
+"""Steady-state timing: top_k vs chunked merge-tree selection inside
+the XLA tile-scan kNN at the 100k shape, on the live backend.
+
+Decides whether ``chunked`` should be the TPU default for wide
+selection.  Output: one line per impl (flushed).
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-T0:7.1f}s] {msg}", flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    log(f"backend: {dev.platform} ({dev.device_kind})")
+
+    from raft_tpu.spatial.select_k import chunked_top_k
+    from raft_tpu.spatial.tiled_knn import tiled_knn
+
+    n, nq, d, k = 100_000, 1024, 128, 100
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+    jax.block_until_ready((x, q))
+    log("data ready")
+
+    # standalone selection cost at the tile shape, isolated from the
+    # scan: one (nq, 8192) top-k per impl
+    sel = jax.random.normal(jax.random.PRNGKey(2), (4096, 8192),
+                            jnp.float32)
+    jax.block_until_ready(sel)
+    from jax import lax
+
+    for name, fn in [("lax.top_k", lambda s: lax.top_k(s, k)[0]),
+                     ("chunked", lambda s: chunked_top_k(s, k)[0]),
+                     ("approx95",
+                      lambda s: lax.approx_max_k(s, k, recall_target=0.95)[0])]:
+        f = jax.jit(fn)
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(sel))
+        log(f"select {name}: compile+first {time.perf_counter()-t0:.2f}s")
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(sel))
+            ts.append(time.perf_counter() - t0)
+        log(f"select {name}: steady {min(ts)*1e3:.2f} ms over (4096, 8192)")
+
+    # end-to-end scan path per select impl
+    def dist(qq, x_t):
+        qn = (qq * qq).sum(1)
+        xn = (x_t * x_t).sum(1)
+        g = jnp.matmul(qq, x_t.T, precision="highest")
+        return qn[:, None] + xn[None, :] - 2.0 * g
+
+    for impl in ("topk", "chunked"):
+        os.environ["RAFT_TPU_SELECT_IMPL"] = impl
+        f = jax.jit(lambda qq: tiled_knn(x, qq, k, dist)[0])
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(q))
+        log(f"scan {impl}: compile+first {time.perf_counter()-t0:.2f}s")
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(q))
+            ts.append(time.perf_counter() - t0)
+        dt = min(ts)
+        log(f"scan {impl}: steady {dt*1e3:.2f} ms  {nq/dt:,.0f} QPS")
+    os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
+
+    # sanity: identical values
+    os.environ["RAFT_TPU_SELECT_IMPL"] = "chunked"
+    d_c, _ = tiled_knn(x, q[:64], k, dist)
+    os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
+    d_t, _ = tiled_knn(x, q[:64], k, dist)
+    ok = bool(np.allclose(np.asarray(d_c), np.asarray(d_t), atol=1e-3))
+    log(f"values match: {ok}")
+
+
+if __name__ == "__main__":
+    main()
