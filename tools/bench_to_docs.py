@@ -1,0 +1,65 @@
+"""Render a bench JSON report as markdown table rows for BASELINE.md /
+README.  Reads the report path given as argv[1] (default
+.bench_r04_final.json) and prints the rows; doc edits stay a human
+decision.
+"""
+
+import json
+import sys
+
+
+def fmt_rung(name, r):
+    if not isinstance(r, dict):
+        return None
+    dev = r.get("device", "?")
+    mfu = r.get("mfu") or {}
+    mfu_s = ""
+    if mfu.get("mfu") is not None:
+        mfu_s = f", mfu {mfu['mfu']:.3f}" if isinstance(
+            mfu.get("mfu"), float) else ""
+    if "qps" in r:
+        extra = ""
+        if "recall_at_k_vs_exact" in r:
+            extra = f", recall {r['recall_at_k_vs_exact']}"
+        if "recall_at_10_vs_exact" in r:
+            extra = f", recall@10 {r['recall_at_10_vs_exact']}"
+        return (f"| {name} | {r['qps']:,.0f} QPS"
+                f" ({r.get('seconds_per_batch', '?')} s/batch{extra}{mfu_s})"
+                f" | {dev} |")
+    if "gpairs_per_sec" in r:
+        return (f"| {name} | {r['gpairs_per_sec']} Gpairs/s"
+                f" ({r.get('metric', '')}{mfu_s}) | {dev} |")
+    if "gemm_tflops" in r:
+        return f"| {name} | {r['gemm_tflops']} TFLOP/s{mfu_s} | {dev} |"
+    if "seconds_incl_compile" in r:
+        return (f"| {name} | {r['seconds_incl_compile']} s incl compile"
+                f" | {dev} |")
+    if "seconds" in r:
+        return f"| {name} | {r['seconds']} s steady | {dev} |"
+    return None
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else ".bench_r04_final.json"
+    rep = json.load(open(path))
+    print(f"headline: {rep['metric']} = {rep['value']} {rep['unit']}"
+          f" (vs_baseline {rep['vs_baseline']})\n")
+    print("| rung | result | device |\n|---|---|---|")
+    det = rep.get("detail", {})
+    for name, r in det.items():
+        if name in ("init_log", "cpu_fallback", "errors", "skipped",
+                    "fallback"):
+            continue
+        row = fmt_rung(name, r)
+        if row:
+            print(row)
+    if "cpu_fallback" in det:
+        print("\nCPU fallback child:")
+        for name, r in det["cpu_fallback"].items():
+            row = fmt_rung(name, r)
+            if row:
+                print(row)
+
+
+if __name__ == "__main__":
+    main()
