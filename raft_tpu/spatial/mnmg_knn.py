@@ -21,7 +21,13 @@ a ``jax.sharding.Mesh`` axis:
 - candidates ride ICI via ``all_gather`` along the axis and are
   re-selected to the global top-k (the ``knn_merge_parts`` heap-merge
   becomes one wide re-selection) — so the merge compiles to a single
-  XLA collective instead of eager NCCL calls.
+  XLA collective instead of eager NCCL calls;
+- ``merge="ring"`` instead streams candidate blocks around the axis
+  with ``ppermute`` and keeps a running top-k: peak merge memory is
+  (nq, 2k) regardless of axis size (vs (nq, size*k) for the allgather),
+  the same total ICI traffic — the distance-matrix instance of the ring
+  pattern (SURVEY §5), and the closest TPU shape to the reference's
+  streaming heap-merge (knn_merge_parts, knn_brute_force_faiss.cuh:55).
 
 The communicator is resolved from (in order) an explicit ``comms``, the
 ``handle``'s injected comms (reference ``handle.get_comms()`` idiom),
@@ -87,6 +93,7 @@ def mnmg_knn(
     query_axis: Optional[str] = None,
     tile_n: int = 8192,
     precision: str = "highest",
+    merge: str = "allgather",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN with the index row-sharded across a mesh axis.
 
@@ -108,6 +115,11 @@ def mnmg_knn(
     precision:
         MXU matmul precision for the local searches ("highest" default;
         "default" = single-pass bf16, see ``brute_force_knn``).
+    merge:
+        "allgather" (default): one wide collective + one re-selection.
+        "ring": ppermute candidate blocks around the axis with a running
+        top-k — (nq, 2k) peak merge memory regardless of axis size
+        (module doc).  Identical results up to distance-tie order.
 
     Returns
     -------
@@ -141,6 +153,9 @@ def mnmg_knn(
     # candidates survive the post-search mask
     k_local = min(k + (n_pad - n), rows)
 
+    expects(merge in ("allgather", "ring"),
+            "mnmg_knn: unknown merge %s", merge)
+
     def shard_fn(ix, q):
         # local partition search (reference per-partition stream search)
         d_loc, i_loc = _search_one_partition(ix, q, k_local, metric,
@@ -151,6 +166,33 @@ def mnmg_knn(
         invalid = gid >= n
         d_loc = jnp.where(invalid, worst, d_loc)
         gid = jnp.where(invalid, -1, gid)
+        if merge == "ring":
+            # narrow the masked local candidates to k (every global
+            # top-k member on this shard survives its local top-k), then
+            # stream blocks around the ring with a running re-selection
+            blk_d, blk_i = select_k(d_loc, min(k, k_local),
+                                    select_min=select_min, values=gid)
+            best_d, best_i = blk_d, blk_i
+            perm = [(i, (i + 1) % size) for i in range(size)]
+
+            def body(_, carry):
+                bd, bi, rd, ri = carry
+                rd = lax.ppermute(rd, axis_, perm)
+                ri = lax.ppermute(ri, axis_, perm)
+                cd = jnp.concatenate([bd, rd], axis=1)
+                ci = jnp.concatenate([bi, ri], axis=1)
+                nd, ni = select_k(cd, k, select_min=select_min, values=ci)
+                return nd, ni, rd, ri
+
+            if blk_d.shape[1] < k:  # tiny shards: pad the running block
+                pad = k - blk_d.shape[1]
+                best_d = jnp.pad(blk_d, ((0, 0), (0, pad)),
+                                 constant_values=worst)
+                best_i = jnp.pad(blk_i, ((0, 0), (0, pad)),
+                                 constant_values=-1)
+            best_d, best_i, _, _ = lax.fori_loop(
+                0, size - 1, body, (best_d, best_i, blk_d, blk_i))
+            return best_d, best_i
         # merge across the axis: allgather candidates, one re-selection
         all_d = lax.all_gather(d_loc, axis_, axis=1, tiled=True)
         all_i = lax.all_gather(gid, axis_, axis=1, tiled=True)

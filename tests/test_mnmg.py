@@ -76,3 +76,39 @@ def test_mnmg_k_exceeds_shard_rows(rng):
     d_got, i_got = mnmg_knn(index, queries, 9)  # shards hold 5 rows each
     d_ref, i_ref = brute_force_knn([index], queries, 9)
     np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+
+
+def test_mnmg_ring_merge_matches_allgather(data):
+    """merge='ring' (ppermute running top-k) == merge='allgather' ==
+    single device, at a ragged shard size."""
+    index, queries = data
+    d_ref, i_ref = brute_force_knn([index], queries, 10)
+    d_ring, i_ring = mnmg_knn(index, queries, 10, merge="ring")
+    np.testing.assert_allclose(np.asarray(d_ring), np.asarray(d_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i_ring), np.asarray(i_ref))
+
+
+def test_mnmg_ring_k_exceeds_shard_rows(rng):
+    """Ring merge when k > rows-per-shard (running block narrower than
+    k must pad, not truncate)."""
+    index = jnp.asarray(rng.standard_normal((19, 8)).astype(np.float32))
+    queries = jnp.asarray(rng.standard_normal((7, 8)).astype(np.float32))
+    d_ref, i_ref = brute_force_knn([index], queries, 5)
+    d_ring, i_ring = mnmg_knn(index, queries, 5, merge="ring")
+    np.testing.assert_allclose(np.asarray(d_ring), np.asarray(d_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i_ring), np.asarray(i_ref))
+
+
+def test_mnmg_ring_2d_mesh(data):
+    """Ring merge composes with query sharding on a 2-D mesh."""
+    index, queries = data
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("qx", "ix"))
+    d_ref, i_ref = brute_force_knn([index], queries, 10)
+    d_got, i_got = mnmg_knn(index, queries, 10, mesh=mesh, axis="ix",
+                            query_axis="qx", merge="ring")
+    np.testing.assert_allclose(np.asarray(d_got), np.asarray(d_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
