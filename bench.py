@@ -515,19 +515,25 @@ def _bench_knn_recall95(n_index, n_query, iters):
 
     out = _bench_knn(n_index, n_query, iters, "xla",
                      select_impl="approx95")
-    # recall probe traced with the same selection impl as the timing
-    # (fresh env pin + fresh trace, matching _bench_knn's mechanics)
+    # recall probe traced with the same impls as the timing: BOTH env
+    # pins — on TPU the fused-kNN auto-dispatch otherwise resolves to
+    # the Pallas kernel, which never consults the select impl, and the
+    # probe would measure the exact kernel against itself (recall ~1.0
+    # regardless — r4 code-review finding)
     index = _rand((n_index, 128), 3)
     probe = _rand((n_query, 128), 4)[:256]
-    prev = os.environ.get("RAFT_TPU_SELECT_IMPL")
+    prev = {v: os.environ.get(v) for v in
+            ("RAFT_TPU_FUSED_KNN_IMPL", "RAFT_TPU_SELECT_IMPL")}
+    os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = "xla"
     os.environ["RAFT_TPU_SELECT_IMPL"] = "approx95"
     try:
         _, i_fast = brute_force_knn([index], probe, 100)
     finally:
-        if prev is None:
-            os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
-        else:
-            os.environ["RAFT_TPU_SELECT_IMPL"] = prev
+        for var, val in prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
     _, i_ref = brute_force_knn([index], probe, 100)
     i_fast, i_ref = np.asarray(i_fast), np.asarray(i_ref)
     out["recall_at_k_vs_exact"] = round(float(np.mean([

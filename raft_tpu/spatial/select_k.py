@@ -74,8 +74,11 @@ def chunked_top_k(sel: jnp.ndarray, k: int,
     c = ceildiv(w, chunk)
     pad = c * chunk - w
     if pad:
+        # pads must NEVER outrank a genuine entry: -inf (not finfo.min,
+        # which BEATS genuine -inf keys) for floats; ints get their min
+        # and rely on the final clamp
         sel = jnp.pad(sel, ((0, 0), (0, pad)),
-                      constant_values=_neg_inf(sel.dtype))
+                      constant_values=_pad_sentinel(sel.dtype))
     kc = min(k, chunk)
     x = sel.reshape(nq, c, chunk)
     vals, idx = lax.top_k(x, kc)                    # (nq, c, kc) batched
@@ -83,7 +86,7 @@ def chunked_top_k(sel: jnp.ndarray, k: int,
     while c > 1:
         if c % 2:
             vals = jnp.pad(vals, ((0, 0), (0, 1), (0, 0)),
-                           constant_values=_neg_inf(vals.dtype))
+                           constant_values=_pad_sentinel(vals.dtype))
             idx = jnp.pad(idx, ((0, 0), (0, 1), (0, 0)))
             c += 1
         vals = vals.reshape(nq, c // 2, 2 * kc)
@@ -93,11 +96,15 @@ def chunked_top_k(sel: jnp.ndarray, k: int,
         idx = jnp.take_along_axis(idx, pos, axis=2)
         kc = kc2
         c //= 2
-    return vals[:, 0, :k], idx[:, 0, :k]
+    # pads can only surface when a row has fewer than k entries above
+    # the sentinel (all-(-inf) tails); clamp keeps such deficit slots
+    # in-range (arbitrary id, sentinel value) instead of fabricating
+    # out-of-range ids that a payload gather would silently clamp
+    return vals[:, 0, :k], jnp.minimum(idx[:, 0, :k], w - 1)
 
 
-def _neg_inf(dtype):
-    return (jnp.finfo(dtype).min if jnp.issubdtype(dtype, jnp.floating)
+def _pad_sentinel(dtype):
+    return (-jnp.inf if jnp.issubdtype(dtype, jnp.floating)
             else jnp.iinfo(dtype).min)
 
 

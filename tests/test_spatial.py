@@ -382,6 +382,27 @@ class TestSelectKImpl:
         got = np.take_along_axis(np.asarray(keys), np.asarray(i_c), 1)
         np.testing.assert_allclose(got, np.asarray(d_c), atol=1e-6)
 
+    def test_chunked_masked_rows_match_topk(self):
+        """Rows where most keys are +inf (the standard invalid-distance
+        sentinel, -inf after negation): pad columns must not outrank
+        genuine entries, values must equal lax.top_k, and indices stay
+        in range (code-review r4 finding)."""
+        rng = np.random.default_rng(3)
+        keys = np.full((4, 1000), np.inf, np.float32)
+        keys[:, :60] = rng.standard_normal((4, 60))  # < k finite entries
+        keys = jnp.asarray(keys)
+        from raft_tpu.spatial.select_k import select_k
+
+        d_c, i_c = select_k(keys, 100, select_min=True, impl="chunked")
+        d_t, _ = select_k(keys, 100, select_min=True, impl="topk")
+        np.testing.assert_allclose(np.asarray(d_c), np.asarray(d_t),
+                                   atol=1e-6)
+        i_c = np.asarray(i_c)
+        assert i_c.min() >= 0 and i_c.max() < 1000
+        # the 60 finite entries are selected with correct indices
+        got = np.take_along_axis(np.asarray(keys), i_c[:, :60], 1)
+        np.testing.assert_allclose(got, np.asarray(d_c)[:, :60], atol=1e-6)
+
     def test_chunked_duplicate_keys(self):
         """All-equal keys: every returned index must be in range and
         distinct (ties resolve to k different columns)."""

@@ -4,10 +4,11 @@
 LOG="${1:-/root/repo/.probe_r04.log}"
 while true; do
   T=$(date +%H:%M:%S)
-  OUT=$(timeout 45 python /root/repo/tools/tpu_probe.py 2>&1 | tail -1)
-  RC=$?
+  OUT=$(timeout 45 python /root/repo/tools/tpu_probe.py 2>&1)
+  RC=$?   # the probe's status, not a pipeline tail's
+  OUT=$(printf '%s\n' "$OUT" | tail -1)
   echo "$T rc=$RC $OUT" >> "$LOG"
-  if [ $RC -eq 0 ]; then
+  if [ "$RC" -eq 0 ]; then
     echo "$T BACKEND UP" >> "$LOG"
   fi
   sleep 45
