@@ -403,6 +403,18 @@ class TestSelectKImpl:
         got = np.take_along_axis(np.asarray(keys), i_c[:, :60], 1)
         np.testing.assert_allclose(got, np.asarray(d_c)[:, :60], atol=1e-6)
 
+    def test_chunked_int_keys(self):
+        """Integer keys (e.g. vote counts) through the merge tree."""
+        rng = np.random.default_rng(4)
+        keys = jnp.asarray(rng.integers(-1000, 1000, (8, 2048)), jnp.int32)
+        from raft_tpu.spatial.select_k import select_k
+
+        d_c, i_c = select_k(keys, 50, select_min=False, impl="chunked")
+        d_t, _ = select_k(keys, 50, select_min=False, impl="topk")
+        np.testing.assert_array_equal(np.asarray(d_c), np.asarray(d_t))
+        got = np.take_along_axis(np.asarray(keys), np.asarray(i_c), 1)
+        np.testing.assert_array_equal(got, np.asarray(d_c))
+
     def test_chunked_duplicate_keys(self):
         """All-equal keys: every returned index must be in range and
         distinct (ties resolve to k different columns)."""
