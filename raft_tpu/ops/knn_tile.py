@@ -38,6 +38,12 @@ vector compares and lane permutations:
 The running buffer is kept sorted ascending at all times, so the output
 needs no final sort.  Distances returned are squared L2 (the sqrt fixup
 is the caller's postprocess, knn_brute_force_faiss.cuh:367-380).
+
+Hardware validation: 23/23 compiled-path checks green on TPU v5e
+(ONCHIP_r04.md run 3) — k in {8,64,100,128} plus the k>128 XLA
+auto-dispatch, ragged shapes, d=384 cross-k-tile accumulation, and
+the 100k x 1024 k=100 timing shape, distances rtol 1e-5 vs the XLA
+path with every index mismatch a recomputed-distance tie.
 """
 
 from __future__ import annotations

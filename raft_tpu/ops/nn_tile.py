@@ -28,6 +28,10 @@ The (m, n) distance matrix never exists anywhere, and unlike the XLA
 scan path the (bm, bn) tile never round-trips HBM.  Serves the default
 min-reduce contract only; custom reduce ops / masks / f64 stay on the
 XLA scan (:mod:`raft_tpu.distance.fused_l2_nn`).
+
+Hardware validation: aligned, ragged, and 1024x100k configs green
+compiled on TPU v5e (ONCHIP_r04.md run 3); at the IVF coarse-assign
+shape the compiled kernel ran ~4x faster than the XLA scan.
 """
 
 from __future__ import annotations

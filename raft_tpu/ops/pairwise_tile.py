@@ -19,6 +19,10 @@ BlockSpecs, playing the role of the reference's ldgXY/stsXY page-flipping
 Zero-padding is used for edge tiles; every supported combine maps
 (0, 0) -> 0 contribution (guarded Canberra/JS included) so padded k is
 harmless, and padded rows/cols are sliced away by the wrapper.
+
+Hardware validation: all seven unexpanded metrics green compiled on
+TPU v5e vs host-f64 numpy (ONCHIP_r04.md run 3) at aligned, ragged
+(193x257x77), and cross-k-tile (d=300) shapes; max abs diff 6.3e-5.
 """
 
 from __future__ import annotations
