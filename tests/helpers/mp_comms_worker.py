@@ -66,17 +66,21 @@ def _mnmg_knn_cross_process():
     repl = NamedSharding(mesh, P(None, None))
     ix = jax.device_put(jnp.asarray(index), repl)
     q = jax.device_put(jnp.asarray(queries), repl)
-    d_got, i_got = mnmg_knn(ix, q, k, mesh=mesh, axis=mesh.axis_names[0])
-    d_got, i_got = np.asarray(d_got), np.asarray(i_got)
-
     sq = ((queries[:, None, :] - index[None, :, :]) ** 2).sum(-1)
     order = np.argsort(sq, axis=1, kind="stable")[:, :k]
     d_ref = np.take_along_axis(sq, order, axis=1)
-    np.testing.assert_allclose(d_got, d_ref, rtol=1e-4, atol=1e-4)
-    # ids must agree except where the k-th boundary distance ties
-    mism = i_got != order
-    assert np.allclose(d_got[mism], d_ref[mism], rtol=1e-4, atol=1e-4), (
-        i_got, order)
+    # both merge modes must cross the process boundary: allgather is the
+    # default collective; ring sends ppermute hops over the same wire
+    for merge in ("allgather", "ring"):
+        d_got, i_got = mnmg_knn(ix, q, k, mesh=mesh,
+                                axis=mesh.axis_names[0], merge=merge)
+        d_got, i_got = np.asarray(d_got), np.asarray(i_got)
+        np.testing.assert_allclose(d_got, d_ref, rtol=1e-4, atol=1e-4,
+                                   err_msg=merge)
+        # ids must agree except where the k-th boundary distance ties
+        mism = i_got != order
+        assert np.allclose(d_got[mism], d_ref[mism],
+                           rtol=1e-4, atol=1e-4), (merge, i_got, order)
     return True
 
 
