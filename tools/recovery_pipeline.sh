@@ -17,7 +17,13 @@ echo "=== select_variants ===" >> "$LOG"
 python tools/select_variants.py > .select_variants.log 2>&1
 echo "select_variants rc=$? at $(date +%H:%M:%S)" >> "$LOG"
 echo "=== full bench (warm cache for the driver) ===" >> "$LOG"
-RAFT_TPU_BENCH_BUDGET=2700 python bench.py > .bench_r04_final.json \
+# never collide with the driver's own round-end bench: full budget only
+# while the session has comfortable headroom (driver takes over ~02:49);
+# late recovery gets a short warm-the-top-rungs run instead
+HOUR=$(date +%H)
+BUDGET=2700
+if [ "$HOUR" -ge 1 ] && [ "$HOUR" -lt 12 ]; then BUDGET=600; fi
+RAFT_TPU_BENCH_BUDGET=$BUDGET python bench.py > .bench_r04_final.json \
   2> .bench_r04_final.err
-echo "bench rc=$? at $(date +%H:%M:%S)" >> "$LOG"
+echo "bench (budget $BUDGET) rc=$? at $(date +%H:%M:%S)" >> "$LOG"
 echo "=== pipeline done ===" >> "$LOG"
