@@ -24,12 +24,19 @@ vector compares and lane permutations:
 - each while-loop round extracts at most one candidate per lane group
   via a strided group-min (a (bm, g, kpad) reshape keeps kpad on the
   128-lane axis), merges the kpad candidates into the sorted running
-  top-k with a bitonic sort over 2*kpad lanes, masks the extracted
-  elements, and re-checks the gate.  Each group loses one element per
-  round, so the loop is bounded by g rounds; expected rounds after
-  warm-up ~0.  Exactness: the loop only exits when no remaining
-  distance beats the k-th best, so the final buffer is exactly the
-  top-kpad set.
+  top-k, masks the extracted elements, and re-checks the gate.  Each
+  group loses one element per round, so the loop is bounded by g
+  rounds; expected rounds after warm-up ~0.  Exactness: the loop only
+  exits when no remaining distance beats the k-th best, so the final
+  buffer is exactly the top-kpad set.
+- the merge exploits the running buffer's sorted invariant: sort the
+  kpad candidates descending at the NATIVE kpad lane width, then a
+  single log2(2*kpad)-stage bitonic-merge tail at the wide width —
+  ~4x fewer wide compare-exchange stages than full-sorting the 2*kpad
+  concatenation (the r4 steady-state suspect: cross-vreg lane rolls at
+  2*kpad > 128 lanes are the kernel's priciest vector op).  Env
+  ``RAFT_TPU_KNN_TILE_MERGE=fullsort`` restores the old network for
+  on-chip A/B.
 - the bitonic compare-exchange is lane-parallel: partner values are
   obtained with two circular lane rolls and an XOR-bit select, payload
   indices ride along with strict-inequality "take partner" predicates
@@ -49,6 +56,7 @@ path with every index mismatch a recomputed-distance tie.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -101,47 +109,83 @@ def _roll_lanes(x: jnp.ndarray, shift: int, interpret: bool) -> jnp.ndarray:
     return pltpu.roll(x, jnp.int32(shift % x.shape[1]), axis=1)
 
 
+def _ce_stage(keys: jnp.ndarray, vals: jnp.ndarray, lane: jnp.ndarray,
+              stride: int, asc_mask: jnp.ndarray,
+              interpret: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One bitonic compare-exchange stage over the lane axis.
+
+    Partner lane = lane XOR stride (fetched as two circular rolls + a
+    bit select); rows/lanes where ``asc_mask`` holds keep the min in the
+    lower lane of the pair (ascending direction), the rest the max.
+    """
+    fwd_k = _roll_lanes(keys, -stride, interpret)
+    bwd_k = _roll_lanes(keys, stride, interpret)
+    fwd_v = _roll_lanes(vals, -stride, interpret)
+    bwd_v = _roll_lanes(vals, stride, interpret)
+    upper = (lane & stride) != 0              # partner is lane - stride
+    pk = jnp.where(upper, bwd_k, fwd_k)
+    pv = jnp.where(upper, bwd_v, fwd_v)
+    want_min = asc_mask != upper
+    # mask logical ops, NOT jnp.where(bool, bool, bool): a select
+    # producing an i1 vector makes Mosaic truncate i8→i1, which the
+    # real backend rejects ("Unsupported target bitwidth for
+    # truncation") even though lowering and interpret both pass
+    take = (want_min & (pk < keys)) | (~want_min & (pk > keys))
+    keys = jnp.where(want_min, jnp.minimum(keys, pk),
+                     jnp.maximum(keys, pk))
+    vals = jnp.where(take, pv, vals)
+    return keys, vals
+
+
 def _bitonic_sort_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
-                        interpret: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Sort each row ascending by key, carrying an int payload.
+                        interpret: bool, descending: bool = False
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sort each row by key, carrying an int payload.
 
     Classic bitonic network over the lane axis (width W = power of two).
     Stage (size, stride): partner lane = lane XOR stride; ascending
-    blocks where (lane & size) == 0.  Partner fetch = two lane rolls +
-    bit select; O(log^2 W) full-width VPU stages, no scalar loops.
+    blocks where (lane & size) == 0 (inverted for ``descending``).
+    O(log^2 W) full-width VPU stages, no scalar loops.
     """
     bm, w = keys.shape
     assert w & (w - 1) == 0, f"bitonic width {w} not a power of two"
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
     size = 2
     while size <= w:
+        asc = (lane & size) == 0
+        if descending:
+            asc = ~asc
         stride = size // 2
         while stride >= 1:
-            fwd_k = _roll_lanes(keys, -stride, interpret)
-            bwd_k = _roll_lanes(keys, stride, interpret)
-            fwd_v = _roll_lanes(vals, -stride, interpret)
-            bwd_v = _roll_lanes(vals, stride, interpret)
-            upper = (lane & stride) != 0          # partner is lane - stride
-            pk = jnp.where(upper, bwd_k, fwd_k)
-            pv = jnp.where(upper, bwd_v, fwd_v)
-            # ascending block → lower lane keeps the min
-            want_min = ((lane & size) == 0) != upper
-            # mask logical ops, NOT jnp.where(bool, bool, bool): a select
-            # producing an i1 vector makes Mosaic truncate i8→i1, which
-            # the real backend rejects ("Unsupported target bitwidth for
-            # truncation") even though lowering and interpret both pass
-            take = (want_min & (pk < keys)) | (~want_min & (pk > keys))
-            keys = jnp.where(want_min, jnp.minimum(keys, pk),
-                             jnp.maximum(keys, pk))
-            vals = jnp.where(take, pv, vals)
+            keys, vals = _ce_stage(keys, vals, lane, stride, asc,
+                                   interpret)
             stride //= 2
         size *= 2
     return keys, vals
 
 
+def _bitonic_merge_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
+                         interpret: bool
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge one BITONIC row (first half ascending, second half
+    descending) into ascending order: the log2(W)-stage tail of the
+    bitonic network, without the log^2 sorting prefix.  This is the
+    cheap half of the classic sorted-list merge: W/2-wide sorted lists
+    A asc ++ B desc form a bitonic sequence by construction."""
+    bm, w = keys.shape
+    assert w & (w - 1) == 0, f"bitonic width {w} not a power of two"
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    asc = jnp.ones_like(lane, dtype=bool)
+    stride = w // 2
+    while stride >= 1:
+        keys, vals = _ce_stage(keys, vals, lane, stride, asc, interpret)
+        stride //= 2
+    return keys, vals
+
+
 def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
                 bd_ref, bi_ref, *, kpad, bn, n_index, n_j_tiles, g,
-                precision, interpret):
+                precision, interpret, merge_impl):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -189,10 +233,23 @@ def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
         # lowest-gg argmin)
         picked = gg_iota == jnp.expand_dims(gg_star, 1)
         d = jnp.where(picked, inf32, d3).reshape(bm, g * kpad)
-        # merge candidates into the sorted running top-k
-        md = jnp.concatenate([bd, gmin], axis=1)          # (bm, 2*kpad)
-        mi = jnp.concatenate([bi, cand_i], axis=1)
-        md, mi = _bitonic_sort_lanes(md, mi, interpret)
+        # merge candidates into the running top-k.  bd is sorted
+        # ascending at all times (init is all-inf; every merge below
+        # returns a sorted prefix), so the default path sorts only the
+        # kpad candidates — at the NATIVE kpad lane width — descending,
+        # and then needs just the log2(2*kpad)-stage bitonic-merge tail
+        # at the wide width: ~4x fewer wide compare-exchange stages
+        # than full-sorting the 2*kpad concatenation each round.
+        if merge_impl == "fullsort":
+            md = jnp.concatenate([bd, gmin], axis=1)      # (bm, 2*kpad)
+            mi = jnp.concatenate([bi, cand_i], axis=1)
+            md, mi = _bitonic_sort_lanes(md, mi, interpret)
+        else:
+            gs, cs = _bitonic_sort_lanes(gmin, cand_i, interpret,
+                                         descending=True)
+            md = jnp.concatenate([bd, gs], axis=1)        # bitonic row
+            mi = jnp.concatenate([bi, cs], axis=1)
+            md, mi = _bitonic_merge_lanes(md, mi, interpret)
         return d, md[:, :kpad], mi[:, :kpad]
 
     _, bd, bi = jax.lax.while_loop(
@@ -214,6 +271,7 @@ def fused_knn_tile(
     block_n: int = 1024,
     precision: str = "highest",
     interpret: Optional[bool] = None,
+    merge_impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest index rows per query under squared L2, fused on-chip.
 
@@ -229,6 +287,10 @@ def fused_knn_tile(
     expects(0 < k <= n, "fused_knn_tile: k=%d out of range for n=%d", k, n)
     if interpret is None:
         interpret = not is_tpu_backend()
+    if merge_impl is None:
+        merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
+    expects(merge_impl in ("merge", "fullsort"),
+            "fused_knn_tile: unknown merge_impl %s", merge_impl)
 
     # next power of two >= max(k, 128): the bitonic merge width 2*kpad
     # must be a power of two, and kpad must stay a lane multiple
@@ -247,7 +309,7 @@ def fused_knn_tile(
     kern = functools.partial(
         _knn_kernel, kpad=kpad, bn=bn, n_index=n, n_j_tiles=grid[1], g=g,
         precision=jax.lax.Precision(precision) if precision else None,
-        interpret=interpret)
+        interpret=interpret, merge_impl=merge_impl)
     out_d, out_i = pl.pallas_call(
         kern,
         grid=grid,

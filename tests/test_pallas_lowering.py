@@ -48,6 +48,16 @@ class TestFusedKnnTileLowersForTPU:
             lambda x, q: fused_knn_tile(x, q, 100, interpret=False),
             (1_000_000, 128), (1024, 128))
 
+    @pytest.mark.parametrize("merge_impl", ["merge", "fullsort"])
+    def test_merge_impls(self, merge_impl):
+        """Both running-top-k merge networks must lower for TPU."""
+        from raft_tpu.ops.knn_tile import fused_knn_tile
+
+        _export_tpu(
+            lambda x, q: fused_knn_tile(x, q, 100, interpret=False,
+                                        merge_impl=merge_impl),
+            (8192, 128), (256, 128))
+
     def test_ragged_tail(self):
         """n not a multiple of the block: padding path must lower too."""
         from raft_tpu.ops.knn_tile import fused_knn_tile

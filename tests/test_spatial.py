@@ -235,6 +235,32 @@ def test_fused_knn_tile_duplicate_rows(rng):
     np.testing.assert_allclose(np.asarray(dist)[:, :3], 0.0, atol=1e-5)
 
 
+def test_fused_knn_tile_merge_impls_agree(rng):
+    """The log2-stage bitonic-merge tail ("merge", default) and the
+    full log^2 sort of the concatenation ("fullsort") are two networks
+    for the same running-top-k update; they must produce identical
+    distance sets — including on tie-heavy duplicated rows, where a
+    broken merge shows up as a dropped or doubled id."""
+    from raft_tpu.ops.knn_tile import fused_knn_tile
+
+    base = rng.standard_normal((150, 24)).astype(np.float32)
+    index = np.concatenate([base, base])          # exact ties everywhere
+    queries = rng.standard_normal((33, 24)).astype(np.float32)
+    for k in (5, 100):
+        d_m, i_m = fused_knn_tile(jnp.asarray(index), jnp.asarray(queries),
+                                  k, merge_impl="merge")
+        d_f, i_f = fused_knn_tile(jnp.asarray(index), jnp.asarray(queries),
+                                  k, merge_impl="fullsort")
+        np.testing.assert_allclose(np.asarray(d_m), np.asarray(d_f),
+                                   rtol=1e-5, atol=1e-6)
+        for row_m, row_f in zip(np.asarray(i_m), np.asarray(i_f)):
+            assert len(set(row_m.tolist())) == k
+            # same id SET up to tie partners (a and a+150 are the same
+            # point): compare modulo the duplication
+            assert sorted(r % 150 for r in row_m) == \
+                sorted(r % 150 for r in row_f)
+
+
 def test_fused_l2_knn_impl_dispatch(rng):
     """impl="pallas" and impl="xla" agree through the public entry."""
     index = rng.standard_normal((600, 32)).astype(np.float32)

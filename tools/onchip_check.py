@@ -90,6 +90,54 @@ def check_knn(n, nq, d, k, seed=0):
     return rec["ok"]
 
 
+def check_merge_impls(n, nq, d, k, seed=0):
+    """A/B the two running-top-k merge networks of the fused kNN kernel
+    on chip: equality of results AND steady-state timing — the log2-tail
+    "merge" network exists because the full log^2 sort of 2*kpad lanes
+    was the r4 steady-state suspect (cross-vreg lane rolls)."""
+    import jax
+
+    from raft_tpu.ops.knn_tile import fused_knn_tile
+
+    x = rand((n, d), seed)
+    q = rand((nq, d), seed + 1)
+    rec = {"check": "knn_merge_impls", "n": n, "nq": nq, "d": d, "k": k}
+    outs = {}
+    for impl in ("merge", "fullsort"):
+        t0 = time.time()
+        dd, ii = fused_knn_tile(x, q, k, merge_impl=impl)
+        jax.block_until_ready((dd, ii))
+        rec[f"t_{impl}_incl_compile"] = round(time.time() - t0, 2)
+        ts = []
+        for _ in range(3):
+            t0 = time.time()
+            dd, ii = fused_knn_tile(x, q, k, merge_impl=impl)
+            jax.block_until_ready((dd, ii))
+            ts.append(time.time() - t0)
+        rec[f"t_{impl}_steady"] = round(min(ts), 4)
+        outs[impl] = (np.asarray(dd), np.asarray(ii))
+    rec["dist_ok"] = bool(np.allclose(outs["merge"][0],
+                                      outs["fullsort"][0],
+                                      rtol=1e-5, atol=1e-3))
+    mism = outs["merge"][1] != outs["fullsort"][1]
+    rec["idx_mismatch_frac"] = float(mism.mean())
+    # every index mismatch must be a genuine tie: RECOMPUTE the distance
+    # at the id the merge network claims (same guard as check_knn — a
+    # payload-routing bug with correct distances must not pass)
+    xh = np.asarray(x, np.float64)
+    qh = np.asarray(q, np.float64)
+    rows, poss = np.nonzero(mism)
+    d_at_claim = ((qh[rows] - xh[outs["merge"][1][rows, poss]]) ** 2
+                  ).sum(axis=1)
+    rec["idx_ties_ok"] = bool(np.allclose(
+        d_at_claim, outs["fullsort"][0][rows, poss], rtol=1e-4, atol=1e-3))
+    rec["ok"] = rec["dist_ok"] and rec["idx_ties_ok"]
+    rec["speedup_merge_vs_fullsort"] = round(
+        rec["t_fullsort_steady"] / max(rec["t_merge_steady"], 1e-9), 2)
+    emit(rec)
+    return rec["ok"]
+
+
 def check_nn(m, n, d, seed=0):
     """Compiled fused 1-NN kernel vs the XLA scan path."""
     from raft_tpu.distance.fused_l2_nn import fused_l2_nn
@@ -227,6 +275,11 @@ def main():
     ok &= check_knn(1000, 7, 17, 5, seed=101)       # tiny + ragged d
     ok &= check_knn(4096, 256, 384, 64, seed=102)   # d > 128 (k-tiling)
     ok &= check_knn(100_000, 1024, 128, 100, seed=103)
+
+    # merge-network A/B at the timing shape + a small shape: equality
+    # and the steady-state cost of the log2-tail merge vs the full sort
+    ok &= check_merge_impls(4096, 256, 128, 100, seed=300)
+    ok &= check_merge_impls(100_000, 1024, 128, 100, seed=301)
 
     # fused 1-NN kernel (fused_l2_nn.cuh analog): aligned, ragged, 100k
     ok &= check_nn(256, 4096, 128, seed=200)
