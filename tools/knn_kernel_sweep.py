@@ -1,0 +1,78 @@
+"""On-chip sweep of the fused kNN kernel's tuning space.
+
+Chained-timing (bench._time_chained: dispatch-latency-cancelling
+fori_loop chains) of the Pallas kernel at the 100k timing shape across
+merge network x block geometry, against the XLA tile-scan path as the
+yardstick.  One flushed JSON line per config; run whenever the backend
+answers:
+
+    python tools/knn_kernel_sweep.py > .knn_sweep.log 2>&1
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+
+T0 = time.time()
+
+
+def emit(rec):
+    rec["t"] = round(time.time() - T0, 1)
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _time_chained
+
+    dev = jax.devices()[0]
+    emit({"config": "init", "device": str(dev.device_kind),
+          "platform": dev.platform})
+
+    from raft_tpu.ops.knn_tile import fused_knn_tile
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    n, nq, d, k = 100_000, 1024, 128, 100
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+    jax.block_until_ready((x, q))
+
+    def xla_step(qq):
+        return fused_l2_knn(x, qq, k, impl="xla")[0]
+
+    dt = _time_chained(xla_step, q, 2)
+    emit({"config": "xla_scan", "seconds_per_batch": round(dt, 4),
+          "qps": round(nq / dt, 1)})
+
+    for merge in ("merge", "fullsort"):
+        for bq in (64, 128, 256):
+            for bn in (1024, 2048):
+                def step(qq, merge=merge, bq=bq, bn=bn):
+                    return fused_knn_tile(x, qq, k, block_q=bq,
+                                          block_n=bn,
+                                          merge_impl=merge)[0]
+                try:
+                    t0 = time.time()
+                    dt = _time_chained(step, q, 2)
+                    emit({"config": f"pallas_{merge}_bq{bq}_bn{bn}",
+                          "seconds_per_batch": round(dt, 4),
+                          "qps": round(nq / dt, 1),
+                          "t_incl_compile": round(time.time() - t0, 1)})
+                except Exception as e:
+                    emit({"config": f"pallas_{merge}_bq{bq}_bn{bn}",
+                          "error": str(e)[-200:]})
+                    # a dead backend fails everything after too
+                    if "UNAVAILABLE" in str(e):
+                        return
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,12 @@ echo "bench rc=$? at $(date +%H:%M:%S)" >> "$LOG"
 
 NOW=$(date +%H%M)
 if [ "$NOW" -lt 1100 ]; then
+  echo "=== knn_kernel_sweep ===" >> "$LOG"
+  python tools/knn_kernel_sweep.py > .knn_sweep.log 2>&1
+  echo "knn_kernel_sweep rc=$? at $(date +%H:%M:%S)" >> "$LOG"
+fi
+NOW=$(date +%H%M)
+if [ "$NOW" -lt 1100 ]; then
   echo "=== select_variants ===" >> "$LOG"
   python tools/select_variants.py > .select_variants.log 2>&1
   echo "select_variants rc=$? at $(date +%H:%M:%S)" >> "$LOG"
