@@ -183,6 +183,70 @@ def _bitonic_merge_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
     return keys, vals
 
 
+def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
+                base_col: jnp.ndarray, *, kpad: int, g: int,
+                interpret: bool, merge_impl: str
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Threshold-gated merge of one keys tile into a running top-k.
+
+    The shared selection core of the fused kNN kernel and the
+    standalone select kernel (:mod:`raft_tpu.ops.select_tile`): given a
+    (bm, g*kpad) tile of keys (smaller = better; padding pre-masked to
+    +inf) and the sorted-ascending running buffers (bd, bi), runs the
+    extract-merge while-loop until no remaining key beats the k-th
+    best.  ``base_col`` is the tile's global column offset (traced
+    scalar), used to reconstruct global payload ids from the strided
+    (g, kpad) grouping.  Returns the updated (bd, bi).
+    """
+    bm = dist.shape[0]
+    inf32 = jnp.float32(_INF)
+    r_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
+    gg_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, g, kpad), 1)
+
+    def gate(state):
+        d, bd, _ = state
+        worst = bd[:, kpad - 1:kpad]
+        # int32 reduce-max, not jnp.any: Mosaic proxies boolean
+        # reductions through the default float type, which is f64 under
+        # jax_enable_x64 and has no TPU lowering
+        return jnp.max((d < worst).astype(jnp.int32)) > 0
+
+    def extract_merge(state):
+        d, bd, bi = state
+        d3 = d.reshape(bm, g, kpad)
+        gmin = jnp.min(d3, axis=1)                        # (bm, kpad)
+        is_min = d3 == jnp.expand_dims(gmin, 1)
+        gg_star = jnp.min(jnp.where(is_min, gg_iota, jnp.int32(g)), axis=1)
+        # candidate global id: strided grouping → column = gg*kpad + r
+        cand_i = base_col + gg_star * kpad + r_iota
+        cand_i = jnp.where(gmin < inf32, cand_i, jnp.int32(-1))
+        # mask the extracted element of each group (exactly one: the
+        # lowest-gg argmin)
+        picked = gg_iota == jnp.expand_dims(gg_star, 1)
+        d = jnp.where(picked, inf32, d3).reshape(bm, g * kpad)
+        # merge candidates into the running top-k.  bd is sorted
+        # ascending at all times (init is all-inf; every merge below
+        # returns a sorted prefix), so the default path sorts only the
+        # kpad candidates — at the NATIVE kpad lane width — descending,
+        # and then needs just the log2(2*kpad)-stage bitonic-merge tail
+        # at the wide width: ~4x fewer wide compare-exchange stages
+        # than full-sorting the 2*kpad concatenation each round.
+        if merge_impl == "fullsort":
+            md = jnp.concatenate([bd, gmin], axis=1)      # (bm, 2*kpad)
+            mi = jnp.concatenate([bi, cand_i], axis=1)
+            md, mi = _bitonic_sort_lanes(md, mi, interpret)
+        else:
+            gs, cs = _bitonic_sort_lanes(gmin, cand_i, interpret,
+                                         descending=True)
+            md = jnp.concatenate([bd, gs], axis=1)        # bitonic row
+            mi = jnp.concatenate([bi, cs], axis=1)
+            md, mi = _bitonic_merge_lanes(md, mi, interpret)
+        return d, md[:, :kpad], mi[:, :kpad]
+
+    _, bd, bi = jax.lax.while_loop(gate, extract_merge, (dist, bd, bi))
+    return bd, bi
+
+
 def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
                 bd_ref, bi_ref, *, kpad, bn, n_index, n_j_tiles, g,
                 precision, interpret, merge_impl):
@@ -208,52 +272,8 @@ def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
     dist = jnp.where(j * bn + col < n_index, dist, inf32)
 
-    bm = dist.shape[0]
-    r_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
-    gg_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, g, kpad), 1)
-
-    def gate(state):
-        d, bd, _ = state
-        worst = bd[:, kpad - 1:kpad]
-        # int32 reduce-max, not jnp.any: Mosaic proxies boolean
-        # reductions through the default float type, which is f64 under
-        # jax_enable_x64 and has no TPU lowering
-        return jnp.max((d < worst).astype(jnp.int32)) > 0
-
-    def extract_merge(state):
-        d, bd, bi = state
-        d3 = d.reshape(bm, g, kpad)
-        gmin = jnp.min(d3, axis=1)                        # (bm, kpad)
-        is_min = d3 == jnp.expand_dims(gmin, 1)
-        gg_star = jnp.min(jnp.where(is_min, gg_iota, jnp.int32(g)), axis=1)
-        # candidate global id: strided grouping → column = gg*kpad + r
-        cand_i = j * bn + gg_star * kpad + r_iota
-        cand_i = jnp.where(gmin < inf32, cand_i, jnp.int32(-1))
-        # mask the extracted element of each group (exactly one: the
-        # lowest-gg argmin)
-        picked = gg_iota == jnp.expand_dims(gg_star, 1)
-        d = jnp.where(picked, inf32, d3).reshape(bm, g * kpad)
-        # merge candidates into the running top-k.  bd is sorted
-        # ascending at all times (init is all-inf; every merge below
-        # returns a sorted prefix), so the default path sorts only the
-        # kpad candidates — at the NATIVE kpad lane width — descending,
-        # and then needs just the log2(2*kpad)-stage bitonic-merge tail
-        # at the wide width: ~4x fewer wide compare-exchange stages
-        # than full-sorting the 2*kpad concatenation each round.
-        if merge_impl == "fullsort":
-            md = jnp.concatenate([bd, gmin], axis=1)      # (bm, 2*kpad)
-            mi = jnp.concatenate([bi, cand_i], axis=1)
-            md, mi = _bitonic_sort_lanes(md, mi, interpret)
-        else:
-            gs, cs = _bitonic_sort_lanes(gmin, cand_i, interpret,
-                                         descending=True)
-            md = jnp.concatenate([bd, gs], axis=1)        # bitonic row
-            mi = jnp.concatenate([bi, cs], axis=1)
-            md, mi = _bitonic_merge_lanes(md, mi, interpret)
-        return d, md[:, :kpad], mi[:, :kpad]
-
-    _, bd, bi = jax.lax.while_loop(
-        gate, extract_merge, (dist, bd_ref[:], bi_ref[:]))
+    bd, bi = topk_update(dist, bd_ref[:], bi_ref[:], j * bn, kpad=kpad,
+                         g=g, interpret=interpret, merge_impl=merge_impl)
     bd_ref[:] = bd
     bi_ref[:] = bi
 

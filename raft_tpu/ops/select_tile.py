@@ -1,0 +1,150 @@
+"""Standalone fused top-k selection Pallas kernel.
+
+The reference serves standalone k-selection with the forked-FAISS
+warp/block select heaps (cpp/include/raft/spatial/knn/detail/
+selection_faiss.cuh:131-160, warp_select_faiss.cuh,
+block_select_faiss.cuh) behind ``select_k`` (knn.hpp:90).  The measured
+TPU problem is the same shape: one wide ``lax.top_k`` over (rows, W) is
+a sort-shaped selection costing ~400x the MXU time of the matmul that
+produced the keys (v5e, W=8192, k=100 — BENCH_TPU_SESSION_r04.md).
+
+This kernel re-uses the fused kNN kernel's selection core
+(:func:`raft_tpu.ops.knn_tile.topk_update`): stream (bm, bw) key tiles
+through VMEM; per tile, a threshold gate (any key below the current
+k-th best?) drives an extract-merge while-loop that approaches zero
+rounds once the running top-k warms up — the role the reference's
+warp-select early-out plays.  Grid = (row_tiles, w_tiles), w innermost;
+the running (sorted) top-k lives in VMEM scratch across w tiles.
+
+Selects the SMALLEST k keys per row (distance semantics, ascending).
+Callers wanting largest negate the keys (see
+:func:`raft_tpu.spatial.select_k.top_k_rows`).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.utils import is_tpu_backend
+from raft_tpu.ops.knn_tile import tile_geometry, topk_update
+
+_INF = float("inf")
+
+
+def _select_kernel(k_ref, od_ref, oi_ref, bd_ref, bi_ref, *, kpad, bw,
+                   w_real, n_j_tiles, g, interpret, merge_impl):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        bd_ref[:] = jnp.full_like(bd_ref, _INF)
+        bi_ref[:] = jnp.full_like(bi_ref, -1)
+
+    keys = k_ref[:]
+    # mask padded columns of the final tile (explicit f32 constant: a
+    # Python-float literal promotes to f64 under jax_enable_x64, which
+    # Mosaic cannot cast back — same rule as the kNN kernel)
+    inf32 = jnp.float32(_INF)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, bw), 1)
+    keys = jnp.where(j * bw + col < w_real, keys, inf32)
+
+    bd, bi = topk_update(keys, bd_ref[:], bi_ref[:], j * bw, kpad=kpad,
+                         g=g, interpret=interpret, merge_impl=merge_impl)
+    bd_ref[:] = bd
+    bi_ref[:] = bi
+
+    @pl.when(j == n_j_tiles - 1)
+    def _emit():
+        od_ref[:] = bd_ref[:]
+        oi_ref[:] = bi_ref[:]
+
+
+def select_tile(
+    keys: jnp.ndarray,
+    k: int,
+    block_rows: int = 256,
+    block_w: int = 2048,
+    interpret: Optional[bool] = None,
+    merge_impl: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row k smallest keys, fused threshold-gated selection.
+
+    Parameters
+    ----------
+    keys:
+        (m, w) float key matrix (e.g. distances; smaller = better).
+    k:
+        Entries to keep per row; k <= min(w, 128) (the bitonic merge
+        width cap shared with the fused kNN kernel).
+    block_rows / block_w:
+        Tile geometry: rows per grid step and key columns per VMEM
+        tile.
+
+    Returns
+    -------
+    (values, indices): (m, k) keys sorted ascending and their int32
+    column ids.  Rows with fewer than k finite keys fill the deficit
+    with +inf values whose ids are clamped in-range (same contract as
+    :func:`raft_tpu.spatial.select_k.chunked_top_k` pads).
+    """
+    expects(keys.ndim == 2, "select_tile: 2-D keys required")
+    m, w = keys.shape
+    expects(0 < k <= w, "select_tile: k=%d out of range for w=%d", k, w)
+    expects(k <= 128,
+            "select_tile: k <= 128 (bitonic merge width cap; got %d)", k)
+    expects(jnp.issubdtype(keys.dtype, jnp.floating),
+            "select_tile: float keys required, got %s", keys.dtype)
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    if merge_impl is None:
+        merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
+    expects(merge_impl in ("merge", "fullsort"),
+            "select_tile: unknown merge_impl %s", merge_impl)
+
+    # shared geometry with the fused kNN kernel (one definition so the
+    # padding/alignment rules cannot drift between the kernels); the
+    # depth argument is irrelevant here — d=1 keeps dp inert
+    kpad = 128
+    bm, bw, g, _, mp, wp = tile_geometry(m, w, 1, block_rows, block_w,
+                                         unit=kpad)
+
+    kf = jnp.pad(keys.astype(jnp.float32),
+                 ((0, mp - m), (0, wp - w)),
+                 constant_values=_INF)
+
+    grid = (mp // bm, wp // bw)
+    kern = functools.partial(
+        _select_kernel, kpad=kpad, bw=bw, w_real=w, n_j_tiles=grid[1],
+        g=g, interpret=interpret, merge_impl=merge_impl)
+    out_d, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, bw), lambda i, j: (i, j))],
+        out_specs=[
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mp, kpad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bm, kpad), jnp.float32),
+            pltpu.VMEM((bm, kpad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kf)
+    # deficit slots (fewer than k finite keys in the row) carry id -1;
+    # clamp in-range so a payload gather cannot go out of bounds
+    return out_d[:m, :k], jnp.clip(out_i[:m, :k], 0, w - 1)

@@ -115,8 +115,12 @@ def top_k_rows(sel: jnp.ndarray, k: int,
     Shared by :func:`select_k` and the tile-scan kNN driver.
 
     ``"chunked"`` is :func:`chunked_top_k` — exact, tie order local to
-    its merge bracket.  ``"approx95"`` is the one deliberately
-    APPROXIMATE mode (recall_target 0.95): unlike ``"approx"``/recall
+    its merge bracket.  ``"pallas"`` is the fused threshold-gated
+    selection kernel (:mod:`raft_tpu.ops.select_tile`; float keys,
+    k <= 128) — exact in value, deficit slots clamped, tie ids may
+    differ from ``top_k``'s smallest-index rule.  ``"approx95"`` is the
+    one deliberately APPROXIMATE mode (recall_target 0.95): unlike
+    ``"approx"``/recall
     1.0 — whose partial reduce cannot drop anything and degenerates to
     the same sort as ``top_k`` (measured identical QPS on v5e) — it
     genuinely shrinks the reduction width.  Exact-contract callers (the
@@ -125,8 +129,18 @@ def top_k_rows(sel: jnp.ndarray, k: int,
     measured recall next to its QPS."""
     if impl is None:
         impl = os.environ.get("RAFT_TPU_SELECT_IMPL", "topk")
-    expects(impl in ("topk", "approx", "approx95", "chunked"),
+    expects(impl in ("topk", "approx", "approx95", "chunked", "pallas"),
             "select_k: unknown impl %s", impl)
+    if impl == "pallas":
+        # fused threshold-gated selection kernel (ops/select_tile.py):
+        # the kernel selects SMALLEST, this contract is largest —
+        # negate in, negate out.  Float keys and k <= 128 only (the
+        # kernel errors otherwise, mirroring the explicit-pallas rule
+        # of fused_l2_knn).
+        from raft_tpu.ops.select_tile import select_tile
+
+        vals, idx = select_tile(jnp.negative(sel), k)
+        return jnp.negative(vals), idx
     if impl == "chunked":
         return chunked_top_k(sel, k)
     if impl == "approx95":

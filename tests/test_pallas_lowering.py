@@ -68,6 +68,24 @@ class TestFusedKnnTileLowersForTPU:
             (5000, 64), (96, 64))
 
 
+class TestSelectTileLowersForTPU:
+    @pytest.mark.parametrize("k", [8, 100, 128])
+    def test_k_sweep(self, k):
+        from raft_tpu.ops.select_tile import select_tile
+
+        _export_tpu(
+            lambda keys: select_tile(keys, k, interpret=False),
+            (4096, 8192))
+
+    def test_ragged_and_merge_impls(self):
+        from raft_tpu.ops.select_tile import select_tile
+
+        _export_tpu(
+            lambda keys: select_tile(keys, 100, interpret=False,
+                                     merge_impl="fullsort"),
+            (1000, 5000))
+
+
 class TestFusedNnTileLowersForTPU:
     def test_default_and_ragged(self):
         from raft_tpu.ops.nn_tile import fused_nn_tile

@@ -429,6 +429,80 @@ class TestSelectKImpl:
         got = np.take_along_axis(np.asarray(keys), i_c[:, :60], 1)
         np.testing.assert_allclose(got, np.asarray(d_c)[:, :60], atol=1e-6)
 
+    @pytest.mark.parametrize("m,n,k", [
+        (32, 4096, 16), (7, 8192, 100), (5, 1000, 3),   # ragged width
+        (3, 257, 100),                                   # w barely > 2k
+        (9, 300, 128),                                   # k == cap
+    ])
+    def test_pallas_matches_topk(self, m, n, k):
+        """The fused select kernel (interpret mode on CPU): exact
+        values; indices point at rows holding the selected value (tie
+        ids may differ from top_k's smallest-index rule)."""
+        rng = np.random.default_rng(4)
+        keys = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        from raft_tpu.spatial.select_k import select_k
+
+        d_p, i_p = select_k(keys, k, select_min=True, impl="pallas")
+        d_t, _ = select_k(keys, k, select_min=True, impl="topk")
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_t),
+                                   rtol=1e-6, atol=1e-6)
+        got = np.take_along_axis(np.asarray(keys), np.asarray(i_p), 1)
+        np.testing.assert_allclose(got, np.asarray(d_p), rtol=1e-6,
+                                   atol=1e-6)
+        assert np.asarray(i_p).min() >= 0
+
+    def test_pallas_select_max_and_payload(self):
+        rng = np.random.default_rng(5)
+        keys = jnp.asarray(rng.standard_normal((6, 2000)), jnp.float32)
+        payload = jnp.asarray(rng.integers(0, 9999, (6, 2000)), jnp.int32)
+        from raft_tpu.spatial.select_k import select_k
+
+        d_p, v_p = select_k(keys, 9, select_min=False, values=payload,
+                            impl="pallas")
+        d_t, v_t = select_k(keys, 9, select_min=False, values=payload,
+                            impl="topk")
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_t),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(v_p), np.asarray(v_t))
+
+    def test_pallas_deficit_rows_stay_in_range(self):
+        """Rows with fewer than k finite keys: +inf fills the deficit
+        and ids stay in range (the kernel's -1 sentinel must be
+        clamped, mirroring the chunked pad contract)."""
+        rng = np.random.default_rng(6)
+        keys = np.full((3, 900), np.inf, np.float32)
+        keys[:, :40] = rng.standard_normal((3, 40))
+        from raft_tpu.spatial.select_k import select_k
+
+        d_p, i_p = select_k(jnp.asarray(keys), 100, select_min=True,
+                            impl="pallas")
+        d_t, _ = select_k(jnp.asarray(keys), 100, select_min=True,
+                          impl="topk")
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_t),
+                                   atol=1e-6)
+        i_p = np.asarray(i_p)
+        assert i_p.min() >= 0 and i_p.max() < 900
+        got = np.take_along_axis(keys, i_p[:, :40], 1)
+        np.testing.assert_allclose(got, np.asarray(d_p)[:, :40],
+                                   atol=1e-6)
+
+    def test_pallas_duplicate_ties_no_id_reuse(self):
+        """Exact-tie keys: the selected id set must not repeat an id."""
+        rng = np.random.default_rng(7)
+        base = rng.standard_normal((1, 300)).astype(np.float32)
+        keys = jnp.asarray(np.concatenate([base, base], axis=1))
+        from raft_tpu.spatial.select_k import select_k
+
+        _, i_p = select_k(keys, 50, select_min=True, impl="pallas")
+        row = np.asarray(i_p)[0]
+        assert len(set(row.tolist())) == 50
+
+    def test_pallas_k_cap_errors(self):
+        from raft_tpu.spatial.select_k import select_k
+
+        with pytest.raises(Exception, match="128"):
+            select_k(jnp.ones((2, 600)), 200, impl="pallas")
+
     def test_chunked_int_keys(self):
         """Integer keys (e.g. vote counts) through the merge tree."""
         rng = np.random.default_rng(4)

@@ -45,8 +45,11 @@ def main():
     jax.block_until_ready(sel)
     from jax import lax
 
+    from raft_tpu.ops.select_tile import select_tile
+
     for name, fn in [("lax.top_k", lambda s: lax.top_k(s, k)[0]),
                      ("chunked", lambda s: chunked_top_k(s, k)[0]),
+                     ("pallas", lambda s: select_tile(-s, k)[0]),
                      ("approx95",
                       lambda s: lax.approx_max_k(s, k, recall_target=0.95)[0])]:
         f = jax.jit(fn)
@@ -67,7 +70,7 @@ def main():
         g = jnp.matmul(qq, x_t.T, precision="highest")
         return qn[:, None] + xn[None, :] - 2.0 * g
 
-    for impl in ("topk", "chunked"):
+    for impl in ("topk", "chunked", "pallas"):
         os.environ["RAFT_TPU_SELECT_IMPL"] = impl
         f = jax.jit(lambda qq: tiled_knn(x, qq, k, dist)[0])
         t0 = time.perf_counter()
@@ -82,13 +85,15 @@ def main():
         log(f"scan {impl}: steady {dt*1e3:.2f} ms  {nq/dt:,.0f} QPS")
     os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
 
-    # sanity: identical values
-    os.environ["RAFT_TPU_SELECT_IMPL"] = "chunked"
-    d_c, _ = tiled_knn(x, q[:64], k, dist)
-    os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
+    # sanity: every raced impl must produce the reference values
     d_t, _ = tiled_knn(x, q[:64], k, dist)
-    ok = bool(np.allclose(np.asarray(d_c), np.asarray(d_t), atol=1e-3))
-    log(f"values match: {ok}")
+    for impl in ("chunked", "pallas"):
+        os.environ["RAFT_TPU_SELECT_IMPL"] = impl
+        d_c, _ = tiled_knn(x, q[:64], k, dist)
+        os.environ.pop("RAFT_TPU_SELECT_IMPL", None)
+        ok = bool(np.allclose(np.asarray(d_c), np.asarray(d_t),
+                              atol=1e-3))
+        log(f"values match ({impl} vs topk): {ok}")
 
 
 if __name__ == "__main__":
