@@ -31,7 +31,8 @@ while true; do
     exit 0
   fi
   if timeout 900 python tools/tpu_probe.py >> "$LOG" 2>&1; then break; fi
-  echo "$(date +%H:%M:%S) probe failed (rc=$?); sleeping 120" >> "$LOG"
+  RC=$?   # before $(date): command substitution resets $?
+  echo "$(date +%H:%M:%S) probe failed (rc=$RC); sleeping 120" >> "$LOG"
   sleep 120
 done
 echo "=== BACKEND UP $(date +%H:%M:%S) ===" >> "$LOG"
