@@ -136,7 +136,8 @@ def assemble(tpu_state, cpu_state):
         detail["cpu_fallback"] = cpu_state
 
     knn_1m = _best_knn(tpu_state, "knn_1m", "knn_1m_pallas")
-    knn_100k = _best_knn(tpu_state, "knn_100k", "knn_100k_chunked")
+    knn_100k = _best_knn(tpu_state, "knn_100k", "knn_100k_chunked",
+                         "knn_100k_pselect")
     pw = None
     for name in ("pairwise_8k", "pairwise_2k", "pairwise_1k"):
         cand = tpu_state.get(name)
@@ -168,11 +169,25 @@ def assemble(tpu_state, cpu_state):
         value = cpu_knn["qps"]
         unit = "queries/s"
         vs = value * (n_index / 1_000_000) / KNN_BASELINE_QPS
+    elif (cpw := next((cpu_state[n] for n in ("pairwise_2k", "pairwise_1k")
+                       if cpu_state.get(n, {}).get("gpairs_per_sec")),
+                      None)):
+        # a very short budget can bank CPU pairwise rungs but not the
+        # (costlier) CPU kNN rung — report the largest banked shape
+        # (same order as the TPU pw chain above) instead of a flat
+        # zero (r4: a 70 s smoke budget hit exactly this)
+        m, _, d = cpw["shape"]
+        metric = "pairwise_l2_gpairs_%dx%d_cpu_fallback" % (m, d)
+        value = cpw["gpairs_per_sec"]
+        unit = "Gpairs/s"
+        vs = value * (d / 128.0) / PAIRWISE_BASELINE_GPAIRS
     else:
         metric, value, unit, vs = "knn_qps_1M_128d_k100", 0.0, "queries/s", 0.0
     return {
         "metric": metric,
-        "value": round(value, 1),
+        # 4 decimals: a 1-decimal round would flatten sub-1 Gpairs/s
+        # fallback values (0.25 -> 0.2)
+        "value": round(value, 4),
         "unit": unit,
         "vs_baseline": round(vs, 4),
         "detail": detail,
@@ -971,12 +986,17 @@ class _Child:
         self.state = {}
         self.final = None
         self.stderr_tail = ""
+        # any streamed line counts as liveness: the stall watchdog keys
+        # off this (a hung first-op RPC emits nothing for the rest of
+        # the budget — observed r4)
+        self.t_last_progress = time.time()
         threading.Thread(target=self._read_out, daemon=True).start()
         threading.Thread(target=self._read_err, daemon=True).start()
 
     def _read_out(self):
         for line in self.proc.stdout:
             line = line.strip()
+            self.t_last_progress = time.time()
             if line.startswith("PARTIAL "):
                 try:
                     self.state.update(json.loads(line[8:]))
@@ -991,6 +1011,10 @@ class _Child:
     def _read_err(self):
         tail = []
         for line in self.proc.stderr:
+            # stderr counts as liveness too: a long compile with
+            # continuous XLA logging but no PARTIAL yet is progressing,
+            # not stalled
+            self.t_last_progress = time.time()
             tail.append(line)
             tail = tail[-8:]
         self.stderr_tail = "".join(tail)[-600:]
@@ -1046,6 +1070,15 @@ def parent_main():
     tpu = _Child(deadline, cpu=False)
     cpu = _Child(deadline, cpu=True)
     tpu_graced = False
+    # stall watchdog: one hung RPC must not burn the whole TPU budget
+    # on a dead gRPC channel (observed r4: first op after devices_ready
+    # hung for the entire 2400 s).  No streamed line for STALL_S —
+    # comfortably above any legitimate compile gap; rungs and init
+    # retries all emit PARTIALs — kills the child and respawns on a
+    # fresh channel, keeping each attempt's evidence and banked rungs.
+    stall_s = float(os.environ.get("RAFT_TPU_BENCH_STALL_S", "420"))
+    stalled_attempts = []
+    banked_states = []
     while time.time() < deadline:
         if tpu.final is not None:
             break
@@ -1060,6 +1093,22 @@ def parent_main():
                 time.sleep(0.1)
             if tpu.final is not None:
                 break
+        if (not tpu_dead and tpu.final is None
+                and time.time() - tpu.t_last_progress > stall_s
+                and deadline - time.time() > 120):
+            note = _tpu_attempt_note(tpu, deadline)
+            note["status"] = "killed_stalled_no_progress"
+            note["stalled_s"] = round(time.time() - tpu.t_last_progress, 1)
+            stalled_attempts.append(note)
+            # bank only RUNG results: per-attempt bookkeeping
+            # (skipped/errors/aborted/init_log) lives in the attempt
+            # note and must not contradict a later attempt's outcome
+            banked_states.append({
+                k: v for k, v in tpu.state.items()
+                if k not in ("skipped", "errors", "aborted", "init_log")})
+            tpu.kill()
+            tpu = _Child(deadline, cpu=False)
+            tpu_graced = False
         if tpu_dead and cpu_done:
             break
         time.sleep(0.5)
@@ -1074,13 +1123,19 @@ def parent_main():
                    and (v.get("qps") or v.get("gpairs_per_sec"))
                    for v in state.values())
 
-    tpu_state = dict(tpu.state)
+    # merge rungs banked by every attempt (a stalled attempt may have
+    # banked rungs before its channel died); later attempts win ties
+    tpu_state = {}
+    for s in banked_states + [dict(tpu.state)]:
+        tpu_state.update(s)
     tpu_state.pop("fallback", None)
     tpu_is_accel = bool(tpu_state.get("init", {}).get("is_tpu"))
     cpu_state = dict(cpu.state)
     cpu_state.pop("fallback", None)
     cpu_state.pop("init_log", None)
     if tpu_is_accel and has_rung(tpu_state):
+        if stalled_attempts:
+            tpu_state["stalled_attempts"] = stalled_attempts
         result = assemble(tpu_state, cpu_state)
     else:
         # no hardware number: both children (at best) ran CPU ladders —
@@ -1091,7 +1146,10 @@ def parent_main():
             b = _best_knn(cpu_state, "knn_100k")
             if (a.get("qps", 0) if a else 0) > (b.get("qps", 0) if b else 0):
                 cpu_state = tpu_state
-        cpu_state["tpu_attempt"] = _tpu_attempt_note(tpu, deadline)
+        note = _tpu_attempt_note(tpu, deadline)
+        if stalled_attempts:
+            note["stalled_attempts"] = stalled_attempts
+        cpu_state["tpu_attempt"] = note
         result = assemble(None, cpu_state)
     tpu.kill()
     cpu.kill()

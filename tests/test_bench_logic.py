@@ -53,6 +53,12 @@ class TestAssemble:
         out = bench.assemble(tpu, {})
         assert out["value"] == 7000.0
 
+    def test_pselect_rung_can_carry_the_100k_headline(self):
+        tpu = {"knn_100k": {"qps": 9_000.0, "n_index": 100_000},
+               "knn_100k_pselect": {"qps": 12_000.0, "n_index": 100_000}}
+        out = bench.assemble(tpu, {})
+        assert out["value"] == 12_000.0
+
     def test_100k_rung_scales_vs_baseline_by_index_size(self):
         tpu = {"knn_100k": {"qps": 10_000.0, "n_index": 100_000}}
         out = bench.assemble(tpu, {})
@@ -72,6 +78,16 @@ class TestAssemble:
         cpu = {"knn_100k": {"qps": 999.0, "n_index": 100_000}}
         out = bench.assemble(None, cpu)
         assert out["metric"].endswith("_cpu_fallback")
+
+    def test_cpu_pairwise_fallback_when_no_knn_rung_fit(self):
+        """A short budget can bank CPU pairwise but not CPU kNN; the
+        report must carry the pairwise number, not a flat zero."""
+        cpu = {"pairwise_1k": {"gpairs_per_sec": 0.25,
+                               "shape": [1024, 1024, 64]}}
+        out = bench.assemble(None, cpu)
+        assert out["metric"] == "pairwise_l2_gpairs_1024x64_cpu_fallback"
+        assert out["value"] == 0.25
+        assert out["vs_baseline"] > 0
 
     def test_zero_when_nothing_banked(self):
         out = bench.assemble({}, {})
@@ -127,6 +143,21 @@ class TestTpuAttemptNote:
         note = bench._tpu_attempt_note(
             _FakeChild(rc=2, stderr_tail="boom"), deadline=0)
         assert note["stderr_tail"] == "boom"
+
+    def test_stalled_attempt_note_shape(self):
+        """The stall watchdog relabels the note and keeps the init log
+        (the evidence that distinguishes 'hung after devices_ready'
+        from 'never connected')."""
+        import time
+
+        child = _FakeChild(rc=None, state={
+            "init_log": [{"t": 0.2, "event": "devices_ready"}]})
+        note = bench._tpu_attempt_note(child, deadline=time.time() + 999)
+        # parent_main overrides status for stalled kills; the raw note
+        # must still carry where the child was stuck
+        note["status"] = "killed_stalled_no_progress"
+        assert note["stuck_after"] == "devices_ready"
+        assert note["init_log"]
 
 
 class TestInitRetry:

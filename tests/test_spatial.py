@@ -503,6 +503,28 @@ class TestSelectKImpl:
         with pytest.raises(Exception, match="128"):
             select_k(jnp.ones((2, 600)), 200, impl="pallas")
 
+    def test_pallas_randomized_geometry_sweep(self):
+        """Seeded fuzz over (m, w, k, block) geometry: the kernel's
+        padding/grouping rules must hold at arbitrary ragged shapes,
+        not only the hand-picked ones (the reference fuzzes select_k
+        the same way: test/spatial/selection.cu random shape lists)."""
+        rng = np.random.default_rng(42)
+        from raft_tpu.ops.select_tile import select_tile
+
+        for _ in range(10):
+            m = int(rng.integers(1, 40))
+            w = int(rng.integers(2, 1500))
+            k = int(rng.integers(1, min(w, 128) + 1))
+            bw = int(rng.choice([256, 512, 1024]))
+            keys = rng.standard_normal((m, w)).astype(np.float32)
+            d_p, i_p = select_tile(jnp.asarray(keys), k, block_w=bw)
+            ref = np.sort(keys, axis=1)[:, :k]
+            np.testing.assert_allclose(np.asarray(d_p), ref, rtol=1e-6,
+                                       atol=1e-6,
+                                       err_msg=f"{m}x{w} k={k} bw={bw}")
+            got = np.take_along_axis(keys, np.asarray(i_p), 1)
+            np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
     def test_chunked_int_keys(self):
         """Integer keys (e.g. vote counts) through the merge tree."""
         rng = np.random.default_rng(4)

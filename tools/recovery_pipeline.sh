@@ -30,8 +30,10 @@ while true; do
     echo "$(date +%H:%M:%S) past 11:30 — stand down for the driver" >> "$LOG"
     exit 0
   fi
-  if timeout 900 python tools/tpu_probe.py >> "$LOG" 2>&1; then break; fi
-  RC=$?   # before $(date): command substitution resets $?
+  timeout 900 python tools/tpu_probe.py >> "$LOG" 2>&1
+  RC=$?   # capture IMMEDIATELY: both `if` compounds and $(date)
+          # substitutions reset $? (two prior bugs here)
+  [ "$RC" -eq 0 ] && break
   echo "$(date +%H:%M:%S) probe failed (rc=$RC); sleeping 120" >> "$LOG"
   sleep 120
 done
