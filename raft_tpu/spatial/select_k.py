@@ -92,8 +92,16 @@ def chunked_top_k(sel: jnp.ndarray, k: int,
         vals = vals.reshape(nq, c // 2, 2 * kc)
         idx = idx.reshape(nq, c // 2, 2 * kc)
         kc2 = min(k, 2 * kc)
-        vals, pos = lax.top_k(vals, kc2)            # (nq, c//2, kc2)
-        idx = jnp.take_along_axis(idx, pos, axis=2)
+        # one variadic sort (descending via the order flip) replaces
+        # top_k + take_along_axis: the per-row gather lowers to a
+        # serial scalar loop on TPU while a 2kc-lane sort with the ids
+        # as a carried operand stays vector-shaped (same finding as the
+        # tile-scan merge, tiled_knn.py).  _flip (not jnp.negative):
+        # integer negation wraps INT_MIN onto itself, which would rank
+        # the odd-round pad sentinel FIRST; ~x is overflow-free.
+        fv, idx = lax.sort((_flip(vals), idx), dimension=2)
+        vals = _flip(fv[:, :, :kc2])
+        idx = idx[:, :, :kc2]
         kc = kc2
         c //= 2
     # pads can only surface when a row has fewer than k entries above
@@ -106,6 +114,29 @@ def chunked_top_k(sel: jnp.ndarray, k: int,
 def _pad_sentinel(dtype):
     return (-jnp.inf if jnp.issubdtype(dtype, jnp.floating)
             else jnp.iinfo(dtype).min)
+
+
+def _flip(x):
+    """Order-reversing involution for ascending-sort-as-descending.
+
+    ``jnp.negative`` would do for floats (-(-inf) = +inf) but wraps
+    INT_MIN onto itself for two's-complement ints; bitwise NOT
+    (~x = -x - 1) is strictly order-reversing with no overflow and maps
+    ``_pad_sentinel``'s iinfo.min to iinfo.max (sorts last, as a pad
+    must)."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.negative(x)
+    return jnp.bitwise_not(x)
+
+
+def _resolve_impl(impl: Optional[str]) -> str:
+    """Single owner of the RAFT_TPU_SELECT_IMPL env default + whitelist
+    (shared by :func:`top_k_rows` and :func:`select_k`)."""
+    if impl is None:
+        impl = os.environ.get("RAFT_TPU_SELECT_IMPL", "topk")
+    expects(impl in ("topk", "approx", "approx95", "chunked", "pallas"),
+            "select_k: unknown impl %s", impl)
+    return impl
 
 
 def top_k_rows(sel: jnp.ndarray, k: int,
@@ -127,10 +158,7 @@ def top_k_rows(sel: jnp.ndarray, k: int,
     public kNN/ANN paths) never default to approx95; it exists for
     consumers that opt into recall-for-speed, and the bench reports its
     measured recall next to its QPS."""
-    if impl is None:
-        impl = os.environ.get("RAFT_TPU_SELECT_IMPL", "topk")
-    expects(impl in ("topk", "approx", "approx95", "chunked", "pallas"),
-            "select_k: unknown impl %s", impl)
+    impl = _resolve_impl(impl)
     if impl == "pallas":
         # fused threshold-gated selection kernel (ops/select_tile.py):
         # the kernel selects SMALLEST, this contract is largest —
@@ -186,10 +214,33 @@ def select_k(
     n = keys.shape[1]
     expects(0 < k <= n, "select_k: k=%d out of range for n=%d", k, n)
 
+    impl = _resolve_impl(impl)
+    if values is None:
+        sel = -keys if select_min else keys
+        top_vals, top_idx = top_k_rows(sel, k, impl)
+        out_keys = -top_vals if select_min else top_vals
+        return out_keys, top_idx.astype(jnp.int32)
+    if impl == "topk":
+        # payload path: carry the payload THROUGH the selection as a
+        # sort operand instead of gathering it afterwards —
+        # take_along_axis over the full row width lowers to a serial
+        # scalar-gather loop on TPU (measured r4: it dominated the
+        # tile-scan kNN wall time), while a variadic sort keeps
+        # everything vector-shaped.  lax.top_k lowers to a full sort on
+        # TPU anyway, so the sort costs no more than the top_k it
+        # replaces.  Sort key: ascending `keys` directly for
+        # select_min; the overflow-free order flip of `keys` (not
+        # integer negation, which wraps INT_MIN) for select-largest.
+        skey = keys if select_min else _flip(keys)
+        sorted_keys, out_values = lax.sort((skey, values), dimension=1)
+        out_keys = (sorted_keys[:, :k] if select_min
+                    else _flip(sorted_keys[:, :k]))
+        return out_keys, out_values[:, :k]
+    # non-default impls (approx*/chunked/pallas) pick their winners by
+    # other means than a full sort; the payload must be fetched by a
+    # row-wise gather (the cost the default path avoids)
     sel = -keys if select_min else keys
     top_vals, top_idx = top_k_rows(sel, k, impl)
     out_keys = -top_vals if select_min else top_vals
-    if values is None:
-        return out_keys, top_idx.astype(jnp.int32)
     out_values = jnp.take_along_axis(values, top_idx, axis=1)
     return out_keys, out_values

@@ -408,6 +408,42 @@ class TestSelectKImpl:
         got = np.take_along_axis(np.asarray(keys), np.asarray(i_c), 1)
         np.testing.assert_allclose(got, np.asarray(d_c), atol=1e-6)
 
+    def test_chunked_int_keys_odd_merge_round(self):
+        """Integer keys through a merge tree with an ODD chunk count
+        (w=768, chunk=256 -> c=3): the odd-round pad sentinel is
+        iinfo.min, whose two's-complement negation wraps onto itself —
+        the order flip must be overflow-free or pads outrank every
+        genuine entry (code-review r4 finding)."""
+        rng = np.random.default_rng(7)
+        keys = rng.integers(-10**9, 10**9, (5, 768)).astype(np.int32)
+        keys[0, :5] = np.iinfo(np.int32).min  # genuine INT_MIN entries
+        from raft_tpu.spatial.select_k import chunked_top_k
+
+        v_c, i_c = chunked_top_k(jnp.asarray(keys), 10)
+        v_ref = np.sort(keys, axis=1)[:, ::-1][:, :10]
+        np.testing.assert_array_equal(np.asarray(v_c), v_ref)
+        got = np.take_along_axis(keys, np.asarray(i_c), 1)
+        np.testing.assert_array_equal(got, v_ref)
+
+    def test_select_k_int_payload_select_max_intmin(self):
+        """select_k(select_min=False, values=payload) on int32 keys
+        containing INT_MIN: the payload sort path must not negate
+        integer keys (INT_MIN wraps onto itself and would be reported
+        as the LARGEST key — code-review r4 finding)."""
+        rng = np.random.default_rng(8)
+        keys = rng.integers(-1000, 1000, (3, 40)).astype(np.int32)
+        keys[:, 0] = np.iinfo(np.int32).min
+        payload = rng.integers(0, 9999, (3, 40)).astype(np.int32)
+        from raft_tpu.spatial.select_k import select_k
+
+        d, v = select_k(jnp.asarray(keys), 5, select_min=False,
+                        values=jnp.asarray(payload), impl="topk")
+        order = np.argsort(-keys.astype(np.int64), axis=1)[:, :5]
+        np.testing.assert_array_equal(
+            np.asarray(d), np.take_along_axis(keys, order, 1))
+        np.testing.assert_array_equal(
+            np.asarray(v), np.take_along_axis(payload, order, 1))
+
     def test_chunked_masked_rows_match_topk(self):
         """Rows where most keys are +inf (the standard invalid-distance
         sentinel, -inf after negation): pad columns must not outrank

@@ -1,0 +1,81 @@
+"""Cross-check the bench's chained timing against plain wall-clock.
+
+Round-4 question: the full-budget bench measured the 100k XLA kNN rung
+at ~98 us/query (nq=4096, _time_chained), while tools/steady_knn.py
+measured ~1700 us/query (nq=1024, plain wall-clock).  One of batch
+size, wrapper path, or timing method explains the 17x; this tool pins
+which, with plain timing and chained timing on the SAME calls.
+
+    python tools/timing_xcheck.py > .timing_xcheck.log 2>&1
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+# bench._time_chained budgets itself against the bench deadline env;
+# give this standalone run a generous one
+os.environ.setdefault("RAFT_TPU_BENCH_DEADLINE", str(time.time() + 1800))
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-T0:7.1f}s] {msg}", flush=True)
+
+
+def wall(fn, *args):
+    """Plain steady-state: warm once, then min over 4 timed calls."""
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _time_chained
+    from raft_tpu.spatial import brute_force_knn
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    dev = jax.devices()[0]
+    log(f"backend: {dev.platform} ({dev.device_kind})")
+
+    n, d, k = 100_000, 128, 100
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    jax.block_until_ready(x)
+
+    os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = "xla"
+    for nq in (1024, 4096):
+        q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+        jax.block_until_ready(q)
+
+        def f_direct(qq):
+            return fused_l2_knn(x, qq, k, impl="xla")[0]
+
+        def f_bf(qq):
+            return brute_force_knn([x], qq, k)[0]
+
+        for name, fn in (("fused_l2_knn", f_direct),
+                         ("brute_force_knn", f_bf)):
+            dt_w = wall(fn, q)
+            log(f"nq={nq} {name:16s} wall    {dt_w*1e3:9.1f} ms "
+                f"{nq/dt_w:10,.0f} QPS")
+            dt_c = _time_chained(fn, q, 2)
+            log(f"nq={nq} {name:16s} chained {dt_c*1e3:9.1f} ms "
+                f"{nq/dt_c:10,.0f} QPS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Part 2 of the timing cross-check: WHY do chained (100 ms) and wall
+(1800 ms) disagree on the same 100k kNN call?
+
+Hypotheses tested, all at nq=1024, n=100k, d=128, k=100, impl=xla:
+  a. dead-code: chained keeps only sum(dists), so the index half of the
+     selection (variadic sorts, gathers) is pruned -> wall-time a
+     sum(dists)-only jit and compare;
+  b. output-fetch: wall pays a (nq,k) device->host fetch per call ->
+     wall-time with a device-resident scalar output;
+  c. chained undercount: force BOTH outputs live in the chain.
+
+    python tools/timing_xcheck2.py > .timing_xcheck2.log 2>&1
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("RAFT_TPU_BENCH_DEADLINE", str(time.time() + 1800))
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time()-T0:7.1f}s] {msg}", flush=True)
+
+
+def wall(fn, *args):
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _time_chained
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    dev = jax.devices()[0]
+    log(f"backend: {dev.platform} ({dev.device_kind})")
+
+    n, nq, d, k = 100_000, 1024, 128, 100
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
+    jax.block_until_ready((x, q))
+
+    full = jax.jit(lambda qq: fused_l2_knn(x, qq, k, impl="xla"))
+    dist_sum = jax.jit(
+        lambda qq: fused_l2_knn(x, qq, k, impl="xla")[0].sum())
+    both_sum = jax.jit(lambda qq: (
+        fused_l2_knn(x, qq, k, impl="xla")[0].sum()
+        + fused_l2_knn(x, qq, k, impl="xla")[1].sum()))
+
+    dt = wall(full, q)
+    log(f"wall full (d,i) out : {dt*1e3:9.1f} ms  {nq/dt:10,.0f} QPS")
+    dt = wall(dist_sum, q)
+    log(f"wall sum(d) only    : {dt*1e3:9.1f} ms  {nq/dt:10,.0f} QPS")
+    dt = wall(both_sum, q)
+    log(f"wall sum(d)+sum(i)  : {dt*1e3:9.1f} ms  {nq/dt:10,.0f} QPS")
+
+    def step_d(qq):
+        return fused_l2_knn(x, qq, k, impl="xla")[0]
+
+    def step_di(qq):
+        dd, ii = fused_l2_knn(x, qq, k, impl="xla")
+        return dd + ii.astype(dd.dtype)
+
+    dt = _time_chained(step_d, q, 2)
+    log(f"chained d-only      : {dt*1e3:9.1f} ms  {nq/dt:10,.0f} QPS")
+    dt = _time_chained(step_di, q, 2)
+    log(f"chained d+i live    : {dt*1e3:9.1f} ms  {nq/dt:10,.0f} QPS")
+
+
+if __name__ == "__main__":
+    main()
