@@ -38,6 +38,7 @@ metric set, ann_quantized_faiss.cuh:94-118).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import NamedTuple, Optional, Tuple
 
@@ -366,9 +367,11 @@ def ivf_pq_build(X, params: IVFPQParams,
     return idx
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "metric", "adc"))
 def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
-                       slot_centroid, cent_slots, q, k, nprobe, metric):
+                       slot_centroid, cent_slots, q, k, nprobe, metric,
+                       adc="gather"):
     M, ksub, dsub = codebooks.shape
     nlist = centroids.shape[0]
     nq = q.shape[0]
@@ -391,8 +394,31 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
     def step_dist(slx, pjx):
         lut = lut_all[jnp.arange(nq), pjx]             # (nq, M, ksub)
         codes = slot_codes[slx]                        # (nq, cap, M)
-        codes_t = jnp.transpose(codes, (0, 2, 1)).astype(jnp.int32)
-        dist = jnp.sum(jnp.take_along_axis(lut, codes_t, axis=-1), axis=1)
+        if adc == "onehot":
+            # LUT lookup as one-hot contractions: dist[n,c] =
+            # sum_m lut[n,m,codes[n,c,m]] = sum_m onehot(codes_m) .
+            # lut_m.  256x the FLOPs of the gather but fully
+            # vector/MXU-shaped, vs a per-element serial gather — the
+            # same trade as the kNN merge rewrite (tiled_knn.py); the
+            # bench compares both on hardware.  Static per-m loop keeps
+            # the one-hot transient at (nq, cap, ksub).
+            # padded codebook entries (build pads short codebooks with
+            # inf rows) make their LUT slots inf; the gather path never
+            # reads them, but here 0 * inf = NaN would poison every
+            # distance — zero them (codes never reference padded slots,
+            # so a zeroed slot contributes exactly nothing)
+            lut_f = jnp.where(jnp.isfinite(lut), lut, 0.0)
+            dist = jnp.zeros(codes.shape[:2], lut.dtype)
+            for m in range(M):
+                oh = jax.nn.one_hot(codes[:, :, m].astype(jnp.int32),
+                                    ksub, dtype=lut.dtype)
+                dist = dist + jnp.einsum("nck,nk->nc", oh,
+                                         lut_f[:, m, :],
+                                         precision="highest")
+        else:
+            codes_t = jnp.transpose(codes, (0, 2, 1)).astype(jnp.int32)
+            dist = jnp.sum(jnp.take_along_axis(lut, codes_t, axis=-1),
+                           axis=1)
         return dist, slot_ids[slx]
 
     return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
@@ -430,10 +456,16 @@ def ivf_pq_search(index: IVFPQIndex, queries, k: int,
     refine = ratio > 1 and index.vectors is not None
     metric = DistanceType(int(index.metric))
     k_search = k * ratio if refine else k
+    # ADC impl resolved at CALL time (a trace-time env read would pin
+    # the first value into the shape-keyed executable cache — the
+    # select_k caveat)
+    adc = os.environ.get("RAFT_TPU_PQ_ADC", "gather")
+    expects(adc in ("gather", "onehot"),
+            "ivf_pq_search: unknown RAFT_TPU_PQ_ADC %s", adc)
     out = _ivf_pq_search_jit(index.centroids, index.codebooks,
                              index.slot_codes, index.slot_ids,
                              index.slot_centroid, index.cent_slots,
-                             q, k_search, nprobe, metric)
+                             q, k_search, nprobe, metric, adc=adc)
     if refine:
         sqrt = metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded)
         out = _refine_jit(index.vectors, q, out[1], k, sqrt)

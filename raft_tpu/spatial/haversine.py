@@ -12,6 +12,7 @@ on the shared tile-scan driver (:mod:`raft_tpu.spatial.tiled_knn`).
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax.numpy as jnp
@@ -44,4 +45,6 @@ def haversine_knn(
     """
     expects(queries.ndim == 2 and queries.shape[1] == 2,
             "haversine distance requires 2 dimensions (latitude / longitude).")
-    return tiled_knn(index, queries, k, haversine_distances, tile_n=tile_n)
+    merge = os.environ.get("RAFT_TPU_TILE_MERGE", "tile_topk")
+    return tiled_knn(index, queries, k, haversine_distances, tile_n=tile_n,
+                     merge=merge)

@@ -11,7 +11,8 @@ computation overlaps tile t's selection.
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+import os
+from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -27,6 +28,7 @@ def tiled_knn(
     k: int,
     tile_dist: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     tile_n: int = 8192,
+    merge: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k best (smallest-distance) index rows per query.
 
@@ -34,10 +36,31 @@ def tiled_knn(
     distance tile; padding rows of the index are zeros and their distances
     are overridden to +inf here, so ``tile_dist`` need not handle them.
 
+    ``merge`` selects the per-tile selection strategy (env default
+    ``RAFT_TPU_TILE_MERGE``, read at TRACE time when merge is None —
+    jitted consumers cached by shape will not see later env changes,
+    the select_k executable-cache caveat; public wrappers resolve the
+    env at their own call sites and pass it explicitly):
+
+    - ``"tile_topk"`` (default): top-k the tile (impl-dispatched, see
+      :func:`~raft_tpu.spatial.select_k.top_k_rows`), then one 2k-wide
+      variadic sort merges it into the running top-k.
+    - ``"direct"``: no per-tile top-k — ONE variadic sort over the
+      (k + tile_n)-wide concatenation of running top-k and raw tile.
+      On backends where ``lax.top_k`` lowers to a full sort anyway
+      (TPU), this does the same lane width once instead of
+      sort(tile_n) + sort(2k); where top_k has a real partial
+      implementation, ``tile_topk`` wins.  The bench ladder measures
+      both on hardware.
+
     Returns (distances, indices): (n_queries, k) ascending, int32 ids.
     """
     n = index.shape[0]
     expects(0 < k <= n, "tiled_knn: k=%d out of range for n_index=%d", k, n)
+    if merge is None:
+        merge = os.environ.get("RAFT_TPU_TILE_MERGE", "tile_topk")
+    expects(merge in ("tile_topk", "direct"),
+            "tiled_knn: unknown merge %s", merge)
     nq = queries.shape[0]
     tile_n = max(k, min(tile_n, n))
     n_tiles = ceildiv(n, tile_n)
@@ -51,21 +74,30 @@ def tiled_knn(
         x_t = lax.dynamic_slice_in_dim(x_p, j0, tile_n, axis=0)
         v_t = lax.dynamic_slice_in_dim(valid, j0, tile_n, axis=0)
         d = jnp.where(v_t[None, :], tile_dist(queries, x_t), jnp.inf)
-        # wide tile selection dispatches impl (top_k vs the TPU
-        # approx_max_k instruction at recall 1.0 — see select_k module
-        # doc); the narrow 2k merge below stays lax.top_k
-        t_vals, t_idx = top_k_rows(-d, k)
-        t_idx = (j0 + t_idx).astype(jnp.int32)
-        # merge running and tile top-k: one variadic sort over the
-        # 2k-wide concatenation, indices carried as a sort operand.
+        if merge == "direct":
+            # one (k + tile_n)-wide variadic sort: raw tile + running
+            # top-k in a single pass (module doc)
+            cat_d = jnp.concatenate([best_d, d], axis=1)
+            # astype: under x64 the scanned tile_idx is int64 and would
+            # widen the id carry out of its int32 type
+            tid = (j0 + jnp.arange(tile_n)).astype(jnp.int32)[None, :]
+            cat_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(tid, d.shape)], axis=1)
+        else:
+            # wide tile selection dispatches impl (top_k vs the TPU
+            # approx_max_k instruction at recall 1.0 — see select_k
+            # module doc); the narrow 2k merge below stays a sort
+            t_vals, t_idx = top_k_rows(-d, k)
+            t_idx = (j0 + t_idx).astype(jnp.int32)
+            cat_d = jnp.concatenate([best_d, -t_vals], axis=1)
+            cat_i = jnp.concatenate([best_i, t_idx], axis=1)
+        # merge via variadic sort, indices carried as a sort operand.
         # NOT top_k + take_along_axis: the per-row gather lowers to a
         # serial scalar loop on TPU and dominated the whole scan
-        # (measured r4: ~94% of the 100k-shape wall time), while a
-        # 2k-lane variadic sort stays vector-shaped.  num_keys=2 makes
-        # the tie rule exactly lexicographic (distance, then smaller
-        # index) — the reference heap's insertion-order rule.
-        cat_d = jnp.concatenate([best_d, -t_vals], axis=1)
-        cat_i = jnp.concatenate([best_i, t_idx], axis=1)
+        # (measured r4: ~94% of the 100k-shape wall time), while the
+        # sort stays vector-shaped.  num_keys=2 makes the tie rule
+        # exactly lexicographic (distance, then smaller index) — the
+        # reference heap's insertion-order rule.
         m_d, m_i = lax.sort((cat_d, cat_i), dimension=1, num_keys=2)
         return (m_d[:, :k], m_i[:, :k]), None
 

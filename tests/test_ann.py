@@ -78,6 +78,34 @@ class TestIVFPQ:
         _, ref = brute(X, Q, 10)
         assert recall(np.asarray(ii), ref) >= 0.8
 
+    def test_adc_onehot_matches_gather(self, gauss, monkeypatch):
+        """The one-hot MXU formulation of the ADC scan must return the
+        same distances and ids as the LUT gather (RAFT_TPU_PQ_ADC)."""
+        X, Q = gauss
+        idx = approx_knn_build_index(
+            X, IVFPQParams(nlist=10, M=8, n_bits=8), D.L2Expanded)
+        d_g, i_g = approx_knn_search(idx, Q, k=10, nprobe=10)
+        monkeypatch.setenv("RAFT_TPU_PQ_ADC", "onehot")
+        d_o, i_o = approx_knn_search(idx, Q, k=10, nprobe=10)
+        np.testing.assert_allclose(np.asarray(d_g), np.asarray(d_o),
+                                   rtol=1e-4, atol=1e-4)
+        assert (np.asarray(i_g) == np.asarray(i_o)).mean() > 0.99
+
+    def test_adc_onehot_padded_codebooks(self, monkeypatch):
+        """m < 2**n_bits pads codebooks with inf rows; the one-hot ADC
+        einsum must not turn those into 0*inf = NaN distances
+        (code-review r4 finding)."""
+        rng = np.random.default_rng(9)
+        X = rng.normal(0, 1, (120, 16)).astype(np.float32)  # < 256 rows
+        Q = rng.normal(0, 1, (20, 16)).astype(np.float32)
+        idx = approx_knn_build_index(
+            X, IVFPQParams(nlist=4, M=8, n_bits=8), D.L2Expanded)
+        monkeypatch.setenv("RAFT_TPU_PQ_ADC", "onehot")
+        dd, ii = approx_knn_search(idx, Q, k=5, nprobe=4)
+        assert np.isfinite(np.asarray(dd)).all()
+        _, ref = brute(X, Q, 5)
+        assert recall(np.asarray(ii), ref) >= 0.8
+
     def test_refined_recall(self, gauss):
         X, Q = gauss
         idx = approx_knn_build_index(

@@ -161,6 +161,44 @@ class TestXlaPathsExportForTPU:
 
         self._export(lambda x, y: fused_l2_nn(x, y), (4096, 64), (4096, 64))
 
+    def test_tiled_knn_direct_merge(self):
+        """The r4 'direct' merge mode (single (k+tile_n)-wide variadic
+        sort per tile) must lower for tpu."""
+        def fn(x, q):
+            from raft_tpu.spatial.tiled_knn import tiled_knn
+
+            qn = jnp.sum(q * q, axis=1)
+
+            def tile_dist(qq, xt):
+                xn = jnp.sum(xt * xt, axis=1)
+                return (qn[:, None] + xn[None, :]
+                        - 2.0 * qq @ xt.T)
+
+            return tiled_knn(x, q, 100, tile_dist, merge="direct")
+
+        self._export(fn, (100_000, 128), (1024, 128))
+
+    def test_ivf_pq_adc_onehot(self):
+        """The r4 one-hot ADC formulation must lower for tpu (the
+        one_hot + einsum chain can promote under x64)."""
+        from raft_tpu.spatial.ann import _ivf_pq_search_jit
+        from raft_tpu.distance import DistanceType
+
+        nlist, M, ksub, dsub, cap, n_slots, nq = 16, 8, 256, 4, 64, 32, 64
+        d = M * dsub
+
+        def fn(centroids, codebooks, q):
+            slot_codes = jnp.zeros((n_slots, cap, M), jnp.int32)
+            slot_ids = jnp.zeros((n_slots, cap), jnp.int32)
+            slot_centroid = jnp.zeros((n_slots,), jnp.int32)
+            cent_slots = jnp.zeros((nlist, 2), jnp.int32)
+            return _ivf_pq_search_jit(
+                centroids, codebooks, slot_codes, slot_ids,
+                slot_centroid, cent_slots, q, 10, 4,
+                DistanceType.L2Expanded, adc="onehot")
+
+        self._export(fn, (nlist, d), (M, ksub, dsub), (nq, d))
+
     def test_select_k_approx(self):
         from raft_tpu.spatial.select_k import select_k
 

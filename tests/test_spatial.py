@@ -408,6 +408,38 @@ class TestSelectKImpl:
         got = np.take_along_axis(np.asarray(keys), np.asarray(i_c), 1)
         np.testing.assert_allclose(got, np.asarray(d_c), atol=1e-6)
 
+    def test_direct_merge_matches_tile_topk(self, monkeypatch):
+        """tiled_knn merge='direct' (single (k+tile_n)-wide sort) must
+        equal the default tile-topk merge exactly."""
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(rng.standard_normal((3000, 32)), jnp.float32)
+        q = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+        from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+        from raft_tpu.spatial.tiled_knn import tiled_knn
+
+        def tile_dist(qq, xt):
+            return jnp.sum((qq[:, None, :] - xt[None, :, :]) ** 2, -1)
+
+        d_t, i_t = tiled_knn(x, q, 10, tile_dist, tile_n=512,
+                             merge="tile_topk")
+        d_d, i_d = tiled_knn(x, q, 10, tile_dist, tile_n=512,
+                             merge="direct")
+        np.testing.assert_allclose(np.asarray(d_t), np.asarray(d_d),
+                                   rtol=1e-6)
+        assert (np.asarray(i_t) == np.asarray(i_d)).mean() > 0.999
+        # the env knob must reach the public entry: run BOTH settings
+        # (fresh shapes aren't needed — fused_l2_knn is untraced here,
+        # so each call re-reads the env)
+        d_e, i_e = fused_l2_knn(x, q, 10, tile_n=512, impl="xla")
+        monkeypatch.setenv("RAFT_TPU_TILE_MERGE", "direct")
+        d_v, i_v = fused_l2_knn(x, q, 10, tile_n=512, impl="xla")
+        np.testing.assert_allclose(np.asarray(d_e), np.asarray(d_v),
+                                   atol=1e-4)
+        assert (np.asarray(i_e) == np.asarray(i_v)).mean() > 0.999
+        monkeypatch.setenv("RAFT_TPU_TILE_MERGE", "bogus")
+        with pytest.raises(Exception):
+            fused_l2_knn(x, q, 10, tile_n=512, impl="xla")
+
     def test_chunked_int_keys_odd_merge_round(self):
         """Integer keys through a merge tree with an ODD chunk count
         (w=768, chunk=256 -> c=3): the odd-round pad sentinel is
