@@ -86,6 +86,7 @@ _SAFETY = 12.0          # parent prints this many seconds before the budget
 # reported truthfully)
 _OPERATOR_IMPL = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
 _OPERATOR_SELECT = os.environ.get("RAFT_TPU_SELECT_IMPL")
+_OPERATOR_MERGE = os.environ.get("RAFT_TPU_TILE_MERGE")
 
 
 def chip_peak_flops(device_kind, platform):
@@ -376,7 +377,8 @@ def _bench_pairwise(m, dim, iters, sqrt=False):
     }
 
 
-def _bench_knn(n_index, n_query, iters, impl, select_impl=None):
+def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
+               merge=None):
     from raft_tpu.spatial import brute_force_knn
 
     dim, k = 128, 100
@@ -384,12 +386,16 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None):
     queries = _rand((n_query, dim), 4)
     impl = _OPERATOR_IMPL or impl  # operator env pins win over the ladder
     select_impl = _OPERATOR_SELECT or select_impl
+    merge = _OPERATOR_MERGE or merge
     prev = {v: os.environ.get(v) for v in
-            ("RAFT_TPU_FUSED_KNN_IMPL", "RAFT_TPU_SELECT_IMPL")}
+            ("RAFT_TPU_FUSED_KNN_IMPL", "RAFT_TPU_SELECT_IMPL",
+             "RAFT_TPU_TILE_MERGE")}
     if impl:
         os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = impl
     if select_impl:
         os.environ["RAFT_TPU_SELECT_IMPL"] = select_impl
+    if merge:
+        os.environ["RAFT_TPU_TILE_MERGE"] = merge
 
     def step(q):
         # BOTH outputs folded into the returned array: the chained
@@ -415,6 +421,7 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None):
         "seconds_per_batch": round(dt, 4),
         "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
         "impl": impl or "xla", "select_impl": select_impl or "topk",
+        "merge": merge or "tile_topk",
         "mfu": _mfu(2.0 * n_query * n_index * dim, dt),
     }
 
@@ -874,18 +881,20 @@ def child_main():
         ]
     else:
         def best_select():
-            """chunked merge-tree vs fused pallas select vs top_k, per
-            measurement at 100k — the winner drives the 1M rung.
-            (approx@recall-1.0 was a fourth candidate in r4; measured
+            """chunked merge-tree vs fused pallas select vs top_k vs
+            the direct single-sort merge, per measurement at 100k — the
+            winner drives the 1M rung.  Returns (select_impl, merge).
+            (approx@recall-1.0 was a fifth candidate in r4; measured
             identical to top_k, so the rung was retired for the
             genuinely different formulations.)"""
             base = state.get("knn_100k", {}).get("qps", 0)
-            best, best_qps = None, base
-            for rung, impl in (("knn_100k_chunked", "chunked"),
-                               ("knn_100k_pselect", "pallas")):
+            best, best_qps = (None, None), base
+            for rung, cfg in (("knn_100k_chunked", ("chunked", None)),
+                              ("knn_100k_pselect", ("pallas", None)),
+                              ("knn_100k_direct", (None, "direct"))):
                 qps = state.get(rung, {}).get("qps", 0)
                 if qps > best_qps:
-                    best, best_qps = impl, qps
+                    best, best_qps = cfg, qps
             return best
 
         # ladder ordered by compile cost: the README 1k x 64 config
@@ -910,9 +919,12 @@ def child_main():
             ("knn_100k_pselect", 80 + 140,
              lambda: _bench_knn(100_000, 4096, 4, "xla",
                                 select_impl="pallas")),
+            ("knn_100k_direct", 60 + 140,
+             lambda: _bench_knn(100_000, 4096, 4, "xla",
+                                merge="direct")),
             ("knn_1m", 140,
              lambda: _bench_knn(1_000_000, 10_000, 3, "xla",
-                                select_impl=best_select())),
+                                *best_select())),
             ("pallas_check", 100, lambda: _bench_pallas(state)),
             ("knn_1m_pallas", 120, knn_pallas_1m),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),

@@ -390,6 +390,13 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
     lut_all = (jnp.sum(rs * rs, -1)[..., None] + cb_norms[None, None]
                - 2.0 * jnp.einsum("npmd,mkd->npmk", rs, codebooks,
                                   precision="highest"))  # (nq,np,M,ksub)
+    if adc == "onehot":
+        # padded codebook entries (build pads short codebooks with inf
+        # rows) make their LUT slots inf; the gather path never reads
+        # them, but the one-hot einsum would turn 0 * inf into NaN —
+        # sanitize ONCE, outside the slot scan (codes never reference
+        # padded slots, so a zeroed slot contributes exactly nothing)
+        lut_all = jnp.where(jnp.isfinite(lut_all), lut_all, 0.0)
 
     def step_dist(slx, pjx):
         lut = lut_all[jnp.arange(nq), pjx]             # (nq, M, ksub)
@@ -402,18 +409,13 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
             # same trade as the kNN merge rewrite (tiled_knn.py); the
             # bench compares both on hardware.  Static per-m loop keeps
             # the one-hot transient at (nq, cap, ksub).
-            # padded codebook entries (build pads short codebooks with
-            # inf rows) make their LUT slots inf; the gather path never
-            # reads them, but here 0 * inf = NaN would poison every
-            # distance — zero them (codes never reference padded slots,
-            # so a zeroed slot contributes exactly nothing)
-            lut_f = jnp.where(jnp.isfinite(lut), lut, 0.0)
+            # (lut_all was inf-sanitized above, once, outside the scan)
             dist = jnp.zeros(codes.shape[:2], lut.dtype)
             for m in range(M):
                 oh = jax.nn.one_hot(codes[:, :, m].astype(jnp.int32),
                                     ksub, dtype=lut.dtype)
                 dist = dist + jnp.einsum("nck,nk->nc", oh,
-                                         lut_f[:, m, :],
+                                         lut[:, m, :],
                                          precision="highest")
         else:
             codes_t = jnp.transpose(codes, (0, 2, 1)).astype(jnp.int32)

@@ -107,10 +107,4 @@ def fused_l2_knn(
             q, x_t.T, precision=precision)
         return jnp.maximum(d, 0.0)
 
-    # merge mode resolved HERE (not inside tiled_knn) so the env read
-    # sits at the same altitude as the impl resolution above — one
-    # level closer to the caller than the trace (executable-cache
-    # caveat: select_k module doc)
-    merge = os.environ.get("RAFT_TPU_TILE_MERGE", "tile_topk")
-    return tiled_knn(index, queries, k, tile_dist, tile_n=tile_n,
-                     merge=merge)
+    return tiled_knn(index, queries, k, tile_dist, tile_n=tile_n)

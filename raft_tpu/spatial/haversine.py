@@ -12,7 +12,6 @@ on the shared tile-scan driver (:mod:`raft_tpu.spatial.tiled_knn`).
 
 from __future__ import annotations
 
-import os
 from typing import Tuple
 
 import jax.numpy as jnp
@@ -45,6 +44,4 @@ def haversine_knn(
     """
     expects(queries.ndim == 2 and queries.shape[1] == 2,
             "haversine distance requires 2 dimensions (latitude / longitude).")
-    merge = os.environ.get("RAFT_TPU_TILE_MERGE", "tile_topk")
-    return tiled_knn(index, queries, k, haversine_distances, tile_n=tile_n,
-                     merge=merge)
+    return tiled_knn(index, queries, k, haversine_distances, tile_n=tile_n)

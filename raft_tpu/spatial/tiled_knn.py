@@ -39,8 +39,8 @@ def tiled_knn(
     ``merge`` selects the per-tile selection strategy (env default
     ``RAFT_TPU_TILE_MERGE``, read at TRACE time when merge is None —
     jitted consumers cached by shape will not see later env changes,
-    the select_k executable-cache caveat; public wrappers resolve the
-    env at their own call sites and pass it explicitly):
+    the select_k executable-cache caveat; pass ``merge`` explicitly to
+    pin it):
 
     - ``"tile_topk"`` (default): top-k the tile (impl-dispatched, see
       :func:`~raft_tpu.spatial.select_k.top_k_rows`), then one 2k-wide

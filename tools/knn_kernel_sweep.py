@@ -18,6 +18,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(REPO, ".jax_cache"))
+# bench._time_chained budgets itself against the bench deadline env —
+# standalone runs get a generous one
+os.environ.setdefault("RAFT_TPU_BENCH_DEADLINE", str(time.time() + 3600))
 
 T0 = time.time()
 
@@ -46,19 +49,53 @@ def main():
     jax.block_until_ready((x, q))
 
     def xla_step(qq):
-        return fused_l2_knn(x, qq, k, impl="xla")[0]
+        # indices folded in: a d-only step lets XLA dead-code the index
+        # half of the selection inside the chained loop (bench.py
+        # _time_chained caller contract)
+        d2, i2 = fused_l2_knn(x, qq, k, impl="xla")
+        return d2 + i2.astype(d2.dtype)
 
     dt = _time_chained(xla_step, q, 2)
     emit({"config": "xla_scan", "seconds_per_batch": round(dt, 4),
           "qps": round(nq / dt, 1)})
 
+    # XLA-path merge/select variants (same honest step shape)
+    for name, kw in (("xla_direct", {"merge": "direct"}),
+                     ("xla_chunked", {"select": "chunked"}),
+                     ("xla_pselect", {"select": "pallas"})):
+        def vstep(qq, kw=kw):
+            prev = {v: os.environ.get(v) for v in
+                    ("RAFT_TPU_TILE_MERGE", "RAFT_TPU_SELECT_IMPL")}
+            if kw.get("merge"):
+                os.environ["RAFT_TPU_TILE_MERGE"] = kw["merge"]
+            if kw.get("select"):
+                os.environ["RAFT_TPU_SELECT_IMPL"] = kw["select"]
+            try:
+                d, i = fused_l2_knn(x, qq, k, impl="xla")
+            finally:
+                for var, val in prev.items():
+                    if val is None:
+                        os.environ.pop(var, None)
+                    else:
+                        os.environ[var] = val
+            return d + i.astype(d.dtype)
+        try:
+            dt = _time_chained(vstep, q, 2)
+            emit({"config": name, "seconds_per_batch": round(dt, 4),
+                  "qps": round(nq / dt, 1)})
+        except Exception as e:
+            emit({"config": name, "error": str(e)[-200:]})
+            if "UNAVAILABLE" in str(e):
+                return
+
     for merge in ("merge", "fullsort"):
         for bq in (64, 128, 256):
             for bn in (1024, 2048):
                 def step(qq, merge=merge, bq=bq, bn=bn):
-                    return fused_knn_tile(x, qq, k, block_q=bq,
+                    d, i = fused_knn_tile(x, qq, k, block_q=bq,
                                           block_n=bn,
-                                          merge_impl=merge)[0]
+                                          merge_impl=merge)
+                    return d + i.astype(d.dtype)
                 try:
                     t0 = time.time()
                     dt = _time_chained(step, q, 2)

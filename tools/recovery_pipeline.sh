@@ -19,15 +19,15 @@
 # Budget policy: the driver's round-end bench must find a free
 # endpoint and a warm compile cache, never a colliding client.  Full
 # budget only while the session has comfortable headroom (before
-# ~10:30 local); later recoveries get a short warm-the-top-rungs run;
-# past 11:30 the pipeline stands down entirely.
+# ~13:00 local, this session runs 03:14-15:14); later recoveries get a short warm-the-top-rungs run;
+# past 14:15 the pipeline stands down entirely.
 cd /root/repo
 LOG=.recovery.log
 echo "=== pipeline start $(date +%H:%M:%S) ===" >> "$LOG"
 while true; do
   NOW=$(date +%H%M)
-  if [ "$NOW" -ge 1130 ] && [ "$NOW" -lt 2300 ]; then
-    echo "$(date +%H:%M:%S) past 11:30 — stand down for the driver" >> "$LOG"
+  if [ "$NOW" -ge 1415 ] && [ "$NOW" -lt 2300 ]; then
+    echo "$(date +%H:%M:%S) past 14:15 — stand down for the driver" >> "$LOG"
     exit 0
   fi
   timeout 900 python tools/tpu_probe.py >> "$LOG" 2>&1
@@ -40,20 +40,26 @@ done
 echo "=== BACKEND UP $(date +%H:%M:%S) ===" >> "$LOG"
 
 NOW=$(date +%H%M)
-if [ "$NOW" -ge 1030 ] && [ "$NOW" -lt 2300 ]; then BUDGET=600; else BUDGET=2700; fi
+if [ "$NOW" -ge 1300 ] && [ "$NOW" -lt 2300 ]; then BUDGET=600; else BUDGET=2700; fi
 echo "=== full bench (budget $BUDGET) ===" >> "$LOG"
 RAFT_TPU_BENCH_BUDGET=$BUDGET python bench.py > .bench_r04_final.json \
   2> .bench_r04_final.err
 echo "bench rc=$? at $(date +%H:%M:%S)" >> "$LOG"
 
+# tool deadline pinned to the 14:15 stand-down wall clock (minus a
+# 10-min drain) so a tool started late can never hold the endpoint
+# into the driver's round-end window — tools honor
+# RAFT_TPU_BENCH_DEADLINE via bench._time_chained and only setdefault
+# their own
+export RAFT_TPU_BENCH_DEADLINE=$(date -d "14:05" +%s)
 NOW=$(date +%H%M)
-if [ "$NOW" -lt 1100 ]; then
+if [ "$NOW" -lt 1345 ]; then
   echo "=== knn_kernel_sweep ===" >> "$LOG"
   python tools/knn_kernel_sweep.py > .knn_sweep.log 2>&1
   echo "knn_kernel_sweep rc=$? at $(date +%H:%M:%S)" >> "$LOG"
 fi
 NOW=$(date +%H%M)
-if [ "$NOW" -lt 1100 ]; then
+if [ "$NOW" -lt 1345 ]; then
   echo "=== select_variants ===" >> "$LOG"
   python tools/select_variants.py > .select_variants.log 2>&1
   echo "select_variants rc=$? at $(date +%H:%M:%S)" >> "$LOG"
