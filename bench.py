@@ -48,6 +48,7 @@ only because BASELINE.md defines the north star that way (the reference
 publishes no numbers).
 """
 
+import contextlib
 import json
 import os
 import subprocess
@@ -87,6 +88,33 @@ _SAFETY = 12.0          # parent prints this many seconds before the budget
 _OPERATOR_IMPL = os.environ.get("RAFT_TPU_FUSED_KNN_IMPL")
 _OPERATOR_SELECT = os.environ.get("RAFT_TPU_SELECT_IMPL")
 _OPERATOR_MERGE = os.environ.get("RAFT_TPU_TILE_MERGE")
+
+
+# gRPC-status tokens of a dead/hung device — matched against the
+# exception MESSAGE only (a full traceback mentions benign words
+# like "backend" in rendered source lines of ordinary bugs)
+_DEAD_SIGNS = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+               "Unable to initialize backend")
+
+
+@contextlib.contextmanager
+def _env_pins(pins):
+    """Temporarily set env vars (None values = leave unset), restoring
+    previous values on exit.  Single owner of the save/mutate/restore
+    dance — exceptions propagate (a dead-device error must reach
+    child_main's consecutive_dead abort, not be swallowed mid-pin)."""
+    prev = {v: os.environ.get(v) for v in pins}
+    for var, val in pins.items():
+        if val is not None:
+            os.environ[var] = val
+    try:
+        yield
+    finally:
+        for var, val in prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
 
 
 def chip_peak_flops(device_kind, platform):
@@ -387,16 +415,6 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
     impl = _OPERATOR_IMPL or impl  # operator env pins win over the ladder
     select_impl = _OPERATOR_SELECT or select_impl
     merge = _OPERATOR_MERGE or merge
-    prev = {v: os.environ.get(v) for v in
-            ("RAFT_TPU_FUSED_KNN_IMPL", "RAFT_TPU_SELECT_IMPL",
-             "RAFT_TPU_TILE_MERGE")}
-    if impl:
-        os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = impl
-    if select_impl:
-        os.environ["RAFT_TPU_SELECT_IMPL"] = select_impl
-    if merge:
-        os.environ["RAFT_TPU_TILE_MERGE"] = merge
-
     def step(q):
         # BOTH outputs folded into the returned array: the chained
         # timing loop keeps only what the step returns live, and XLA
@@ -406,14 +424,10 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
         dists, idx = brute_force_knn([index], q, k)
         return dists + idx.astype(dists.dtype)
 
-    try:
+    with _env_pins({"RAFT_TPU_FUSED_KNN_IMPL": impl or None,
+                    "RAFT_TPU_SELECT_IMPL": select_impl or None,
+                    "RAFT_TPU_TILE_MERGE": merge or None}):
         dt = _time_chained(step, queries, iters)
-    finally:
-        for var, val in prev.items():
-            if val is None:
-                os.environ.pop(var, None)
-            else:
-                os.environ[var] = val
     qps = n_query / dt
     return {
         "qps": round(qps, 1),
@@ -559,18 +573,9 @@ def _bench_knn_recall95(n_index, n_query, iters):
     # regardless — r4 code-review finding)
     index = _rand((n_index, 128), 3)
     probe = _rand((n_query, 128), 4)[:256]
-    prev = {v: os.environ.get(v) for v in
-            ("RAFT_TPU_FUSED_KNN_IMPL", "RAFT_TPU_SELECT_IMPL")}
-    os.environ["RAFT_TPU_FUSED_KNN_IMPL"] = "xla"
-    os.environ["RAFT_TPU_SELECT_IMPL"] = "approx95"
-    try:
+    with _env_pins({"RAFT_TPU_FUSED_KNN_IMPL": "xla",
+                    "RAFT_TPU_SELECT_IMPL": "approx95"}):
         _, i_fast = brute_force_knn([index], probe, 100)
-    finally:
-        for var, val in prev.items():
-            if val is None:
-                os.environ.pop(var, None)
-            else:
-                os.environ[var] = val
     _, i_ref = brute_force_knn([index], probe, 100)
     i_fast, i_ref = np.asarray(i_fast), np.asarray(i_ref)
     out["recall_at_k_vs_exact"] = round(float(np.mean([
@@ -605,7 +610,8 @@ def _bench_fused_nn(n, n_centroids, dim, iters):
     }
 
 
-def _bench_ivf(n_index, n_query, iters, build, search, params):
+def _bench_ivf(n_index, n_query, iters, build, search, params,
+               alt_env=None):
     """Shared IVF rung driver: build once (untimed), timed search, and
     recall@10 against brute force on a probe slice — throughput without
     recall is not an ANN benchmark.  Index and queries split from ONE
@@ -644,8 +650,67 @@ def _bench_ivf(n_index, n_query, iters, build, search, params):
         "k": k, "nprobe": nprobe,
         "recall_at_10_vs_exact": round(recall, 4),
     }
+    if alt_env:
+        # re-time the SAME built index under alternative env pins (e.g.
+        # the PQ ADC impls) — the hardware picks defaults, not
+        # intuition.  A failed alt pass is recorded without forfeiting
+        # the rung's headline result — EXCEPT dead-device errors, which
+        # must propagate to child_main's consecutive_dead abort, not be
+        # recorded as a note while later rungs burn the budget against
+        # a dead channel.
+        for tag, pins in alt_env.items():
+            try:
+                with _env_pins(pins):
+                    dt_a = _time_chained(step, queries, iters)
+                out[tag + "_qps"] = round(n_query / dt_a, 1)
+            except Exception as e:
+                if any(s in str(e) for s in _DEAD_SIGNS):
+                    raise
+                out[tag + "_error"] = traceback.format_exc()[-300:]
     out.update(params)
     return out
+
+
+def _bench_sparse_pairwise(m, n_cols, nnz_row, iters, batch_size_k):
+    """Sparse CSR pairwise L2 on the column-tiled engine (the
+    load-balanced-SpMV-regime analog, sparse/distance/detail/
+    coo_spmv.cuh:49,106) — the engine landed in r4 with correctness
+    tests but no perf evidence.  ``batch_size_k`` is passed EXPLICITLY
+    (n_cols/batch_size_k col tiles) so the multi-tile accumulation path
+    is what gets timed — the auto heuristic at this shape would pick a
+    single full-width tile and certify a path that never ran."""
+    import numpy as np
+
+    from raft_tpu.distance import DistanceType
+    from raft_tpu.sparse.distance import pairwise_distance as spd
+    from raft_tpu.sparse.formats import CSR
+
+    def make(rows, seed):
+        r = np.random.default_rng(seed)
+        # stratified columns: unique + sorted per row by construction
+        stride = n_cols // nnz_row
+        cols = (np.arange(nnz_row)[None, :] * stride
+                + r.integers(0, stride, (rows, nnz_row))).ravel()
+        indptr = (np.arange(rows + 1) * nnz_row).astype(np.int32)
+        data = r.random(rows * nnz_row).astype(np.float32) + 0.1
+        return CSR(indptr, cols.astype(np.int32), data, (rows, n_cols))
+
+    ca = make(m, 22)
+    cb = make(m, 23)
+
+    def step(dat):
+        return spd(CSR(ca.indptr, ca.indices, dat, ca.shape), cb,
+                   DistanceType.L2Expanded, batch_size_k=batch_size_k)
+
+    dt = _time_chained(step, ca.data, iters)
+    return {
+        "gpairs_per_sec": round(m * m / dt / 1e9, 4),
+        "seconds_per_call": round(dt, 4),
+        "m": m, "n_cols": n_cols, "nnz_per_row": nnz_row,
+        "n_col_tiles": -(-n_cols // batch_size_k),
+        "engine": "column-tiled (explicit batch_size_k=%d)"
+                  % batch_size_k,
+    }
 
 
 def _bench_ivf_flat(n_index, n_query, iters):
@@ -673,7 +738,9 @@ def _bench_ivf_pq(n_index, n_query, iters):
         build=lambda X: ivf_pq_build(
             X, IVFPQParams(nlist=nlist, M=M, refine_ratio=refine)),
         search=ivf_pq_search,
-        params={"nlist": nlist, "M": M, "refine_ratio": refine})
+        params={"nlist": nlist, "M": M, "refine_ratio": refine},
+        # same built index re-timed under the one-hot ADC contraction
+        alt_env={"onehot_adc": {"RAFT_TPU_PQ_ADC": "onehot"}})
 
 
 def _bench_ivf_sq(n_index, n_query, iters):
@@ -936,20 +1003,23 @@ def child_main():
              lambda: _bench_fused_nn(1_000_000, 1024, 64, 4)),
             ("ivf_flat_100k", 90,
              lambda: _bench_ivf_flat(100_000, 4096, 4)),
-            ("ivf_pq_100k", 90,
+            # est covers the onehot-ADC alt pass too (second compile +
+            # timing chain on the same built index)
+            ("ivf_pq_100k", 170,
              lambda: _bench_ivf_pq(100_000, 4096, 4)),
             ("ivf_sq_100k", 90,
              lambda: _bench_ivf_sq(100_000, 4096, 4)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),
+            # m=2048 keeps the coltiled dense cross-term near 0.3 Pflop
+            # per call (f32-highest: ~10-15 s) so the 5+ calls of a
+            # chained timing fit the gate; 4 real col tiles
+            ("sparse_pairwise", 150,
+             lambda: _bench_sparse_pairwise(2048, 32768, 16, 2, 8192)),
         ]
 
-    # gRPC-status tokens of a dead/hung device — matched against the
-    # exception MESSAGE only (a full traceback mentions benign words
-    # like "backend" in rendered source lines of ordinary bugs)
-    dead_signs = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
-                  "Unable to initialize backend")
+    dead_signs = _DEAD_SIGNS
     consecutive_dead = 0
     for idx, (name, est, fn) in enumerate(rungs):
         if _remaining() < est:
