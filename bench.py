@@ -1012,10 +1012,10 @@ def child_main():
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),
-            # m=2048 keeps the coltiled dense cross-term near 0.3 Pflop
-            # per call (f32-highest: ~10-15 s) so the 5+ calls of a
-            # chained timing fit the gate; 4 real col tiles
-            ("sparse_pairwise", 150,
+            # 2*2048^2*32768 = 0.27 Tflop per call (~10 ms-scale on
+            # chip) — est covers compile + the chained timing, not the
+            # math; 4 real col tiles
+            ("sparse_pairwise", 60,
              lambda: _bench_sparse_pairwise(2048, 32768, 16, 2, 8192)),
         ]
 

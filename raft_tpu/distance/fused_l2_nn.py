@@ -104,7 +104,9 @@ def fused_l2_nn_min_reduce(
         if sqrt:
             d = jnp.sqrt(d)
         t_idx = jnp.argmin(d, axis=1)
-        t_val = jnp.take_along_axis(d, t_idx[:, None], axis=1)[:, 0]
+        # row-min, NOT take_along_axis(argmin): the per-row gather
+        # lowers to a serial scalar loop on TPU (r4 tile-merge finding)
+        t_val = jnp.min(d, axis=1)
         cand = (t_val, (j0 + t_idx).astype(jnp.int32))
         return rop(carry, cand), None
 

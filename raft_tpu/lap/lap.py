@@ -44,7 +44,9 @@ def _auction_round(cost, eps, state):
 
     value = -(cost + price[None, :])  # row i's value for col j (maximize)
     best_j = jnp.argmax(value, axis=1)
-    best_v = jnp.take_along_axis(value, best_j[:, None], axis=1)[:, 0]
+    # row-max, NOT take_along_axis(argmax): the per-row gather lowers
+    # to a serial scalar loop on TPU (r4 tile-merge finding)
+    best_v = jnp.max(value, axis=1)
     masked = value.at[jnp.arange(cost.shape[0]), best_j].set(-jnp.inf)
     second_v = jnp.max(masked, axis=1)
     second_v = jnp.where(jnp.isfinite(second_v), second_v, best_v - eps)

@@ -234,9 +234,12 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
     prank = jnp.broadcast_to(
         jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_slots)[None],
         slots.shape)
-    order = jnp.argsort(slots < 0, axis=1, stable=True)      # valid first
-    slots = jnp.take_along_axis(slots, order, axis=1)
-    prank = jnp.take_along_axis(prank, order, axis=1)
+    # valid-first compaction as ONE stable variadic sort (slots/prank
+    # ride as operands) — argsort + two take_along_axis would be serial
+    # per-row gathers on TPU (r4 tile-merge finding)
+    _, slots, prank = lax.sort(
+        ((slots < 0).astype(jnp.int32), slots, prank), dimension=1,
+        num_keys=1, is_stable=True)
     n_live = jnp.max(jnp.sum(slots >= 0, axis=1))
 
     dt = jnp.result_type(q.dtype, jnp.float32)

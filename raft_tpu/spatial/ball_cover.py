@@ -148,8 +148,10 @@ def _rbc_query_jit(X, landmarks, groups, radius, q, k, metric):
                                      precision="highest"))
             dd = jnp.sqrt(jnp.maximum(dd, 0.0))
         dd = jnp.where(gids >= 0, dd, worst)
-        bd, bl = select_k(dd, min(k, gmax), select_min=True)
-        bi = jnp.take_along_axis(gids, bl, axis=1)
+        # gids carried as the selection payload (variadic sort path) —
+        # a select-then-take_along_axis gather is a serial scalar loop
+        # on TPU (r4 tile-merge finding)
+        bd, bi = select_k(dd, min(k, gmax), select_min=True, values=gids)
         if bd.shape[1] < k:
             pad = k - bd.shape[1]
             bd = jnp.pad(bd, ((0, 0), (0, pad)), constant_values=worst)

@@ -86,8 +86,10 @@ def _kmeans_jit(X, k, tol, max_iter, seed):
             return labels, jnp.sum(vals)
         dm = _sq_dists(X, C, xn)
         labels = jnp.argmin(dm, axis=1).astype(jnp.int32)
-        residual = jnp.sum(jnp.take_along_axis(dm, labels[:, None],
-                                               axis=1)[:, 0])
+        # row-min, NOT take_along_axis(labels): the per-row gather
+        # lowers to a serial scalar loop on TPU (r4 tile-merge finding)
+        # and min(dm) is by definition the labeled entry
+        residual = jnp.sum(jnp.min(dm, axis=1))
         return labels, residual
 
     def update(C, labels):
