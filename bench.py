@@ -945,6 +945,10 @@ def child_main():
             ("linalg_bundle", 30, lambda: _bench_linalg_bundle(1024, 2)),
             ("knn_100k", 70, lambda: _bench_knn(100_000, 512, 2, "xla")),
             ("spectral", 40, _bench_spectral),
+            # scaled-down column-tiled sparse engine evidence even on a
+            # no-hardware round
+            ("sparse_pairwise", 40,
+             lambda: _bench_sparse_pairwise(512, 32768, 16, 2, 8192)),
         ]
     else:
         def best_select():

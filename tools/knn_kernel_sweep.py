@@ -43,7 +43,12 @@ def main():
     from raft_tpu.ops.knn_tile import fused_knn_tile
     from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
 
-    n, nq, d, k = 100_000, 1024, 128, 100
+    # RAFT_TPU_SWEEP_SMOKE=1: tiny shapes for a hardware-free wiring
+    # check of every variant path (the numbers are meaningless)
+    if os.environ.get("RAFT_TPU_SWEEP_SMOKE") == "1":
+        n, nq, d, k = 5_000, 128, 64, 50
+    else:
+        n, nq, d, k = 100_000, 1024, 128, 100
     x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
     q = jax.random.normal(jax.random.PRNGKey(1), (nq, d), jnp.float32)
     jax.block_until_ready((x, q))
@@ -59,10 +64,17 @@ def main():
     emit({"config": "xla_scan", "seconds_per_batch": round(dt, 4),
           "qps": round(nq / dt, 1)})
 
-    # XLA-path merge/select variants (same honest step shape)
+    # XLA-path merge/select variants (same honest step shape);
+    # tile_n scan rides on the winner question too
     for name, kw in (("xla_direct", {"merge": "direct"}),
                      ("xla_chunked", {"select": "chunked"}),
-                     ("xla_pselect", {"select": "pallas"})):
+                     ("xla_pselect", {"select": "pallas"}),
+                     ("xla_tile4k", {"tile_n": 4096}),
+                     ("xla_tile16k", {"tile_n": 16384}),
+                     ("xla_direct_tile4k",
+                      {"merge": "direct", "tile_n": 4096}),
+                     ("xla_chunked_tile16k",
+                      {"select": "chunked", "tile_n": 16384})):
         def vstep(qq, kw=kw):
             prev = {v: os.environ.get(v) for v in
                     ("RAFT_TPU_TILE_MERGE", "RAFT_TPU_SELECT_IMPL")}
@@ -70,8 +82,12 @@ def main():
                 os.environ["RAFT_TPU_TILE_MERGE"] = kw["merge"]
             if kw.get("select"):
                 os.environ["RAFT_TPU_SELECT_IMPL"] = kw["select"]
+            # tile_n passed ONLY when the variant pins it, so the other
+            # variants track fused_l2_knn's default and the comparison
+            # never hides a tile_n difference
+            tn = {"tile_n": kw["tile_n"]} if "tile_n" in kw else {}
             try:
-                d, i = fused_l2_knn(x, qq, k, impl="xla")
+                d, i = fused_l2_knn(x, qq, k, impl="xla", **tn)
             finally:
                 for var, val in prev.items():
                     if val is None:
