@@ -166,7 +166,7 @@ def assemble(tpu_state, cpu_state):
 
     knn_1m = _best_knn(tpu_state, "knn_1m", "knn_1m_pallas")
     knn_100k = _best_knn(tpu_state, "knn_100k", "knn_100k_chunked",
-                         "knn_100k_pselect")
+                         "knn_100k_pselect", "knn_100k_direct")
     pw = None
     for name in ("pairwise_8k", "pairwise_2k", "pairwise_1k"):
         cand = tpu_state.get(name)
@@ -406,7 +406,7 @@ def _bench_pairwise(m, dim, iters, sqrt=False):
 
 
 def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
-               merge=None):
+               merge=None, wall_check=False):
     from raft_tpu.spatial import brute_force_knn
 
     dim, k = 128, 100
@@ -428,8 +428,23 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
                     "RAFT_TPU_SELECT_IMPL": select_impl or None,
                     "RAFT_TPU_TILE_MERGE": merge or None}):
         dt = _time_chained(step, queries, iters)
+        wall = None
+        if wall_check:
+            # wall-clock cross-check: one plain timed call of the
+            # jitted step.  After the r4 dead-code findings, chained
+            # and wall must agree within dispatch overhead — a large
+            # ratio in a report is the red flag that something is being
+            # optimized away again.  Headline rungs only: the check
+            # costs one extra compile.
+            import jax
+
+            jstep = jax.jit(step)
+            jax.block_until_ready(jstep(queries))    # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(jstep(queries))
+            wall = time.perf_counter() - t0
     qps = n_query / dt
-    return {
+    out = {
         "qps": round(qps, 1),
         "qps_1m_equiv": round(qps * n_index / 1_000_000, 1),
         "seconds_per_batch": round(dt, 4),
@@ -438,6 +453,9 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
         "merge": merge or "tile_topk",
         "mfu": _mfu(2.0 * n_query * n_index * dim, dt),
     }
+    if wall is not None:
+        out["wall_seconds_per_batch"] = round(wall, 4)
+    return out
 
 
 def _bench_pallas(state):
@@ -980,7 +998,9 @@ def child_main():
                                                         sqrt=True)),
             ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 8)),
             ("linalg_bundle", 40, lambda: _bench_linalg_bundle(4096, 8)),
-            ("knn_100k", 80, lambda: _bench_knn(100_000, 4096, 4, "xla")),
+            ("knn_100k", 80 + 40,
+             lambda: _bench_knn(100_000, 4096, 4, "xla",
+                                wall_check=True)),
             # gate = its own cost (60) PLUS the 1M rung's (140): the
             # comparison rungs must never consume the budget that would
             # otherwise let the north-star headline run
@@ -993,9 +1013,9 @@ def child_main():
             ("knn_100k_direct", 60 + 140,
              lambda: _bench_knn(100_000, 4096, 4, "xla",
                                 merge="direct")),
-            ("knn_1m", 140,
+            ("knn_1m", 140 + 60,
              lambda: _bench_knn(1_000_000, 10_000, 3, "xla",
-                                *best_select())),
+                                *best_select(), wall_check=True)),
             ("pallas_check", 100, lambda: _bench_pallas(state)),
             ("knn_1m_pallas", 120, knn_pallas_1m),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
