@@ -611,21 +611,40 @@ def _bench_fused_nn(n, n_centroids, dim, iters):
     x = _rand((n, dim), 13)
     c = _rand((n_centroids, dim), 14)
 
-    def step(a):
-        # tile_n=512: the exact configuration the kmeans large-k
-        # assignment runs (kmeans.py assign), so this rung measures the
-        # real IVF coarse-assign op, not a different block size
-        # argmin ids folded in: see _bench_knn on dead-coding
-        vals, ids = fused_l2_nn(a, c, tile_n=512)
-        return vals + ids.astype(vals.dtype)
+    def make_step(impl):
+        def step(a):
+            # tile_n=512: the exact configuration the kmeans large-k
+            # assignment runs (kmeans.py assign), so this rung measures
+            # the real IVF coarse-assign op, not a different block
+            # size.  argmin ids folded in: see _bench_knn.
+            vals, ids = fused_l2_nn(a, c, tile_n=512, impl=impl)
+            return vals + ids.astype(vals.dtype)
+        return step
 
-    dt = _time_chained(step, x, iters)
-    return {
+    dt = _time_chained(make_step(None), x, iters)
+    out = {
         "seconds_per_call": round(dt, 4),
         "n": n, "n_centroids": n_centroids, "dim": dim,
         "assigns_per_sec": round(n / dt, 1),
+        "impl": "auto (pallas on TPU, xla elsewhere)",
         "mfu": _mfu(2.0 * n * n_centroids * dim, dt),
     }
+    # both impls timed ON TPU only (elsewhere auto IS xla and the
+    # second chain would time the same impl twice): the 1-NN kernel has
+    # no steady-state comparison yet (the kNN kernel's r4 lesson:
+    # measure, don't assume)
+    from raft_tpu.core.utils import is_tpu_backend
+
+    if is_tpu_backend():
+        try:
+            dt_x = _time_chained(make_step("xla"), x, iters)
+            out["xla_seconds_per_call"] = round(dt_x, 4)
+            out["xla_assigns_per_sec"] = round(n / dt_x, 1)
+        except Exception as e:
+            if any(s in str(e) for s in _DEAD_SIGNS):
+                raise
+            out["xla_error"] = traceback.format_exc()[-300:]
+    return out
 
 
 def _bench_ivf(n_index, n_query, iters, build, search, params,
@@ -1023,7 +1042,8 @@ def child_main():
              lambda: _bench_knn_bf16(100_000, 4096, 4)),
             ("knn_100k_recall95", 60,
              lambda: _bench_knn_recall95(100_000, 4096, 4)),
-            ("fused_nn_1m", 60,
+            # est covers the TPU-only xla comparison chain too
+            ("fused_nn_1m", 120,
              lambda: _bench_fused_nn(1_000_000, 1024, 64, 4)),
             ("ivf_flat_100k", 90,
              lambda: _bench_ivf_flat(100_000, 4096, 4)),
@@ -1041,6 +1061,11 @@ def child_main():
             # math; 4 real col tiles
             ("sparse_pairwise", 60,
              lambda: _bench_sparse_pairwise(2048, 32768, 16, 2, 8192)),
+            # scale headroom: 10x the north star (5 GB index in HBM),
+            # informational tail rung on the measured winner config
+            ("knn_10m", 200,
+             lambda: _bench_knn(10_000_000, 2048, 2, "xla",
+                                *best_select())),
         ]
 
     dead_signs = _DEAD_SIGNS

@@ -19,9 +19,12 @@ TPU re-design, two implementations sharing the same contract:
   (:mod:`raft_tpu.ops.knn_tile`) — distance tile and running top-k both
   VMEM-resident, threshold-gated bitonic merge, the true analog of the
   reference's one-kernel design.
-- ``impl=None`` (default): "pallas" on a real TPU backend, "xla"
-  elsewhere (the Pallas interpreter is orders of magnitude slower than
-  XLA CPU, so interpret-mode is for tests only).
+- ``impl=None`` (default): "xla" everywhere as of r4 — the one honest
+  steady-state measurement (100k×1024q k=100, v5e) put the tile-scan
+  at 1.74 s vs the fused kernel's 4.01 s, so the default follows the
+  evidence until `tools/knn_kernel_sweep.py` finds a winning kernel
+  geometry (docs/TUNING.md "Open question").  Opt into the kernel with
+  ``impl="pallas"`` / ``RAFT_TPU_FUSED_KNN_IMPL=pallas``.
 
 Like the reference kernel, returned distances are *squared* L2; the sqrt
 fixup for L2Sqrt metrics is the caller's postprocess step
@@ -37,7 +40,6 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
-from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.spatial.tiled_knn import tiled_knn
 
 
@@ -76,23 +78,24 @@ def fused_l2_knn(
             "fused_l2_knn: shape mismatch")
     requested = impl or os.environ.get("RAFT_TPU_FUSED_KNN_IMPL") or None
     if impl is None:
-        impl = requested or ("pallas" if is_tpu_backend() else "xla")
+        # r4: "xla" on every backend — the measured default (module doc)
+        impl = requested or "xla"
     expects(impl in ("xla", "pallas"),
             "fused_l2_knn: unknown impl %s", impl)
-    if impl == "pallas" and k > 128:
-        # the fused kernel's merge is a bitonic network over 2*kpad
-        # lanes; beyond kpad=128 the unrolled network blows up Mosaic
-        # compile time (measured: minutes at kpad=256 on v5e).  The
-        # reference draws the same line even tighter — fusedL2Knn serves
-        # only k <= 64 and larger k falls back to the general path
-        # (knn_brute_force_faiss.cuh:297-313).  Auto-selection falls
-        # back to the XLA tile-scan impl; an *explicit* pallas request
-        # (arg or env) errors rather than silently running another impl.
-        expects(requested != "pallas",
+    if impl == "pallas":
+        # impl == "pallas" now implies an explicit request (arg or env;
+        # auto-dispatch picks "xla" as of r4).  The kernel's merge is a
+        # bitonic network over 2*kpad lanes; beyond kpad=128 the
+        # unrolled network blows up Mosaic compile time (measured:
+        # minutes at kpad=256 on v5e).  The reference draws the same
+        # line even tighter — fusedL2Knn serves only k <= 64 and larger
+        # k falls back to the general path
+        # (knn_brute_force_faiss.cuh:297-313).  An explicit pallas
+        # request errors rather than silently running another impl.
+        expects(k <= 128,
                 "fused_l2_knn: impl='pallas' supports k <= 128 (bitonic "
                 "merge width cap; got k=%d) — use impl='xla' or reduce k",
                 k)
-        impl = "xla"
     if impl == "pallas":
         from raft_tpu.ops.knn_tile import fused_knn_tile
 
