@@ -35,8 +35,12 @@ vector compares and lane permutations:
   ~4x fewer wide compare-exchange stages than full-sorting the 2*kpad
   concatenation (the r4 steady-state suspect: cross-vreg lane rolls at
   2*kpad > 128 lanes are the kernel's priciest vector op).  Env
-  ``RAFT_TPU_KNN_TILE_MERGE=fullsort`` restores the old network for
-  on-chip A/B.
+  ``RAFT_TPU_KNN_TILE_MERGE``: ``fullsort`` restores the old network;
+  ``sorttile`` replaces the whole extract-merge while loop with a
+  gated full-tile bitonic sort + one merge tail — no data-dependent
+  loop, no (bm, g*kpad) carry (the structural suspects for the
+  kernel's measured 80x-over-model wall time; docs/TUNING.md).  All
+  three are A/B'd on chip by ``tools/knn_kernel_sweep.py``.
 - the bitonic compare-exchange is lane-parallel: partner values are
   obtained with two circular lane rolls and an XOR-bit select, payload
   indices ride along with strict-inequality "take partner" predicates
@@ -200,6 +204,45 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
     """
     bm = dist.shape[0]
     inf32 = jnp.float32(_INF)
+
+    if merge_impl == "sorttile":
+        # r4 variant with NO data-dependent while loop and no (bm,
+        # g*kpad) loop carry — the two structural suspects for the
+        # kernel's measured-vs-modeled 80x gap (docs/TUNING.md "Open
+        # question").  One scalar gate; contributing tiles pay a fixed
+        # full-width bitonic sort + one 2*kpad merge tail.
+        lane_w = jax.lax.broadcasted_iota(jnp.int32, (bm, g * kpad), 1)
+        ids = jnp.where(dist < inf32, base_col + lane_w, jnp.int32(-1))
+        # the bitonic network needs a power-of-two width; g need not be
+        # one (ragged tiles) — pad with +inf/-1 lanes that sort last
+        w2 = 1
+        while w2 < g * kpad:
+            w2 *= 2
+        if w2 > g * kpad:
+            pad = w2 - g * kpad
+            dist = jnp.concatenate(
+                [dist, jnp.full((bm, pad), inf32)], axis=1)
+            ids = jnp.concatenate(
+                [ids, jnp.full((bm, pad), jnp.int32(-1))], axis=1)
+        worst = bd[:, kpad - 1:kpad]
+        # int32 reduce-max, not jnp.any (f64 proxy under x64, as below)
+        hit = jnp.max((dist < worst).astype(jnp.int32)) > 0
+
+        def _update(args):
+            d_, bd_, bi_ = args
+            # descending full sort: the kpad SMALLEST land in the last
+            # lanes, already descending — the exact bitonic second half
+            # the merge tail wants (no lane reverse needed)
+            sd, si = _bitonic_sort_lanes(d_, ids, interpret,
+                                         descending=True)
+            md = jnp.concatenate([bd_, sd[:, -kpad:]], axis=1)
+            mi = jnp.concatenate([bi_, si[:, -kpad:]], axis=1)
+            md, mi = _bitonic_merge_lanes(md, mi, interpret)
+            return md[:, :kpad], mi[:, :kpad]
+
+        return jax.lax.cond(hit, _update, lambda args: (args[1], args[2]),
+                            (dist, bd, bi))
+
     r_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
     gg_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, g, kpad), 1)
 
@@ -309,7 +352,7 @@ def fused_knn_tile(
         interpret = not is_tpu_backend()
     if merge_impl is None:
         merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
-    expects(merge_impl in ("merge", "fullsort"),
+    expects(merge_impl in ("merge", "fullsort", "sorttile"),
             "fused_knn_tile: unknown merge_impl %s", merge_impl)
 
     # next power of two >= max(k, 128): the bitonic merge width 2*kpad

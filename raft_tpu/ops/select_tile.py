@@ -106,7 +106,7 @@ def select_tile(
         interpret = not is_tpu_backend()
     if merge_impl is None:
         merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
-    expects(merge_impl in ("merge", "fullsort"),
+    expects(merge_impl in ("merge", "fullsort", "sorttile"),
             "select_tile: unknown merge_impl %s", merge_impl)
 
     # shared geometry with the fused kNN kernel (one definition so the

@@ -96,7 +96,6 @@ def fused_l2_knn(
                 "fused_l2_knn: impl='pallas' supports k <= 128 (bitonic "
                 "merge width cap; got k=%d) — use impl='xla' or reduce k",
                 k)
-    if impl == "pallas":
         from raft_tpu.ops.knn_tile import fused_knn_tile
 
         return fused_knn_tile(index, queries, k,

@@ -48,9 +48,9 @@ class TestFusedKnnTileLowersForTPU:
             lambda x, q: fused_knn_tile(x, q, 100, interpret=False),
             (1_000_000, 128), (1024, 128))
 
-    @pytest.mark.parametrize("merge_impl", ["merge", "fullsort"])
+    @pytest.mark.parametrize("merge_impl", ["merge", "fullsort", "sorttile"])
     def test_merge_impls(self, merge_impl):
-        """Both running-top-k merge networks must lower for TPU."""
+        """Every running-top-k merge network must lower for TPU."""
         from raft_tpu.ops.knn_tile import fused_knn_tile
 
         _export_tpu(

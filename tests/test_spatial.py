@@ -249,16 +249,19 @@ def test_fused_knn_tile_merge_impls_agree(rng):
     for k in (5, 100):
         d_m, i_m = fused_knn_tile(jnp.asarray(index), jnp.asarray(queries),
                                   k, merge_impl="merge")
-        d_f, i_f = fused_knn_tile(jnp.asarray(index), jnp.asarray(queries),
-                                  k, merge_impl="fullsort")
-        np.testing.assert_allclose(np.asarray(d_m), np.asarray(d_f),
-                                   rtol=1e-5, atol=1e-6)
-        for row_m, row_f in zip(np.asarray(i_m), np.asarray(i_f)):
-            assert len(set(row_m.tolist())) == k
-            # same id SET up to tie partners (a and a+150 are the same
-            # point): compare modulo the duplication
-            assert sorted(r % 150 for r in row_m) == \
-                sorted(r % 150 for r in row_f)
+        for alt in ("fullsort", "sorttile"):
+            d_f, i_f = fused_knn_tile(jnp.asarray(index),
+                                      jnp.asarray(queries),
+                                      k, merge_impl=alt)
+            np.testing.assert_allclose(np.asarray(d_m), np.asarray(d_f),
+                                       rtol=1e-5, atol=1e-6)
+            for row_m, row_f in zip(np.asarray(i_m), np.asarray(i_f)):
+                assert len(set(row_m.tolist())) == k
+                assert len(set(row_f.tolist())) == k
+                # same id SET up to tie partners (a and a+150 are the
+                # same point): compare modulo the duplication
+                assert sorted(r % 150 for r in row_m) == \
+                    sorted(r % 150 for r in row_f)
 
 
 def test_fused_l2_knn_impl_dispatch(rng):

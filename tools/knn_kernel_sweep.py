@@ -104,7 +104,7 @@ def main():
             if "UNAVAILABLE" in str(e):
                 return
 
-    for merge in ("merge", "fullsort"):
+    for merge in ("merge", "fullsort", "sorttile"):
         for bq in (64, 128, 256):
             for bn in (1024, 2048):
                 def step(qq, merge=merge, bq=bq, bn=bn):
