@@ -1,0 +1,52 @@
+"""Summarize a knn_kernel_sweep log: rank configs, print markdown.
+
+    python tools/summarize_sweep.py .knn_sweep.log
+
+Hardware-free (pure parsing).  One row per config with QPS and the
+ratio to the xla_scan baseline; errors listed at the bottom so a
+partially-complete sweep still summarizes.
+"""
+
+import json
+import sys
+
+
+def main(path):
+    rows, errors, base = [], [], None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            cfg = rec.get("config")
+            if not cfg or cfg == "init":
+                continue
+            if "error" in rec:
+                errors.append((cfg, rec["error"][-120:]))
+                continue
+            qps = rec.get("qps")
+            if qps is None:
+                continue
+            rows.append((cfg, qps, rec.get("seconds_per_batch")))
+            if cfg == "xla_scan":
+                base = qps
+    rows.sort(key=lambda r: -r[1])
+    print("| config | QPS | s/batch | vs xla_scan |")
+    print("|---|---|---|---|")
+    for cfg, qps, spb in rows:
+        vs = f"{qps / base:.2f}x" if base else "-"
+        print(f"| {cfg} | {qps:,.0f} | {spb} | {vs} |")
+    if errors:
+        print("\nerrors:")
+        for cfg, err in errors:
+            print(f"- {cfg}: {err}")
+    if rows:
+        print(f"\nwinner: {rows[0][0]} ({rows[0][1]:,.0f} QPS)")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else ".knn_sweep.log")
