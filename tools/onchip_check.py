@@ -91,10 +91,12 @@ def check_knn(n, nq, d, k, seed=0):
 
 
 def check_merge_impls(n, nq, d, k, seed=0):
-    """A/B the two running-top-k merge networks of the fused kNN kernel
+    """A/B the running-top-k merge networks of the fused kNN kernel
     on chip: equality of results AND steady-state timing — the log2-tail
     "merge" network exists because the full log^2 sort of 2*kpad lanes
-    was the r4 steady-state suspect (cross-vreg lane rolls)."""
+    was the r4 steady-state suspect (cross-vreg lane rolls);
+    "sorttile" removes the while loop + big carry entirely
+    (docs/TUNING.md "Open question")."""
     import jax
 
     from raft_tpu.ops.knn_tile import fused_knn_tile
@@ -103,7 +105,7 @@ def check_merge_impls(n, nq, d, k, seed=0):
     q = rand((nq, d), seed + 1)
     rec = {"check": "knn_merge_impls", "n": n, "nq": nq, "d": d, "k": k}
     outs = {}
-    for impl in ("merge", "fullsort"):
+    for impl in ("merge", "fullsort", "sorttile"):
         f = jax.jit(lambda xx, qq, impl=impl: fused_knn_tile(
             xx, qq, k, merge_impl=impl))
         t0 = time.time()
@@ -118,10 +120,13 @@ def check_merge_impls(n, nq, d, k, seed=0):
             ts.append(time.time() - t0)
         rec[f"t_{impl}_steady"] = round(min(ts), 4)
         outs[impl] = (np.asarray(dd), np.asarray(ii))
-    rec["dist_ok"] = bool(np.allclose(outs["merge"][0],
-                                      outs["fullsort"][0],
-                                      rtol=1e-5, atol=1e-3))
-    mism = outs["merge"][1] != outs["fullsort"][1]
+    rec["dist_ok"] = bool(
+        np.allclose(outs["merge"][0], outs["fullsort"][0],
+                    rtol=1e-5, atol=1e-3)
+        and np.allclose(outs["merge"][0], outs["sorttile"][0],
+                        rtol=1e-5, atol=1e-3))
+    mism = ((outs["merge"][1] != outs["fullsort"][1])
+            | (outs["merge"][1] != outs["sorttile"][1]))
     rec["idx_mismatch_frac"] = float(mism.mean())
     # every index mismatch must be a genuine tie: RECOMPUTE the distance
     # at the id the merge network claims (same guard as check_knn — a
@@ -136,6 +141,8 @@ def check_merge_impls(n, nq, d, k, seed=0):
     rec["ok"] = rec["dist_ok"] and rec["idx_ties_ok"]
     rec["speedup_merge_vs_fullsort"] = round(
         rec["t_fullsort_steady"] / max(rec["t_merge_steady"], 1e-9), 2)
+    rec["speedup_sorttile_vs_merge"] = round(
+        rec["t_merge_steady"] / max(rec["t_sorttile_steady"], 1e-9), 2)
     emit(rec)
     return rec["ok"]
 
