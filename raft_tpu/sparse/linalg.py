@@ -12,11 +12,13 @@ min-label propagation inside ``lax.while_loop``.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.error import expects
 from raft_tpu.sparse.formats import COO, CSR
 from raft_tpu.sparse import convert, op as sparse_op
 
@@ -195,9 +197,43 @@ def symmetrize_knn(knn_indices: jnp.ndarray, knn_dists: jnp.ndarray,
 # --------------------------------------------------------------------- #
 # SpMV
 # --------------------------------------------------------------------- #
-def csr_spmv(csr: CSR, x: jnp.ndarray) -> jnp.ndarray:
-    """y = A @ x via gather + segment-sum (replaces cusparseSpMV; the
-    Lanczos hot loop rides this, see spectral/matrix_wrappers.hpp:180)."""
+def csr_spmv(csr: CSR, x: jnp.ndarray,
+             impl: Optional[str] = None) -> jnp.ndarray:
+    """y = A @ x (replaces cusparseSpMV; the Lanczos hot loop rides
+    this, see spectral/matrix_wrappers.hpp:180).
+
+    ``impl`` (env default ``RAFT_TPU_SPMV_IMPL``):
+
+    - ``"segment"`` (default): gather + sorted segment-sum.
+    - ``"cumsum"``: prefix-sum formulation — y[i] = cs[indptr[i+1]] -
+      cs[indptr[i]] over the exclusive cumsum of the contributions.
+      Trades the nnz-sized scatter for an O(nnz) vectorized prefix sum
+      plus two n_rows-sized 1-D gathers; a candidate TPU win when nnz
+      >> n_rows (scatter-add is the suspect serial path).  ACCURACY
+      CAVEAT: the subtraction differences the GLOBAL running prefix, so
+      a row's absolute error scales with |cs| at its position, not with
+      the row's own sum — rows with small sums late in a large
+      same-signed matrix lose relative precision.  Fine for
+      graph-Laplacian-shaped data (alternating signs, bounded rows);
+      prefer "segment" when row sums are tiny relative to the global
+      mass.
+    """
+    if impl is None:
+        impl = os.environ.get("RAFT_TPU_SPMV_IMPL", "segment")
+    expects(impl in ("segment", "cumsum"),
+            "csr_spmv: unknown impl %s", impl)
+    if impl == "cumsum":
+        # validity needs only the entry position vs nnz (the tail is
+        # padding by the container invariant) — NOT row_ids(), whose
+        # capacity-sized searchsorted is gather-shaped work this impl
+        # exists to avoid
+        pos = jnp.arange(csr.capacity, dtype=csr.indptr.dtype)
+        valid = pos < csr.indptr[-1]
+        xv = x[jnp.where(valid, csr.indices, 0)]
+        contrib = jnp.where(valid, csr.data * xv, 0)
+        cs = jnp.concatenate([
+            jnp.zeros((1,), contrib.dtype), jnp.cumsum(contrib)])
+        return cs[csr.indptr[1:]] - cs[csr.indptr[:-1]]
     rows = csr.row_ids()
     valid = rows < csr.n_rows
     xv = x[jnp.where(valid, csr.indices, 0)]

@@ -221,6 +221,18 @@ class TestLinalg:
         got = linalg.csr_spmv(CSR.from_dense(d, capacity=80), x)
         np.testing.assert_allclose(np.asarray(got), d @ x, rtol=1e-5)
 
+    def test_spmv_cumsum_impl(self, rng):
+        """The prefix-sum SpMV formulation (RAFT_TPU_SPMV_IMPL=cumsum)
+        must match the segment-sum default, including empty rows and a
+        padded capacity tail."""
+        d = random_dense(rng, 30, 17)
+        d[5] = 0.0                      # empty row
+        x = rng.random(17).astype(np.float32)
+        c = CSR.from_dense(d, capacity=700)
+        got = linalg.csr_spmv(c, x, impl="cumsum")
+        np.testing.assert_allclose(np.asarray(got), d @ x, rtol=2e-5,
+                                   atol=1e-6)
+
     def test_spmm(self, rng):
         d = random_dense(rng, 8, 8)
         x = rng.random((8, 3)).astype(np.float32)

@@ -129,15 +129,20 @@ def check_merge_impls(n, nq, d, k, seed=0):
             | (outs["merge"][1] != outs["sorttile"][1]))
     rec["idx_mismatch_frac"] = float(mism.mean())
     # every index mismatch must be a genuine tie: RECOMPUTE the distance
-    # at the id the merge network claims (same guard as check_knn — a
-    # payload-routing bug with correct distances must not pass)
+    # at the id EACH network claims (same guard as check_knn — a
+    # payload-routing bug with correct distances must not pass, for ANY
+    # of the networks)
     xh = np.asarray(x, np.float64)
     qh = np.asarray(q, np.float64)
     rows, poss = np.nonzero(mism)
-    d_at_claim = ((qh[rows] - xh[outs["merge"][1][rows, poss]]) ** 2
-                  ).sum(axis=1)
-    rec["idx_ties_ok"] = bool(np.allclose(
-        d_at_claim, outs["fullsort"][0][rows, poss], rtol=1e-4, atol=1e-3))
+    ties_ok = True
+    for impl in ("merge", "fullsort", "sorttile"):
+        d_at_claim = ((qh[rows] - xh[outs[impl][1][rows, poss]]) ** 2
+                      ).sum(axis=1)
+        ties_ok = ties_ok and bool(np.allclose(
+            d_at_claim, outs["fullsort"][0][rows, poss],
+            rtol=1e-4, atol=1e-3))
+    rec["idx_ties_ok"] = ties_ok
     rec["ok"] = rec["dist_ok"] and rec["idx_ties_ok"]
     rec["speedup_merge_vs_fullsort"] = round(
         rec["t_fullsort_steady"] / max(rec["t_merge_steady"], 1e-9), 2)
