@@ -205,6 +205,19 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
     bm = dist.shape[0]
     inf32 = jnp.float32(_INF)
 
+    if merge_impl == "skip":
+        # ATTRIBUTION PROBE ONLY (sweep tool): evaluate the gate, then
+        # drop every candidate.  Times the kernel's MXU + DMA + grid
+        # + gate floor; t(real merge) - t(skip) isolates the selection
+        # network's true cost on chip.  Returns WRONG top-k results by
+        # design — never reachable from the public dispatch
+        # (fused_l2_knn/select_tile whitelists exclude it).
+        worst = bd[:, kpad - 1:kpad]
+        hit = jnp.max((dist < worst).astype(jnp.int32)) > 0
+        # keep the gate's result live so it cannot be dead-coded
+        bd = jnp.where(hit, bd, bd)
+        return bd, bi
+
     if merge_impl == "sorttile":
         # r4 variant with NO data-dependent while loop and no (bm,
         # g*kpad) loop carry — the two structural suspects for the
@@ -352,7 +365,12 @@ def fused_knn_tile(
         interpret = not is_tpu_backend()
     if merge_impl is None:
         merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
-    expects(merge_impl in ("merge", "fullsort", "sorttile"),
+        # "skip" (the attribution probe that returns WRONG results) is
+        # argument-only: an env var must never silently break the
+        # public dispatch's results
+        expects(merge_impl != "skip",
+                "fused_knn_tile: merge_impl='skip' is argument-only")
+    expects(merge_impl in ("merge", "fullsort", "sorttile", "skip"),
             "fused_knn_tile: unknown merge_impl %s", merge_impl)
 
     # next power of two >= max(k, 128): the bitonic merge width 2*kpad

@@ -104,7 +104,10 @@ def main():
             if "UNAVAILABLE" in str(e):
                 return
 
-    for merge in ("merge", "fullsort", "sorttile"):
+    # "skip" is the attribution probe (WRONG results by design): its
+    # time is the kernel's MXU+DMA+grid+gate floor, so
+    # t(variant) - t(skip) isolates each selection network's true cost
+    for merge in ("skip", "merge", "fullsort", "sorttile"):
         for bq in (64, 128, 256):
             for bn in (1024, 2048):
                 def step(qq, merge=merge, bq=bq, bn=bn):
