@@ -1178,6 +1178,32 @@ class _Child:
             pass
 
 
+def _has_rung(state):
+    return any(isinstance(v, dict)
+               and (v.get("qps") or v.get("gpairs_per_sec"))
+               for v in state.values())
+
+
+def _partition_attempt_states(states):
+    """Merge rungs banked by every attempt (a stalled attempt may have
+    banked rungs before its channel died); later attempts win ties.
+    PARTITIONED BY THE BACKEND THAT MEASURED THEM: when one attempt ran
+    on the accelerator and another fell back to CPU (wedged endpoint),
+    a blind merge would let the later init overwrite the earlier one —
+    relabeling TPU-measured rungs as CPU fallback or, worse, CPU-speed
+    rungs as accelerator numbers (r4 review).  Returns
+    (accel_state, fallback_state, tpu_is_accel)."""
+    accel_state, fb_state = {}, {}
+    for s in states:
+        dst = (accel_state if s.get("init", {}).get("is_tpu")
+               else fb_state)
+        dst.update(s)
+    accel_state.pop("fallback", None)
+    fb_state.pop("fallback", None)
+    tpu_is_accel = bool(accel_state.get("init", {}).get("is_tpu"))
+    return accel_state, fb_state, tpu_is_accel
+
+
 def _tpu_attempt_note(tpu, deadline):
     """Honest status of the accelerator child (round-3 advisor: a child
     killed mid-import must not be labeled 'init did not complete')."""
@@ -1270,26 +1296,9 @@ def parent_main():
     while time.time() < t_grace:
         time.sleep(0.1)
 
-    def has_rung(state):
-        return any(isinstance(v, dict)
-                   and (v.get("qps") or v.get("gpairs_per_sec"))
-                   for v in state.values())
-
-    # merge rungs banked by every attempt (a stalled attempt may have
-    # banked rungs before its channel died); later attempts win ties.
-    # PARTITIONED BY THE BACKEND THAT MEASURED THEM: when one attempt
-    # ran on the accelerator and another fell back to CPU (wedged
-    # endpoint), a blind merge would let the later init overwrite the
-    # earlier one — relabeling TPU-measured rungs as CPU fallback or,
-    # worse, CPU-speed rungs as accelerator numbers (r4 review).
-    accel_state, fb_state = {}, {}
-    for s in banked_states + [dict(tpu.state)]:
-        dst = (accel_state if s.get("init", {}).get("is_tpu")
-               else fb_state)
-        dst.update(s)
-    accel_state.pop("fallback", None)
-    fb_state.pop("fallback", None)
-    tpu_is_accel = bool(accel_state.get("init", {}).get("is_tpu"))
+    has_rung = _has_rung
+    accel_state, fb_state, tpu_is_accel = _partition_attempt_states(
+        banked_states + [dict(tpu.state)])
     tpu_state = accel_state if tpu_is_accel else fb_state
     cpu_state = dict(cpu.state)
     cpu_state.pop("fallback", None)

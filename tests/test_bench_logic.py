@@ -206,3 +206,41 @@ class TestInitRetry:
 
         with pytest.raises(RuntimeError, match="UNAVAILABLE"):
             bench._rung_init()
+
+
+class TestPartitionAttemptStates:
+    """Cross-attempt rung banking must partition by the backend that
+    measured each attempt (r4 review: a wedged-endpoint respawn that
+    falls back to CPU must not relabel TPU rungs or smuggle CPU rungs
+    under accelerator names)."""
+
+    def test_tpu_then_cpu_fallback_attempt(self):
+        tpu_attempt = {"init": {"is_tpu": True},
+                       "linalg_bundle": {"gpairs_per_sec": 0,
+                                         "gemm_tflops": 95.0,
+                                         "qps": None}}
+        cpu_attempt = {"init": {"is_tpu": False},
+                       "knn_100k": {"qps": 500.0}}
+        accel, fb, is_accel = bench._partition_attempt_states(
+            [tpu_attempt, cpu_attempt])
+        assert is_accel
+        assert "linalg_bundle" in accel and "knn_100k" not in accel
+        assert accel["init"]["is_tpu"]           # later init didn't clobber
+        assert fb["knn_100k"]["qps"] == 500.0
+
+    def test_cpu_then_tpu_attempt(self):
+        cpu_attempt = {"init": {"is_tpu": False},
+                       "knn_100k": {"qps": 500.0}}
+        tpu_attempt = {"init": {"is_tpu": True},
+                       "knn_100k": {"qps": 5000.0}}
+        accel, fb, is_accel = bench._partition_attempt_states(
+            [cpu_attempt, tpu_attempt])
+        assert is_accel
+        assert accel["knn_100k"]["qps"] == 5000.0
+        assert fb["knn_100k"]["qps"] == 500.0
+
+    def test_all_cpu(self):
+        accel, fb, is_accel = bench._partition_attempt_states(
+            [{"init": {"is_tpu": False}, "knn_100k": {"qps": 10.0}}])
+        assert not is_accel and not accel
+        assert fb["knn_100k"]["qps"] == 10.0
