@@ -244,3 +244,28 @@ class TestPartitionAttemptStates:
             [{"init": {"is_tpu": False}, "knn_100k": {"qps": 10.0}}])
         assert not is_accel and not accel
         assert fb["knn_100k"]["qps"] == 10.0
+
+
+class TestEnvPins:
+    def test_set_and_restore(self, monkeypatch):
+        import os
+        monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "chunked")
+        monkeypatch.delenv("RAFT_TPU_TILE_MERGE", raising=False)
+        with bench._env_pins({"RAFT_TPU_SELECT_IMPL": "pallas",
+                              "RAFT_TPU_TILE_MERGE": "direct",
+                              "RAFT_TPU_FUSED_KNN_IMPL": None}):
+            assert os.environ["RAFT_TPU_SELECT_IMPL"] == "pallas"
+            assert os.environ["RAFT_TPU_TILE_MERGE"] == "direct"
+            assert "RAFT_TPU_FUSED_KNN_IMPL" not in os.environ
+        assert os.environ["RAFT_TPU_SELECT_IMPL"] == "chunked"
+        assert "RAFT_TPU_TILE_MERGE" not in os.environ
+
+    def test_restores_on_exception(self, monkeypatch):
+        import os
+        monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "topk")
+        try:
+            with bench._env_pins({"RAFT_TPU_SELECT_IMPL": "approx"}):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert os.environ["RAFT_TPU_SELECT_IMPL"] == "topk"
