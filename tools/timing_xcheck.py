@@ -1,10 +1,15 @@
 """Cross-check the bench's chained timing against plain wall-clock.
 
-Round-4 question: the full-budget bench measured the 100k XLA kNN rung
-at ~98 us/query (nq=4096, _time_chained), while tools/steady_knn.py
-measured ~1700 us/query (nq=1024, plain wall-clock).  One of batch
-size, wrapper path, or timing method explains the 17x; this tool pins
-which, with plain timing and chained timing on the SAME calls.
+Round-4 question (ANSWERED — kept as the reproducer): the full-budget
+bench measured the 100k XLA kNN rung at ~98 us/query (nq=4096,
+_time_chained), while tools/steady_knn.py measured ~1700 us/query
+(nq=1024, plain wall-clock).  Verdict: the timing METHOD — the chained
+step returned distances only, so XLA dead-coded the index half of the
+selection (see bench._time_chained's caller contract and the
+BENCH_TPU_SESSION_r04.md correction).  A part-2 tool that jitted
+lambdas closing over the 100k index was retired: the 51 MB
+HLO-constant compile wedged the tunnel for hours — pass big arrays as
+ARGUMENTS, never closures, when talking to the tunnel.
 
     python tools/timing_xcheck.py > .timing_xcheck.log 2>&1
 """
