@@ -34,6 +34,13 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    try:
+        from bench import _enable_compile_cache
+
+        _enable_compile_cache(jax)
+    except Exception:
+        pass
+
     from bench import _time_chained
 
     dev = jax.devices()[0]

@@ -38,6 +38,13 @@ def rand(shape, seed, scale=1.0, positive=False):
     import jax
     import jax.numpy as jnp
 
+    try:
+        from bench import _enable_compile_cache
+
+        _enable_compile_cache(jax)
+    except Exception:
+        pass
+
     x = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32) * scale
     if positive:
         x = jnp.abs(x) + 0.01

@@ -26,6 +26,13 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    try:
+        from bench import _enable_compile_cache
+
+        _enable_compile_cache(jax)
+    except Exception:
+        pass
+
     dev = jax.devices()[0]
     log(f"backend: {dev.platform} ({dev.device_kind})")
 

@@ -37,6 +37,13 @@ def wall(fn, *args):
     """Plain steady-state: warm once, then min over 4 timed calls."""
     import jax
 
+    try:
+        from bench import _enable_compile_cache
+
+        _enable_compile_cache(jax)
+    except Exception:
+        pass
+
     jax.block_until_ready(fn(*args))
     ts = []
     for _ in range(4):
