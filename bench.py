@@ -330,19 +330,20 @@ def _rand(shape, seed):
     )()
 
 
-def _enable_compile_cache(jax_mod):
+def _enable_compile_cache(jax_mod=None):
     """Persistent compile cache via EXPLICIT config: this environment's
     JAX does not read JAX_COMPILATION_CACHE_DIR from the env (measured
     r4: config stayed None and .jax_cache was never created, so every
-    'warm cache' across sessions was a no-op).  5 s threshold: only
-    real accelerator compiles are worth disk."""
+    'warm cache' across sessions was a no-op).  Delegates to the single
+    config owner (core.specializations.enable_persistent_cache) with a
+    5 s threshold — only real accelerator compiles are worth disk."""
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if not cache_dir:
         return
     try:
-        jax_mod.config.update("jax_compilation_cache_dir", cache_dir)
-        jax_mod.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 5.0)
+        from raft_tpu.core.specializations import enable_persistent_cache
+
+        enable_persistent_cache(cache_dir, min_compile_secs=5.0)
     except Exception:
         pass  # older config names; cache stays off rather than crashing
 

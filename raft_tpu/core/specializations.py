@@ -27,11 +27,18 @@ _DEFAULT_CACHE = os.path.join(
 _enabled_dir: Optional[str] = None
 
 
-def enable_persistent_cache(path: Optional[str] = None) -> str:
+def enable_persistent_cache(path: Optional[str] = None,
+                            min_compile_secs: float = 0.0) -> str:
     """Turn on the on-disk executable cache (idempotent).
 
     Returns the cache directory.  Safe to call before or after other jax
-    use; programs compiled afterwards are cached.
+    use; programs compiled afterwards are cached.  This is the SINGLE
+    owner of the cache config (the bench and the measurement tools call
+    through here) — note this environment's JAX does not read
+    JAX_COMPILATION_CACHE_DIR from the env, so the explicit config
+    update is what actually enables caching.  ``min_compile_secs``:
+    0.0 caches every program (the AOT-warmup default); the bench passes
+    5.0 so only real accelerator compiles are worth disk.
     """
     global _enabled_dir
     path = path or _DEFAULT_CACHE
@@ -39,8 +46,8 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
         return path
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # cache every program regardless of compile time / size
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _enabled_dir = path
     return path

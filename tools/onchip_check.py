@@ -38,13 +38,6 @@ def rand(shape, seed, scale=1.0, positive=False):
     import jax
     import jax.numpy as jnp
 
-    try:
-        from bench import _enable_compile_cache
-
-        _enable_compile_cache(jax)
-    except Exception:
-        pass
-
     x = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32) * scale
     if positive:
         x = jnp.abs(x) + 0.01
@@ -332,6 +325,10 @@ def check_pairwise(m, n, d, metric, seed=0):
 
 def main():
     import jax
+
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache()
 
     dev = jax.devices()[0]
     emit({"check": "init", "device": str(dev.device_kind),

@@ -27,12 +27,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    try:
-        from bench import _enable_compile_cache
+    from bench import _enable_compile_cache
 
-        _enable_compile_cache(jax)
-    except Exception:
-        pass
+    _enable_compile_cache()
 
     from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
 

@@ -26,12 +26,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    try:
-        from bench import _enable_compile_cache
+    from bench import _enable_compile_cache
 
-        _enable_compile_cache(jax)
-    except Exception:
-        pass
+    _enable_compile_cache()
 
     dev = jax.devices()[0]
     log(f"backend: {dev.platform} ({dev.device_kind})")

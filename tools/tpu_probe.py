@@ -3,19 +3,17 @@ import time, sys
 t0 = time.time()
 def log(e):
     print(f"[{time.time()-t0:8.1f}s] {e}", flush=True)
+import os
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
 log("start; importing jax")
 import jax
 log("jax imported")
 import jax.numpy as jnp
-import os, sys as _s
-_s.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
-try:
-    from bench import _enable_compile_cache
-    _enable_compile_cache(jax)
-except Exception:
-    pass
+from bench import _enable_compile_cache
+_enable_compile_cache()
 devs = jax.devices()
 log(f"devices: {[str(d) for d in devs]} platform={devs[0].platform} kind={devs[0].device_kind}")
 x = jnp.ones((128, 128), jnp.float32)
