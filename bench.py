@@ -1198,9 +1198,7 @@ class _Child:
 
 
 def _has_rung(state):
-    return any(isinstance(v, dict)
-               and (v.get("qps") or v.get("gpairs_per_sec"))
-               for v in state.values())
+    return any(_rung_metric(v) for v in state.values())
 
 
 def _partition_attempt_states(states):
@@ -1221,6 +1219,27 @@ def _partition_attempt_states(states):
     fb_state.pop("fallback", None)
     tpu_is_accel = bool(accel_state.get("init", {}).get("is_tpu"))
     return accel_state, fb_state, tpu_is_accel
+
+
+def _rung_metric(v):
+    if not isinstance(v, dict):
+        return None
+    return v.get("qps") or v.get("gpairs_per_sec")
+
+
+def _merge_best_rungs(base, other):
+    """Fold `other`'s rungs into `base`, keeping the better metric per
+    rung (never wholesale replacement: a fallback attempt that banked
+    one fast kNN rung must not discard the CPU child's other rungs)."""
+    merged = dict(base)
+    for k, v in other.items():
+        m = _rung_metric(v)
+        if m is None:
+            continue
+        cur = _rung_metric(merged.get(k))
+        if cur is None or m > cur:
+            merged[k] = v
+    return merged
 
 
 def _tpu_attempt_note(tpu, deadline):
@@ -1324,11 +1343,10 @@ def parent_main():
     cpu_state.pop("init_log", None)
     if tpu_is_accel and has_rung(fb_state):
         # a CPU-fallback attempt's rungs compete with the CPU child's,
-        # never with the accelerator's
-        a = _best_knn(fb_state, "knn_100k")
-        b = _best_knn(cpu_state, "knn_100k")
-        if (a.get("qps", 0) if a else 0) > (b.get("qps", 0) if b else 0):
-            cpu_state = fb_state
+        # never with the accelerator's; per-rung best-of, not wholesale
+        # (bookkeeping keys never propagate: _merge_best_rungs copies
+        # only metric-bearing rungs)
+        cpu_state = _merge_best_rungs(cpu_state, fb_state)
     if tpu_is_accel and has_rung(tpu_state):
         if stalled_attempts:
             tpu_state["stalled_attempts"] = stalled_attempts
@@ -1338,10 +1356,7 @@ def parent_main():
         # report whichever banked the better kNN rung, with an honest
         # account of what happened to the accelerator attempt
         if not tpu_is_accel and has_rung(tpu_state):
-            a = _best_knn(tpu_state, "knn_100k")
-            b = _best_knn(cpu_state, "knn_100k")
-            if (a.get("qps", 0) if a else 0) > (b.get("qps", 0) if b else 0):
-                cpu_state = tpu_state
+            cpu_state = _merge_best_rungs(cpu_state, tpu_state)
         note = _tpu_attempt_note(tpu, deadline)
         if stalled_attempts:
             note["stalled_attempts"] = stalled_attempts

@@ -214,8 +214,10 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
         # (fused_l2_knn/select_tile whitelists exclude it).
         worst = bd[:, kpad - 1:kpad]
         hit = jnp.max((dist < worst).astype(jnp.int32)) > 0
-        # keep the gate's result live so it cannot be dead-coded
-        bd = jnp.where(hit, bd, bd)
+        # keep the gate's reduction live by folding it numerically into
+        # the output (a same-operand select would be canonicalized away
+        # and the gate dead-coded, under-counting the floor)
+        bd = bd + hit.astype(bd.dtype)
         return bd, bi
 
     if merge_impl == "sorttile":

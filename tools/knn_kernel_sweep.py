@@ -38,7 +38,7 @@ def main():
 
     _enable_compile_cache()
 
-    from bench import _time_chained
+    from bench import _env_pins, _time_chained
 
     dev = jax.devices()[0]
     emit({"config": "init", "device": str(dev.device_kind),
@@ -80,24 +80,13 @@ def main():
                      ("xla_chunked_tile16k",
                       {"select": "chunked", "tile_n": 16384})):
         def vstep(qq, kw=kw):
-            prev = {v: os.environ.get(v) for v in
-                    ("RAFT_TPU_TILE_MERGE", "RAFT_TPU_SELECT_IMPL")}
-            if kw.get("merge"):
-                os.environ["RAFT_TPU_TILE_MERGE"] = kw["merge"]
-            if kw.get("select"):
-                os.environ["RAFT_TPU_SELECT_IMPL"] = kw["select"]
             # tile_n passed ONLY when the variant pins it, so the other
             # variants track fused_l2_knn's default and the comparison
             # never hides a tile_n difference
             tn = {"tile_n": kw["tile_n"]} if "tile_n" in kw else {}
-            try:
+            with _env_pins({"RAFT_TPU_TILE_MERGE": kw.get("merge"),
+                            "RAFT_TPU_SELECT_IMPL": kw.get("select")}):
                 d, i = fused_l2_knn(x, qq, k, impl="xla", **tn)
-            finally:
-                for var, val in prev.items():
-                    if val is None:
-                        os.environ.pop(var, None)
-                    else:
-                        os.environ[var] = val
             return d + i.astype(d.dtype)
         try:
             dt = _time_chained(vstep, q, 2)
