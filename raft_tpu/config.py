@@ -1,0 +1,174 @@
+"""One owner for raft_tpu's runtime perf knobs.
+
+The reference keeps its tuning surface in one-place config structs
+(cpp/include/raft/spatial/knn/ann_common.h:42-72); raft_tpu's analog is
+this module: every performance knob that used to be a scattered
+``os.environ`` read resolves here, with the env vars kept as aliases.
+
+Resolution order (first hit wins):
+
+1. an explicit function argument at the call site (never reaches here);
+2. an active :func:`override` context, innermost first;
+3. a value set by :func:`configure`;
+4. the knob's env var (``RAFT_TPU_*`` — the historical spelling);
+5. the built-in default.
+
+THE executable-cache caveat, stated once: knobs are consumed at *trace*
+time.  ``jax.jit`` caches executables by shape+dtype, so consumers
+already compiled for a given shape will NOT retrace when a knob changes
+mid-process — the change affects only not-yet-compiled shapes.
+:func:`configure` and :func:`override` warn when they change a knob
+that some trace has already consumed; direct env-var writes cannot be
+intercepted, so prefer the functions (or explicit arguments, which
+reach the trace as Python values and always take effect).
+
+Knobs
+-----
+select_impl
+    Per-row top-k implementation for :func:`raft_tpu.spatial.select_k`
+    (``topk`` | ``approx`` | ``approx95`` | ``chunked`` | ``pallas``).
+tile_merge
+    Tile-scan kNN per-tile selection strategy
+    (:func:`raft_tpu.spatial.tiled_knn`): ``tile_topk`` | ``direct``.
+knn_tile_merge
+    Pallas fused-kNN/select merge network
+    (:mod:`raft_tpu.ops.knn_tile`): ``merge`` | ``fullsort`` |
+    ``sorttile`` (``skip`` is argument-only: an attribution probe that
+    returns wrong results by design and must never be reachable from
+    config).
+fused_knn_impl
+    :func:`raft_tpu.spatial.fused_l2_knn` path: ``xla`` | ``pallas``;
+    unset = per-backend auto (currently ``xla`` everywhere, the r4
+    measured default).
+pq_adc
+    IVF-PQ ADC lookup (:func:`raft_tpu.spatial.ann.ivf_pq_search`):
+    ``gather`` (per-element LUT) | ``onehot`` (one-hot einsum).
+    Resolved at call time, not trace time.
+spmv_impl
+    CSR SpMV (:func:`raft_tpu.sparse.linalg.csr_spmv`): ``segment``
+    (gather + sorted segment-sum) | ``cumsum`` (prefix-sum form).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional, Tuple
+
+__all__ = ["configure", "override", "get", "describe"]
+
+# knob -> (env alias, default, legal values settable via configure)
+_KNOBS: Dict[str, Tuple[str, Optional[str], Tuple[str, ...]]] = {
+    "select_impl": ("RAFT_TPU_SELECT_IMPL", "topk",
+                    ("topk", "approx", "approx95", "chunked", "pallas")),
+    "tile_merge": ("RAFT_TPU_TILE_MERGE", "tile_topk",
+                   ("tile_topk", "direct")),
+    "knn_tile_merge": ("RAFT_TPU_KNN_TILE_MERGE", "merge",
+                       ("merge", "fullsort", "sorttile")),
+    "fused_knn_impl": ("RAFT_TPU_FUSED_KNN_IMPL", None,
+                       ("xla", "pallas")),
+    "pq_adc": ("RAFT_TPU_PQ_ADC", "gather", ("gather", "onehot")),
+    "spmv_impl": ("RAFT_TPU_SPMV_IMPL", "segment", ("segment", "cumsum")),
+}
+
+_values: Dict[str, Optional[str]] = {}
+_tls = threading.local()
+# knob -> set of values already handed to some trace (consumed); used
+# only to decide whether a later change deserves the caveat warning
+_consumed: Dict[str, set] = {}
+_lock = threading.Lock()
+
+
+def _frames():
+    return getattr(_tls, "frames", ())
+
+
+def get(name: str) -> Optional[str]:
+    """Resolve a knob (module-doc order) and mark it consumed.
+
+    Returns the raw string (or None for an unset no-default knob);
+    call sites keep their own whitelists so an env-var typo fails with
+    the site's error message, exactly as before.
+    """
+    env, default, _ = _KNOBS[name]
+    val = None
+    for frame in reversed(_frames()):
+        if name in frame:
+            val = frame[name]
+            break
+    else:
+        if name in _values:
+            val = _values[name]
+        else:
+            val = os.environ.get(env, default)
+    with _lock:
+        _consumed.setdefault(name, set()).add(val)
+    return val
+
+
+def _check(name: str, value: Optional[str]) -> None:
+    if name not in _KNOBS:
+        raise ValueError(
+            f"raft_tpu.config: unknown knob {name!r} "
+            f"(have: {', '.join(sorted(_KNOBS))})")
+    env, default, choices = _KNOBS[name]
+    if value is not None and value not in choices:
+        raise ValueError(
+            f"raft_tpu.config: {name}={value!r} not in {choices} "
+            "('skip' and other probe-only modes are argument-only)")
+
+
+def _warn_if_consumed(name: str, value: Optional[str]) -> None:
+    with _lock:
+        seen = _consumed.get(name)
+        if seen and value not in seen:
+            warnings.warn(
+                f"raft_tpu.config: {name} was already consumed at trace "
+                f"time (as {', '.join(map(repr, sorted(seen, key=str)))}); "
+                "consumers already compiled for a shape keep the old "
+                f"value — {name}={value!r} affects only not-yet-compiled "
+                "shapes. Pass the argument explicitly to pin it per call.",
+                stacklevel=3)
+
+
+def configure(**knobs: Optional[str]) -> None:
+    """Set knob values process-wide (None = revert to env/default)."""
+    for name, value in knobs.items():
+        _check(name, value)
+        _warn_if_consumed(name, value)
+        if value is None:
+            _values.pop(name, None)
+        else:
+            _values[name] = value
+
+
+@contextmanager
+def override(**knobs: Optional[str]) -> Iterator[None]:
+    """Scoped knob values (thread-local; nestable, innermost wins)."""
+    for name, value in knobs.items():
+        _check(name, value)
+        _warn_if_consumed(name, value)
+    frames = list(_frames())
+    frames.append(dict(knobs))
+    _tls.frames = tuple(frames)
+    try:
+        yield
+    finally:
+        _tls.frames = tuple(frames[:-1])
+
+
+def describe() -> Dict[str, Optional[str]]:
+    """Current effective value of every knob (no consumption mark)."""
+    out = {}
+    for name, (env, default, _) in _KNOBS.items():
+        val = None
+        for frame in reversed(_frames()):
+            if name in frame:
+                val = frame[name]
+                break
+        else:
+            val = _values.get(name, os.environ.get(env, default))
+        out[name] = val
+    return out

@@ -60,7 +60,6 @@ path with every index mismatch a recomputed-distance tie.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -68,6 +67,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import ceildiv, is_tpu_backend
 
@@ -366,7 +366,7 @@ def fused_knn_tile(
     if interpret is None:
         interpret = not is_tpu_backend()
     if merge_impl is None:
-        merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
+        merge_impl = config.get("knn_tile_merge")
         # "skip" (the attribution probe that returns WRONG results) is
         # argument-only: an env var must never silently break the
         # public dispatch's results

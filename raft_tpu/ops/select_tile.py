@@ -24,7 +24,6 @@ Callers wanting largest negate the keys (see
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -32,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.ops.knn_tile import tile_geometry, topk_update
@@ -105,7 +105,7 @@ def select_tile(
     if interpret is None:
         interpret = not is_tpu_backend()
     if merge_impl is None:
-        merge_impl = os.environ.get("RAFT_TPU_KNN_TILE_MERGE", "merge")
+        merge_impl = config.get("knn_tile_merge")
     expects(merge_impl in ("merge", "fullsort", "sorttile"),
             "select_tile: unknown merge_impl %s", merge_impl)
 

@@ -12,12 +12,12 @@ min-label propagation inside ``lax.while_loop``.
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.formats import COO, CSR
 from raft_tpu.sparse import convert, op as sparse_op
@@ -219,7 +219,7 @@ def csr_spmv(csr: CSR, x: jnp.ndarray,
       mass.
     """
     if impl is None:
-        impl = os.environ.get("RAFT_TPU_SPMV_IMPL", "segment")
+        impl = config.get("spmv_impl")
     expects(impl in ("segment", "cumsum"),
             "csr_spmv: unknown impl %s", impl)
     if impl == "cumsum":

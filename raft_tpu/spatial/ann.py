@@ -38,7 +38,6 @@ metric set, ann_quantized_faiss.cuh:94-118).
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass
 from typing import NamedTuple, Optional, Tuple
 
@@ -47,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import record_on_handle
 from raft_tpu.core.utils import round_up_safe
@@ -464,9 +464,9 @@ def ivf_pq_search(index: IVFPQIndex, queries, k: int,
     # ADC impl resolved at CALL time (a trace-time env read would pin
     # the first value into the shape-keyed executable cache — the
     # select_k caveat)
-    adc = os.environ.get("RAFT_TPU_PQ_ADC", "gather")
+    adc = config.get("pq_adc")
     expects(adc in ("gather", "onehot"),
-            "ivf_pq_search: unknown RAFT_TPU_PQ_ADC %s", adc)
+            "ivf_pq_search: unknown pq_adc %s", adc)
     out = _ivf_pq_search_jit(index.centroids, index.codebooks,
                              index.slot_codes, index.slot_ids,
                              index.slot_centroid, index.cent_slots,

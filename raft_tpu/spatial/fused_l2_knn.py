@@ -33,12 +33,12 @@ fixup for L2Sqrt metrics is the caller's postprocess step
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.spatial.tiled_knn import tiled_knn
 
@@ -76,7 +76,7 @@ def fused_l2_knn(
     expects(index.ndim == 2 and queries.ndim == 2
             and index.shape[1] == queries.shape[1],
             "fused_l2_knn: shape mismatch")
-    requested = impl or os.environ.get("RAFT_TPU_FUSED_KNN_IMPL") or None
+    requested = impl or config.get("fused_knn_impl")
     if impl is None:
         # r4: "xla" on every backend — the measured default (module doc)
         impl = requested or "xla"

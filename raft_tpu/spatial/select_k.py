@@ -18,17 +18,12 @@ Implementation choice (``impl``): ``"topk"`` (default) is ``lax.top_k``
 — exact, sorted, stable toward smaller index on ties (the same tie rule
 as the reference's heap with sequential insertion).  ``"approx"`` is
 ``lax.approx_max_k`` — exact in *membership* at recall 1.0 but with no
-tie-order guarantee.  Default comes from ``RAFT_TPU_SELECT_IMPL`` (read
-at trace time; the bench measures both on hardware and reports the
-winner rather than assuming).
-
-Executable-cache caveat: because the env default is read at *trace*
-time, jitted consumers that were already compiled for a given shape
-(e.g. the module-level ANN search jits) will NOT retrace when the env
-var changes mid-process — flipping ``RAFT_TPU_SELECT_IMPL`` affects
-only not-yet-compiled shapes.  Pass ``impl=`` explicitly (it reaches
-the trace as a Python value) or set the env var before first use; the
-bench creates a fresh outer jit per rung for exactly this reason.
+tie-order guarantee.  The default is the ``select_impl`` knob of
+:mod:`raft_tpu.config` (env alias ``RAFT_TPU_SELECT_IMPL``; the
+executable-cache caveat — knobs are consumed at trace time and cannot
+reach already-compiled shapes — is documented there, once).  The bench
+measures the impls on hardware and reports the winner rather than
+assuming.
 
 ``select_k`` is THE building block for kNN merge and ANN list scans, so it
 accepts an optional payload (``values``) to carry indices through
@@ -37,12 +32,12 @@ selection, mirroring the (key, value) pairs of the reference heaps.
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import ceildiv
 
@@ -130,10 +125,12 @@ def _flip(x):
 
 
 def _resolve_impl(impl: Optional[str]) -> str:
-    """Single owner of the RAFT_TPU_SELECT_IMPL env default + whitelist
-    (shared by :func:`top_k_rows` and :func:`select_k`)."""
+    """Default + whitelist for the select impl (shared by
+    :func:`top_k_rows` and :func:`select_k`); the default resolves
+    through :mod:`raft_tpu.config` (knob ``select_impl``, env alias
+    RAFT_TPU_SELECT_IMPL — caveat documented there, once)."""
     if impl is None:
-        impl = os.environ.get("RAFT_TPU_SELECT_IMPL", "topk")
+        impl = config.get("select_impl")
     expects(impl in ("topk", "approx", "approx95", "chunked", "pallas"),
             "select_k: unknown impl %s", impl)
     return impl

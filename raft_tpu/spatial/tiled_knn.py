@@ -11,12 +11,12 @@ computation overlaps tile t's selection.
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import ceildiv
 from raft_tpu.spatial.select_k import top_k_rows
@@ -36,11 +36,10 @@ def tiled_knn(
     distance tile; padding rows of the index are zeros and their distances
     are overridden to +inf here, so ``tile_dist`` need not handle them.
 
-    ``merge`` selects the per-tile selection strategy (env default
-    ``RAFT_TPU_TILE_MERGE``, read at TRACE time when merge is None —
-    jitted consumers cached by shape will not see later env changes,
-    the select_k executable-cache caveat; pass ``merge`` explicitly to
-    pin it):
+    ``merge`` selects the per-tile selection strategy (default: the
+    ``tile_merge`` knob of :mod:`raft_tpu.config`, env alias
+    ``RAFT_TPU_TILE_MERGE`` — trace-time-consumption caveat documented
+    there; pass ``merge`` explicitly to pin it per call):
 
     - ``"tile_topk"`` (default): top-k the tile (impl-dispatched, see
       :func:`~raft_tpu.spatial.select_k.top_k_rows`), then one 2k-wide
@@ -58,7 +57,7 @@ def tiled_knn(
     n = index.shape[0]
     expects(0 < k <= n, "tiled_knn: k=%d out of range for n_index=%d", k, n)
     if merge is None:
-        merge = os.environ.get("RAFT_TPU_TILE_MERGE", "tile_topk")
+        merge = config.get("tile_merge")
     expects(merge in ("tile_topk", "direct"),
             "tiled_knn: unknown merge %s", merge)
     nq = queries.shape[0]

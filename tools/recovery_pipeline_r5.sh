@@ -12,18 +12,40 @@
 # selection sweep (VERDICT r4 item 1/2), then pairwise + spectral +
 # second-tier tools.
 #
-# Stand-down: past 03:00 UTC (and before 16:00, i.e. next-day
+# Stand-down: past 03:00 UTC (and before 16:00 UTC, i.e. next-day
 # morning) the pipeline exits so the driver's round-end bench finds a
-# free endpoint and a warm compile cache.
+# free endpoint and a warm compile cache.  EVERY post-recovery step is
+# additionally clamped by `timeout $(secs_left)` so a wedged RPC or a
+# step started near the wall cannot occupy the endpoint into the
+# driver's window (SIGINT first, KILL 60 s later — the gentlest
+# abandonment available once holding the endpoint is the greater harm).
 cd /root/repo
 LOG=.recovery_r5.log
 standdown() {
-  NOW=$(date +%H%M)
-  # session runs 1610 -> ~0400; stand down in [0300, 1600)
+  NOW=$(date -u +%H%M)
+  # session runs 1610 -> ~0400 UTC; stand down in [0300, 1600)
   if [ "$NOW" -ge 0300 ] && [ "$NOW" -lt 1600 ]; then return 0; fi
   return 1
 }
+secs_left() {  # seconds until the 03:00 UTC stand-down wall
+  local now target
+  now=$(date -u +%s)
+  if [ "$(date -u +%H%M)" -ge 0300 ]; then
+    target=$(date -u -d "tomorrow 03:00" +%s)
+  else
+    target=$(date -u -d "03:00" +%s)
+  fi
+  echo $(( target - now ))
+}
 echo "=== r5 pipeline start $(date -u +%H:%M:%S) ===" >> "$LOG"
+
+# never run two probe clients at once: wait out any probe a previous
+# pipeline instance left in flight (it dies by itself within 15 min)
+while pgrep -f "python tools/tpu_probe.py" > /dev/null 2>&1; do
+  echo "$(date -u +%H:%M:%S) older probe still in flight; waiting" >> "$LOG"
+  sleep 60
+done
+
 while true; do
   if standdown; then
     echo "$(date -u +%H:%M:%S) stand-down window — exit for the driver" >> "$LOG"
@@ -40,21 +62,33 @@ echo "=== BACKEND UP $(date -u +%H:%M:%S) ===" >> "$LOG"
 # Leave a marker the interactive session can poll.
 touch .backend_up_r5
 
-NOW=$(date +%H%M)
-# generous budget before midnight; shorter after (driver window nears)
-if [ "$NOW" -ge 1600 ] || [ "$NOW" -lt 0000 ]; then BUDGET=2700; else BUDGET=1500; fi
-echo "=== full bench (budget $BUDGET) ===" >> "$LOG"
-RAFT_TPU_BENCH_BUDGET=$BUDGET python bench.py > .bench_r05_auto.json \
-  2> .bench_r05_auto.err
-echo "bench rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+NOW=$(date -u +%H%M)
+# generous budget before midnight UTC; shorter after (wall nears)
+if [ "$NOW" -ge 1600 ]; then BUDGET=2700; else BUDGET=1500; fi
+LEFT=$(secs_left)
+[ "$BUDGET" -gt "$LEFT" ] && BUDGET=$LEFT
+if [ "$LEFT" -le 300 ]; then
+  echo "=== skip bench: only ${LEFT}s to stand-down ===" >> "$LOG"
+else
+  echo "=== full bench (budget $BUDGET, wall in ${LEFT}s) ===" >> "$LOG"
+  RAFT_TPU_BENCH_BUDGET=$BUDGET timeout -s INT -k 60 "$LEFT" \
+    python bench.py > .bench_r05_auto.json 2> .bench_r05_auto.err
+  echo "bench rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+fi
 
 run_tool() {  # run_tool <script> <logfile>
   if standdown; then
     echo "$(date -u +%H:%M:%S) stand-down — skip $1" >> "$LOG"
     return 1
   fi
-  echo "=== $1 ===" >> "$LOG"
-  python "$1" > "$2" 2>&1
+  local left
+  left=$(secs_left)
+  if [ "$left" -le 300 ]; then
+    echo "$(date -u +%H:%M:%S) only ${left}s to wall — skip $1" >> "$LOG"
+    return 1
+  fi
+  echo "=== $1 (wall in ${left}s) ===" >> "$LOG"
+  timeout -s INT -k 60 "$left" python "$1" > "$2" 2>&1
   echo "$1 rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
 }
 run_tool tools/knn_kernel_sweep.py .knn_sweep_r5.log
