@@ -399,6 +399,31 @@ def _rung_init():
     }
 
 
+def _bench_micro():
+    """<10 s first rung (warm cache): one 512³ matmul, chain-timed.
+
+    Exists so the report banks a hardware-tagged rung within seconds of
+    a successful backend init — in a hostile-endpoint round the
+    difference between "zero TPU rungs" and "TPU proven up + measured"
+    is exactly this rung (VERDICT r4 item 4)."""
+    import jax.numpy as jnp
+
+    n = 512
+    x = _rand((n, n), 7)
+
+    def step(a):
+        return jnp.matmul(a, x, precision="highest")
+
+    dt = _time_chained(step, x, 4)
+    fl = 2.0 * n ** 3
+    return {
+        "tflops": round(fl / dt / 1e12, 4),
+        "seconds_per_call": round(dt, 6),
+        "shape": [n, n, n],
+        "mfu": _mfu(fl, dt),
+    }
+
+
 def _bench_pairwise(m, dim, iters, sqrt=False):
     from raft_tpu.distance import DistanceType, pairwise_distance
 
@@ -1032,6 +1057,8 @@ def child_main():
         # forfeit the north-star number (the parent can only kill the
         # whole child).
         rungs = [
+            # hardware-tagged rung within seconds of init (module doc)
+            ("micro_matmul", 10, _bench_micro),
             ("pairwise_1k", 30, lambda: _bench_pairwise(1024, 64, 8,
                                                         sqrt=True)),
             ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 8)),
@@ -1224,7 +1251,7 @@ def _partition_attempt_states(states):
 def _rung_metric(v):
     if not isinstance(v, dict):
         return None
-    return v.get("qps") or v.get("gpairs_per_sec")
+    return v.get("qps") or v.get("gpairs_per_sec") or v.get("tflops")
 
 
 def _merge_best_rungs(base, other):
@@ -1293,6 +1320,14 @@ def parent_main():
     # retries all emit PARTIALs — kills the child and respawns on a
     # fresh channel, keeping each attempt's evidence and banked rungs.
     stall_s = float(os.environ.get("RAFT_TPU_BENCH_STALL_S", "420"))
+    # stage-aware stall: BEFORE the child's "init" PARTIAL (backend up)
+    # the only legitimate silence is a healthy backend init, measured at
+    # 0.1-14 s whenever the endpoint was up (r4 sessions) — a silent
+    # 150 s there is a hung init RPC, and a fresh child on a fresh
+    # channel is the only probe that can ever bank a rung.  AFTER init,
+    # long compiles justify the full stall_s.
+    init_stall_s = float(os.environ.get("RAFT_TPU_BENCH_INIT_STALL_S",
+                                        "150"))
     stalled_attempts = []
     banked_states = []
     while time.time() < deadline:
@@ -1309,9 +1344,14 @@ def parent_main():
                 time.sleep(0.1)
             if tpu.final is not None:
                 break
+        cur_stall = (stall_s if tpu.state.get("init")
+                     else init_stall_s)
+        # a fresh child can init in ~15 s and bank the micro rung in a
+        # few more, so re-probing stays worthwhile until nearly the end
+        min_left = 120 if tpu.state.get("init") else 45
         if (not tpu_dead and tpu.final is None
-                and time.time() - tpu.t_last_progress > stall_s
-                and deadline - time.time() > 120):
+                and time.time() - tpu.t_last_progress > cur_stall
+                and deadline - time.time() > min_left):
             note = _tpu_attempt_note(tpu, deadline)
             note["status"] = "killed_stalled_no_progress"
             note["stalled_s"] = round(time.time() - tpu.t_last_progress, 1)

@@ -14,17 +14,32 @@ reorthogonalization costs a little more FLOP but removes the ghost-eigenvalue
 pathology the reference's restart machinery exists to fight, and FLOPs are
 what a TPU has.
 
+The whole solve — basis expansion, Rayleigh-Ritz, thick restarts, and the
+convergence test — is ONE jitted ``lax.while_loop`` computation: no
+host↔device sync per restart and no per-call retrace (the r4 pathology:
+the old per-restart Python loop re-traced its ``fori_loop`` closures every
+call, so a 2k-vertex solve spent ~7.4 s compiling and ~0.05 s computing,
+every time).  The operator crosses the jit boundary as a *pytree*
+(``jax.tree_util.Partial``), so the executable is cached by (operator
+structure, shapes) and reused across calls and instances.
+
 The matrix is supplied as a callable ``mv(x) -> A @ x`` (the
 ``sparse_matrix_t::mv`` interface, reference spectral/matrix_wrappers.hpp:180)
-or as a dense array.
+or as a dense array.  For cache-friendliness a callable should be either a
+bound method of a pytree-registered operator (``LaplacianMatrix.mv``) or a
+``tree_util.Partial`` over array arguments; a plain closure still works but
+embeds its captured arrays as compile-time constants (one recompile per new
+operand — and the large-constant hazard on linked backends).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from jax.tree_util import Partial
 
 from raft_tpu.core.debug import check_finite
 from raft_tpu.core.error import expects
@@ -34,10 +49,34 @@ from raft_tpu.core.handle import takes_handle
 Operator = Union[jnp.ndarray, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
-def _as_mv(a: Operator) -> Callable[[jnp.ndarray], jnp.ndarray]:
-    if callable(a):
+def _dense_mv(a: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    return a @ x
+
+
+def _as_pytree_mv(a: Operator) -> Partial:
+    """Normalize an operator to a pytree callable the jitted solver can
+    take as an ARGUMENT (so its arrays are traced operands, not embedded
+    constants, and the executable cache keys on structure + shapes)."""
+    if not callable(a):
+        return Partial(_dense_mv, jnp.asarray(a))
+    if isinstance(a, Partial):
         return a
-    return lambda x: a @ x
+    self_ = getattr(a, "__self__", None)
+    if self_ is not None and not jax.tree_util.all_leaves([self_]):
+        # bound method of a pytree-registered operator: rebind through
+        # the class function so the instance flows as a pytree argument
+        return Partial(a.__func__, self_)
+    # plain function/closure: static under jit (captured arrays become
+    # constants — documented trade in the module docstring)
+    return Partial(a)
+
+
+def _operand_dtype(mv: Partial):
+    for leaf in jax.tree_util.tree_leaves(mv):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            return dt
+    return jnp.zeros(0).dtype
 
 
 def _expand_basis(mv, v_basis: jnp.ndarray, av_basis: jnp.ndarray,
@@ -85,18 +124,111 @@ def _expand_basis(mv, v_basis: jnp.ndarray, av_basis: jnp.ndarray,
     return jax.lax.fori_loop(start, stop, step, (v_basis, av_basis))
 
 
-def _ritz(v_basis: jnp.ndarray, av_basis: jnp.ndarray, m: int):
-    """Rayleigh-Ritz on the first m columns using cached A@V."""
-    v = v_basis[:, :m]
-    av = av_basis[:, :m]
-    h = v.T @ av
+def _ritz(v_basis: jnp.ndarray, av_basis: jnp.ndarray):
+    """Rayleigh-Ritz on the cached basis/A-basis pair."""
+    h = v_basis.T @ av_basis
     h = 0.5 * (h + h.T)
     theta, s = jnp.linalg.eigh(h)
-    y = v @ s
+    y = v_basis @ s
     # residual norms ||A y - theta y|| per Ritz pair
-    r = av @ s - y * theta[None, :]
+    r = av_basis @ s - y * theta[None, :]
     resid = jnp.linalg.norm(r, axis=0)
     return theta, y, s, resid
+
+
+def _keep_order(theta: jnp.ndarray, which: str) -> jnp.ndarray:
+    return jnp.argsort(theta if which == "smallest" else -theta)
+
+
+def _converged(theta, resid, keep, tol):
+    max_resid = jnp.max(resid[keep])
+    scale = jnp.max(jnp.abs(theta))
+    scale = jnp.where(scale > 0, scale, 1.0)
+    return max_resid <= tol * scale
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n", "k", "which", "m", "max_restarts"))
+def _lanczos_run(mv, n, k, which, m, max_restarts, tol, seed):
+    # tol and seed are traced OPERANDS, not static: a caller sweeping
+    # tolerances or deriving per-call seeds must hit the executable
+    # cache, not recompile the whole solver per value
+    """The whole thick-restart solve as one compiled computation.
+
+    Krylov orthogonality is what convergence rests on: every matmul in
+    the solver (projections, re-orthogonalization, Ritz rotation, and a
+    matrix-operand mv) must run f32-faithful.  XLA's TPU default for f32
+    matmuls is single-pass bf16 — enough orthogonality loss to stall
+    restarts — so the whole body is pinned to "highest".
+    """
+    with jax.default_matmul_precision("highest"):
+        dtype = _operand_dtype(mv)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        v0 = jax.random.uniform(sub, (n,), dtype=dtype,
+                                minval=-1.0, maxval=1.0)
+        v0 = v0 / jnp.linalg.norm(v0)
+
+        def expand(vb, ab, start, sub):
+            vb, ab = _expand_basis(mv, vb, ab, start, m - 1, sub)
+            av_last = mv(vb[:, m - 1])
+            ab = ab.at[:, m - 1].set(av_last)
+            return vb, ab, av_last
+
+        v_basis = jnp.zeros((n, m), dtype=dtype).at[:, 0].set(v0)
+        av_basis = jnp.zeros((n, m), dtype=dtype)
+        key, sub = jax.random.split(key)
+        v_basis, av_basis, av_last = expand(v_basis, av_basis, 0, sub)
+        theta, y, s, resid = _ritz(v_basis, av_basis)
+        carry0 = (v_basis, av_basis, av_last, theta, y, s, resid, key,
+                  jnp.int32(0), jnp.int32(m))
+
+        def cond(carry):
+            _, _, _, theta, _, _, resid, _, restart, _ = carry
+            keep = _keep_order(theta, which)[:k]
+            return jnp.logical_and(restart < max_restarts - 1,
+                                   ~_converged(theta, resid, keep, tol))
+
+        def body(carry):
+            (vb, ab, av_last, theta, y, s, resid, key, restart,
+             n_iter) = carry
+            keep = _keep_order(theta, which)[:k]
+            # thick restart: keep the k wanted Ritz vectors plus the
+            # next Krylov direction A v_m orthogonalized against the
+            # whole basis (all Ritz residuals are parallel to it in
+            # exact arithmetic); fall back to a random draw if the
+            # Krylov space is exhausted.
+            kept = y[:, keep]
+            kept_av = ab @ s[:, keep]
+            fresh = av_last
+            for _ in range(2):
+                fresh = fresh - vb @ (vb.T @ fresh)
+            fnorm = jnp.linalg.norm(fresh)
+            key, sub = jax.random.split(key)
+            rand = jax.random.uniform(sub, (n,), dtype=vb.dtype,
+                                      minval=-1.0, maxval=1.0)
+            rand = rand - kept @ (kept.T @ rand)
+            rand = rand / jnp.maximum(jnp.linalg.norm(rand), 1e-30)
+            fresh = jnp.where(fnorm > 1e-10,
+                              fresh / jnp.maximum(fnorm, 1e-30), rand)
+            vb = jnp.zeros_like(vb).at[:, :k].set(kept).at[:, k].set(fresh)
+            ab = jnp.zeros_like(ab).at[:, :k].set(kept_av)
+            key, sub = jax.random.split(key)
+            vb, ab, av_last = expand(vb, ab, k, sub)
+            theta, y, s, resid = _ritz(vb, ab)
+            return (vb, ab, av_last, theta, y, s, resid, key,
+                    restart + 1, n_iter + jnp.int32(m - k))
+
+        (_, _, _, theta, y, _, _, _, _, n_iter) = jax.lax.while_loop(
+            cond, body, carry0)
+
+        keep = _keep_order(theta, which)[:k]
+        vals = theta[keep]
+        vecs = y[:, keep]
+        srt = _keep_order(vals, "smallest" if which == "smallest"
+                          else "largest")
+        return vals[srt], vecs[:, srt], n_iter
 
 
 def _lanczos(
@@ -109,76 +241,20 @@ def _lanczos(
     tol: float,
     seed: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
-    # Krylov orthogonality is what convergence rests on: every matmul in
-    # the solver (projections, re-orthogonalization, Ritz rotation, and
-    # a matrix-operand mv) must run f32-faithful.  XLA's TPU default for
-    # f32 matmuls is single-pass bf16 — enough orthogonality loss to
-    # stall restarts — so pin the whole solver body.
-    with jax.default_matmul_precision("highest"):
-        return _lanczos_impl(a, n, k, which, ncv, max_restarts, tol, seed)
-
-
-def _lanczos_impl(a, n, k, which, ncv, max_restarts, tol, seed):
-    mv = _as_mv(a)
     expects(0 < k < n, "lanczos: need 0 < k < n (k=%d, n=%d)", k, n)
     m = min(max(ncv, 2 * k + 1), n)
-    dtype = (a.dtype if hasattr(a, "dtype") else jnp.zeros(0).dtype)
-    if not jnp.issubdtype(dtype, jnp.floating):
-        dtype = jnp.float32
-
-    key = jax.random.PRNGKey(seed)
-    key, sub = jax.random.split(key)
-    v0 = jax.random.uniform(sub, (n,), dtype=dtype, minval=-1.0, maxval=1.0)
-    v0 = v0 / jnp.linalg.norm(v0)
-
-    v_basis = jnp.zeros((n, m), dtype=dtype).at[:, 0].set(v0)
-    av_basis = jnp.zeros((n, m), dtype=dtype)
-    n_iter = 0
-    keep = jnp.arange(k)
-    for restart in range(max_restarts):
-        start = 1 if restart == 0 else k + 1
-        key, sub = jax.random.split(key)
-        v_basis, av_basis = _expand_basis(mv, v_basis, av_basis, start - 1, m - 1, sub)
-        # matvec for the last column (the loop fills av only up to m-2)
-        av_last = mv(v_basis[:, m - 1])
-        av_basis = av_basis.at[:, m - 1].set(av_last)
-        n_iter += m - start + 1
-        theta, y, s, resid = _ritz(v_basis, av_basis, m)
-        if which == "smallest":
-            order = jnp.argsort(theta)
-        else:
-            order = jnp.argsort(-theta)
-        keep = order[:k]
-        max_resid = float(jnp.max(resid[keep]))
-        scale = float(jnp.max(jnp.abs(theta))) or 1.0
-        if max_resid <= tol * scale or restart == max_restarts - 1:
-            break
-        # thick restart: keep the k wanted Ritz vectors plus the next Krylov
-        # direction A v_m orthogonalized against the whole basis (all Ritz
-        # residuals are parallel to it in exact arithmetic); fall back to a
-        # random draw if the Krylov space is exhausted.
-        kept = y[:, keep]
-        kept_av = av_basis[:, :m] @ s[:, keep]
-        fresh = av_last
-        for _ in range(2):
-            fresh = fresh - v_basis @ (v_basis.T @ fresh)
-        fnorm = jnp.linalg.norm(fresh)
-        key, sub = jax.random.split(key)
-        rand = jax.random.uniform(sub, (n,), dtype=dtype, minval=-1.0, maxval=1.0)
-        rand = rand - kept @ (kept.T @ rand)
-        rand = rand / jnp.maximum(jnp.linalg.norm(rand), 1e-30)
-        fresh = jnp.where(fnorm > 1e-10, fresh / jnp.maximum(fnorm, 1e-30), rand)
-        v_basis = jnp.zeros((n, m), dtype=dtype)
-        v_basis = v_basis.at[:, :k].set(kept).at[:, k].set(fresh)
-        av_basis = jnp.zeros((n, m), dtype=dtype).at[:, :k].set(kept_av)
-
-    vals = theta[keep]
-    vecs = y[:, keep]
-    if which == "smallest":
-        srt = jnp.argsort(vals)
-    else:
-        srt = jnp.argsort(-vals)
-    return vals[srt], vecs[:, srt], n_iter
+    if m >= n:
+        # the basis spans the whole space after one expansion, so
+        # Rayleigh-Ritz is already the exact (f32) eigendecomposition;
+        # further restarts only churn floating-point noise through the
+        # wanted vectors (an unreachable tol would otherwise spin every
+        # small-n solve through max_restarts of that churn)
+        max_restarts = 1
+    mv = _as_pytree_mv(a)
+    vals, vecs, n_iter = _lanczos_run(mv, n, k, which, m, max_restarts,
+                                      jnp.float32(tol),
+                                      jnp.int32(seed))
+    return vals, vecs, int(n_iter)
 
 
 @takes_handle

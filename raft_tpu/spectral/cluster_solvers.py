@@ -22,6 +22,10 @@ class ClusterSolverConfig:
     max_iter: int = 300
     tol: float = 1e-4
     seed: int = 123456
+    # spectral embeddings are tiny (n × n_eig_vecs) but rich in Lloyd
+    # local optima; restarts are nearly free there and the best-of rule
+    # is what the residual exists for
+    n_init: int = 8
 
 
 class KmeansSolver:
@@ -34,5 +38,5 @@ class KmeansSolver:
         """Cluster rows of obs; returns (labels, residual, iters)."""
         c = self.config
         res = kmeans(obs, c.n_clusters, tol=c.tol, max_iter=c.max_iter,
-                     seed=c.seed)
+                     seed=c.seed, n_init=c.n_init)
         return res.labels, res.residual, res.iters

@@ -65,12 +65,11 @@ def init_plus_plus(X: jnp.ndarray, k: int, key: jax.Array) -> jnp.ndarray:
     return C
 
 
-@functools.partial(jax.jit, static_argnames=("k", "max_iter"))
-def _kmeans_jit(X, k, tol, max_iter, seed):
+@functools.partial(jax.jit, static_argnames=("k", "max_iter", "n_init"))
+def _kmeans_jit(X, k, tol, max_iter, seed, n_init=1):
     n, d = X.shape
     xn = jnp.sum(X * X, axis=1)
     key = jax.random.PRNGKey(seed)
-    C0 = init_plus_plus(X, k, key)
 
     def assign(C):
         if k >= 256:
@@ -101,8 +100,6 @@ def _kmeans_jit(X, k, tol, max_iter, seed):
                          sums / jnp.maximum(counts, 1.0)[:, None], C)
         return newC
 
-    labels0, res0 = assign(C0)
-
     def cond(state):
         _, _, prev_res, res, it = state
         return (it < max_iter) & (jnp.abs(prev_res - res) >
@@ -114,23 +111,51 @@ def _kmeans_jit(X, k, tol, max_iter, seed):
         labels, new_res = assign(C)
         return C, labels, res, new_res, it + 1
 
-    C, labels, _, res, iters = jax.lax.while_loop(
-        cond, body, (C0, labels0, jnp.inf, res0, jnp.int32(0)))
+    def one_solve(sub):
+        C0 = init_plus_plus(X, k, sub)
+        labels0, res0 = assign(C0)
+        return jax.lax.while_loop(
+            cond, body, (C0, labels0, jnp.inf, res0, jnp.int32(0)))
+
+    # restarts as ONE fori_loop over the solve body (traced once
+    # regardless of n_init), keeping the lowest-residual run — Lloyd's
+    # local optima are real on whitened spectral embeddings, where a
+    # bad k-means++ draw can split along an uninformative coordinate.
+    # t=0 consumes `key` itself (not fold_in(key, 0)): keeps the
+    # n_init=1 draw identical to the historical single-init solver, so
+    # quantizer builds and their recall characteristics are unchanged.
+    def restart(t, best):
+        bC, bl, br, bi = best
+        sub = jnp.where(t == 0, key, jax.random.fold_in(key, t))
+        nC, nl, _, nr, ni = one_solve(sub)
+        take = nr < br
+        return (jnp.where(take, nC, bC), jnp.where(take, nl, bl),
+                jnp.where(take, nr, br), jnp.where(take, ni, bi))
+
+    best0 = (jnp.zeros((k, d), X.dtype), jnp.zeros((n,), jnp.int32),
+             jnp.asarray(jnp.inf, X.dtype), jnp.int32(0))
+    C, labels, res, iters = jax.lax.fori_loop(0, n_init, restart, best0)
     return C, labels, res, iters
 
 
 def kmeans(X: jnp.ndarray, k: int, tol: float = 1e-4,
-           max_iter: int = 300, seed: int = 1234567) -> KmeansResult:
+           max_iter: int = 300, seed: int = 1234567,
+           n_init: int = 1) -> KmeansResult:
     """Lloyd k-means with k-means++ init (reference kmeans, kmeans.hpp:775).
 
     Returns (centroids (k, d), labels (n,), residual, iters); ``residual``
-    is the total within-cluster squared distance (reference ``residual_host``).
+    is the total within-cluster squared distance (reference
+    ``residual_host``).  ``n_init`` > 1 repeats the whole solve from
+    fresh k-means++ draws and keeps the lowest-residual run (the
+    spectral cluster solver's default; quantizer builds keep 1).
     """
     X = jnp.asarray(X)
     expects(X.ndim == 2, "kmeans: 2-D observations required")
     expects(1 <= k <= X.shape[0],
             "kmeans: k=%d out of range for %d points", k, X.shape[0])
+    expects(n_init >= 1, "kmeans: n_init must be >= 1, got %d", n_init)
     check_finite(X, "kmeans observations")  # opt-in sanitizer, SURVEY §5
-    C, labels, res, iters = _kmeans_jit(X, k, tol, max_iter, seed)
+    C, labels, res, iters = _kmeans_jit(X, k, tol, max_iter, seed,
+                                        n_init=n_init)
     check_finite(C, "kmeans centroids")
     return KmeansResult(C, labels, res, iters)

@@ -16,12 +16,22 @@ def transform_eigen_matrix(eig_vecs: jnp.ndarray) -> jnp.ndarray:
     """Whiten eigenvector columns: subtract the column mean, divide by
     (column norm / √n) (reference transform_eigen_matrix,
     spectral_util.hpp:118-145; the trailing transpose is a cuBLAS layout
-    detail we don't need)."""
+    detail we don't need).
+
+    Columns that are numerically CONSTANT (centered norm ≲ 1e-3 of the
+    raw norm — e.g. the trivial all-ones Laplacian eigenvector) are
+    zeroed rather than standardized: dividing f32 eigensolver noise by
+    its own tiny norm would hand k-means a unit-variance garbage
+    coordinate that can dominate the informative ones."""
     n = eig_vecs.shape[0]
     centered = eig_vecs - jnp.mean(eig_vecs, axis=0, keepdims=True)
+    raw = jnp.linalg.norm(eig_vecs, axis=0, keepdims=True)
     norms = jnp.linalg.norm(centered, axis=0, keepdims=True)
+    degenerate = norms <= 1e-3 * jnp.maximum(raw, jnp.finfo(
+        eig_vecs.dtype).tiny)
     scale = norms / jnp.sqrt(jnp.asarray(n, eig_vecs.dtype))
-    return centered / jnp.where(scale == 0, 1.0, scale)
+    out = centered / jnp.where(scale == 0, 1.0, scale)
+    return jnp.where(degenerate, 0.0, out)
 
 
 def construct_indicator(cluster_id: int, labels: jnp.ndarray, op
