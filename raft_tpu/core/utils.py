@@ -97,3 +97,25 @@ class Pow2:
 
     def is_aligned(self, x: int) -> bool:
         return (x & self.mask) == 0
+
+
+def as_pytree_fn(fn):
+    """Normalize a callable so it can cross a ``jax.jit`` boundary as an
+    ARGUMENT (``jax.tree_util.Partial``): bound methods of
+    pytree-registered objects rebind through the class function so the
+    instance flows as a traced pytree (executable cache keys on
+    structure + shapes, arrays are operands, not embedded constants).
+    Plain functions become leafless Partials — static under jit, cached
+    by function identity; a fresh closure per call still retraces, so
+    hot paths should pass stable function objects (module-level
+    functions, ``functools.lru_cache``-memoized factories, or
+    Partials over array args)."""
+    import jax
+    from jax.tree_util import Partial
+
+    if isinstance(fn, Partial):
+        return fn
+    self_ = getattr(fn, "__self__", None)
+    if self_ is not None and not jax.tree_util.all_leaves([self_]):
+        return Partial(fn.__func__, self_)
+    return Partial(fn)

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.tree_util import Partial
 
 from raft_tpu.core.debug import check_finite
+from raft_tpu.core.utils import as_pytree_fn
 from raft_tpu.core.error import expects
 
 from raft_tpu.core.handle import takes_handle
@@ -56,19 +57,12 @@ def _dense_mv(a: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
 def _as_pytree_mv(a: Operator) -> Partial:
     """Normalize an operator to a pytree callable the jitted solver can
     take as an ARGUMENT (so its arrays are traced operands, not embedded
-    constants, and the executable cache keys on structure + shapes)."""
+    constants, and the executable cache keys on structure + shapes).
+    Dense arrays become matmul Partials; callables delegate to the
+    shared :func:`raft_tpu.core.utils.as_pytree_fn` normalization."""
     if not callable(a):
         return Partial(_dense_mv, jnp.asarray(a))
-    if isinstance(a, Partial):
-        return a
-    self_ = getattr(a, "__self__", None)
-    if self_ is not None and not jax.tree_util.all_leaves([self_]):
-        # bound method of a pytree-registered operator: rebind through
-        # the class function so the instance flows as a pytree argument
-        return Partial(a.__func__, self_)
-    # plain function/closure: static under jit (captured arrays become
-    # constants — documented trade in the module docstring)
-    return Partial(a)
+    return as_pytree_fn(a)
 
 
 def _operand_dtype(mv: Partial):

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -263,6 +265,13 @@ def weak_cc(csr: CSR, max_iters: int = 0) -> jnp.ndarray:
     (``label <- label[label-1]``) for logarithmic convergence, inside
     ``lax.while_loop``.
     """
+    return _weak_cc_run(csr, max_iters=max_iters)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def _weak_cc_run(csr: CSR, max_iters: int) -> jnp.ndarray:
+    # one cached executable per shape (eager while_loop closures would
+    # retrace every call — r5 retrace audit)
     n = csr.n_rows
     rows = csr.row_ids()
     valid = rows < n

@@ -20,6 +20,7 @@ list, and extracted/deduplicated at the end.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -87,6 +88,21 @@ def mst(csr: CSR,
     (connected components of the input graph).
     """
     V = csr.n_rows
+    if colors is None:
+        colors0 = jnp.arange(V, dtype=jnp.int32)
+    else:
+        colors0 = jnp.asarray(colors, dtype=jnp.int32)
+    cap = max_iterations if max_iterations else \
+        2 * max(int(V - 1).bit_length(), 1) + 4
+    return _mst_run(csr, colors0, cap=cap)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _mst_run(csr: CSR, colors0: jnp.ndarray, cap: int):
+    """The whole Borůvka solve as one cached executable (the linkage
+    pipeline calls mst repeatedly at a fixed shape; an eager while_loop
+    retraced its closures every call — r5 retrace audit)."""
+    V = csr.n_rows
     E = csr.capacity
     rows = csr.row_ids()
     cols = csr.indices
@@ -101,11 +117,6 @@ def mst(csr: CSR,
         eid = minuv * V + maxuv  # canonical undirected edge id
         EID_MAX = jnp.iinfo(jnp.int64).max
         eid = jnp.where(valid, eid, EID_MAX)
-
-    if colors is None:
-        colors0 = jnp.arange(V, dtype=jnp.int32)
-    else:
-        colors0 = jnp.asarray(colors, dtype=jnp.int32)
 
     INT_MAX = jnp.iinfo(jnp.int32).max
     vidx = jnp.arange(V, dtype=jnp.int32)
@@ -145,9 +156,6 @@ def mst(csr: CSR,
         parent = _pointer_jump(parent)
         color = parent[color]
         return color, in_mst, it + 1, jnp.any(cross)
-
-    cap = max_iterations if max_iterations else \
-        2 * max(int(V - 1).bit_length(), 1) + 4
 
     def cond(state):
         _, _, it, progressed = state

@@ -11,15 +11,17 @@ computation overlaps tile t's selection.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu import config
 from raft_tpu.core.error import expects
-from raft_tpu.core.utils import ceildiv
-from raft_tpu.spatial.select_k import top_k_rows
+from raft_tpu.core.utils import as_pytree_fn, ceildiv
+from raft_tpu.spatial.select_k import _resolve_impl, top_k_rows
 
 
 def tiled_knn(
@@ -60,8 +62,22 @@ def tiled_knn(
         merge = config.get("tile_merge")
     expects(merge in ("tile_topk", "direct"),
             "tiled_knn: unknown merge %s", merge)
+    # knobs resolved HERE (outside the jit) and passed static, so the
+    # executable caches on their values; tile_dist crosses the boundary
+    # as a pytree (fresh closures would otherwise retrace the whole
+    # scan every call — the r5 retrace audit caught exactly that on
+    # brute_force_knn's steady state)
+    return _tiled_knn_run(index, queries, as_pytree_fn(tile_dist),
+                          k=k, tile_n=max(k, min(tile_n, n)),
+                          merge=merge, select_impl=_resolve_impl(None))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile_n", "merge",
+                                             "select_impl"))
+def _tiled_knn_run(index, queries, tile_dist, k, tile_n, merge,
+                   select_impl):
+    n = index.shape[0]
     nq = queries.shape[0]
-    tile_n = max(k, min(tile_n, n))
     n_tiles = ceildiv(n, tile_n)
     n_pad = n_tiles * tile_n
     x_p = jnp.pad(index, ((0, n_pad - n), (0, 0)))
@@ -86,7 +102,7 @@ def tiled_knn(
             # wide tile selection dispatches impl (top_k vs the TPU
             # approx_max_k instruction at recall 1.0 — see select_k
             # module doc); the narrow 2k merge below stays a sort
-            t_vals, t_idx = top_k_rows(-d, k)
+            t_vals, t_idx = top_k_rows(-d, k, impl=select_impl)
             t_idx = (j0 + t_idx).astype(jnp.int32)
             cat_d = jnp.concatenate([best_d, -t_vals], axis=1)
             cat_i = jnp.concatenate([best_i, t_idx], axis=1)

@@ -128,7 +128,15 @@ def _kmeans_jit(X, k, tol, max_iter, seed, n_init=1):
         bC, bl, br, bi = best
         sub = jnp.where(t == 0, key, jax.random.fold_in(key, t))
         nC, nl, _, nr, ni = one_solve(sub)
-        take = nr < br
+        # NaN-safe best-of: `nr < br` alone would let a NaN solve lose
+        # every comparison and silently return the zero-initialized
+        # best (all-zero centroids/labels masquerading as a valid
+        # clustering).  A finite run beats any non-finite best; when
+        # both are non-finite the new one replaces the inf sentinel so
+        # an all-NaN solve stays VISIBLE in the returned residual.
+        take = ((nr < br)
+                | (jnp.isfinite(nr) & ~jnp.isfinite(br))
+                | (~jnp.isfinite(nr) & ~jnp.isfinite(br)))
         return (jnp.where(take, nC, bC), jnp.where(take, nl, bl),
                 jnp.where(take, nr, br), jnp.where(take, ni, bi))
 

@@ -221,3 +221,17 @@ class TestR5Regressions:
         r1 = kmeans(X, 4, seed=5, n_init=1)
         r8 = kmeans(X, 4, seed=5, n_init=8)
         assert float(r8.residual) <= float(r1.residual) + 1e-5
+
+    def test_kmeans_nan_solve_stays_visible(self):
+        """A non-finite solve must surface as a non-finite residual, not
+        as the zero-initialized best (r5 review finding)."""
+        from raft_tpu.spectral.kmeans import kmeans
+
+        X = jnp.asarray(np.full((32, 2), 1e20, np.float32))
+        res = kmeans(X, 2, seed=1, n_init=3)
+        assert not np.isfinite(float(res.residual)) or \
+            float(res.residual) >= 0
+        # the all-zero-centroid masquerade: centroids must not be the
+        # untouched zeros sentinel while residual claims +inf
+        if not np.isfinite(float(res.residual)):
+            assert not np.all(np.asarray(res.centroids) == 0.0)
