@@ -38,6 +38,15 @@ def tiled_knn(
     distance tile; padding rows of the index are zeros and their distances
     are overridden to +inf here, so ``tile_dist`` need not handle them.
 
+    STABLE IDENTITY REQUIRED for repeat calls: the scan body is jitted
+    and ``tile_dist`` crosses the boundary via
+    :func:`raft_tpu.core.utils.as_pytree_fn`, so the executable caches
+    on the function's identity (plus operand shapes).  Pass a
+    module-level function, a memoized factory product, or a
+    ``tree_util.Partial`` over array args (see ``fused_l2_knn``); a
+    closure defined per call recompiles the whole scan per call and
+    grows the jit cache without bound.
+
     ``merge`` selects the per-tile selection strategy (default: the
     ``tile_merge`` knob of :mod:`raft_tpu.config`, env alias
     ``RAFT_TPU_TILE_MERGE`` — trace-time-consumption caveat documented

@@ -87,7 +87,23 @@ class TestAssemble:
         out = bench.assemble(None, cpu)
         assert out["metric"] == "pairwise_l2_gpairs_1024x64_cpu_fallback"
         assert out["value"] == 0.25
+        # r5: CPU-vs-A100 is suppressed as cross-hardware noise; the
+        # note says so explicitly (r4 verdict item 5)
+        assert out["vs_baseline"] == 0.0
+        assert "suppressed" in out["vs_baseline_note"]
+
+    def test_cpu_fallback_headline_notes_suppression(self):
+        cpu = {"knn_100k": {"qps": 100.0, "n_index": 100_000}}
+        out = bench.assemble(None, cpu)
+        assert out["metric"].endswith("_cpu_fallback")
+        assert out["vs_baseline"] == 0.0
+        assert "vs_baseline_note" in out
+
+    def test_accelerator_headline_keeps_vs_baseline(self):
+        tpu = {"knn_1m": {"qps": 5000.0, "n_index": 1_000_000}}
+        out = bench.assemble(tpu, {})
         assert out["vs_baseline"] > 0
+        assert "vs_baseline_note" not in out
 
     def test_zero_when_nothing_banked(self):
         out = bench.assemble({}, {})
