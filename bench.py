@@ -1083,6 +1083,9 @@ def child_main():
             # no-hardware round
             ("sparse_pairwise", 40,
              lambda: _bench_sparse_pairwise(512, 32768, 16, 2, 8192)),
+            # affordable on CPU since the r5 single-jit Lanczos (~12 s
+            # incl the graph build; was hours-scale retrace before)
+            ("spectral_100k", 40, _bench_spectral_100k),
         ]
     else:
         def best_select():

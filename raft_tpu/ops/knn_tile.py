@@ -187,6 +187,37 @@ def _bitonic_merge_lanes(keys: jnp.ndarray, vals: jnp.ndarray,
     return keys, vals
 
 
+def tile_local_topk(dist: jnp.ndarray, base_col, *, kpad: int, g: int,
+                    interpret: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(bm, g*kpad) key tile → its kpad smallest, sorted DESCENDING,
+    with reconstructed global ids (finite keys only; ids -1 elsewhere).
+
+    The one owner of the tile-local id-mask / power-of-two lane pad /
+    descending bitonic sort rules, shared by the ``sorttile`` merge
+    branch and the two-phase kernel — the attribution comparison
+    between them is only valid while both use the identical network.
+    """
+    bm = dist.shape[0]
+    inf32 = jnp.float32(_INF)
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (bm, g * kpad), 1)
+    ids = jnp.where(dist < inf32, base_col + lane_w, jnp.int32(-1))
+    # the bitonic network needs a power-of-two width; g need not be one
+    # (ragged tiles) — pad with +inf/-1 lanes that sort last
+    w2 = 1
+    while w2 < g * kpad:
+        w2 *= 2
+    if w2 > g * kpad:
+        pad = w2 - g * kpad
+        dist = jnp.concatenate([dist, jnp.full((bm, pad), inf32)], axis=1)
+        ids = jnp.concatenate(
+            [ids, jnp.full((bm, pad), jnp.int32(-1))], axis=1)
+    # descending full sort: the kpad SMALLEST land in the last lanes,
+    # already descending — the exact bitonic second half a merge tail
+    # wants (no lane reverse needed)
+    sd, si = _bitonic_sort_lanes(dist, ids, interpret, descending=True)
+    return sd[:, -kpad:], si[:, -kpad:]
+
+
 def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
                 base_col: jnp.ndarray, *, kpad: int, g: int,
                 interpret: bool, merge_impl: str
@@ -226,32 +257,16 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
         # kernel's measured-vs-modeled 80x gap (docs/TUNING.md "Open
         # question").  One scalar gate; contributing tiles pay a fixed
         # full-width bitonic sort + one 2*kpad merge tail.
-        lane_w = jax.lax.broadcasted_iota(jnp.int32, (bm, g * kpad), 1)
-        ids = jnp.where(dist < inf32, base_col + lane_w, jnp.int32(-1))
-        # the bitonic network needs a power-of-two width; g need not be
-        # one (ragged tiles) — pad with +inf/-1 lanes that sort last
-        w2 = 1
-        while w2 < g * kpad:
-            w2 *= 2
-        if w2 > g * kpad:
-            pad = w2 - g * kpad
-            dist = jnp.concatenate(
-                [dist, jnp.full((bm, pad), inf32)], axis=1)
-            ids = jnp.concatenate(
-                [ids, jnp.full((bm, pad), jnp.int32(-1))], axis=1)
         worst = bd[:, kpad - 1:kpad]
         # int32 reduce-max, not jnp.any (f64 proxy under x64, as below)
         hit = jnp.max((dist < worst).astype(jnp.int32)) > 0
 
         def _update(args):
             d_, bd_, bi_ = args
-            # descending full sort: the kpad SMALLEST land in the last
-            # lanes, already descending — the exact bitonic second half
-            # the merge tail wants (no lane reverse needed)
-            sd, si = _bitonic_sort_lanes(d_, ids, interpret,
-                                         descending=True)
-            md = jnp.concatenate([bd_, sd[:, -kpad:]], axis=1)
-            mi = jnp.concatenate([bi_, si[:, -kpad:]], axis=1)
+            sd, si = tile_local_topk(d_, base_col, kpad=kpad, g=g,
+                                     interpret=interpret)
+            md = jnp.concatenate([bd_, sd], axis=1)
+            mi = jnp.concatenate([bi_, si], axis=1)
             md, mi = _bitonic_merge_lanes(md, mi, interpret)
             return md[:, :kpad], mi[:, :kpad]
 
@@ -339,6 +354,115 @@ def _knn_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref,
     def _emit():
         od_ref[:] = bd_ref[:]
         oi_ref[:] = bi_ref[:]
+
+
+def _knn_twophase_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref, *,
+                         kpad, bn, n_index, g, precision, interpret):
+    """Phase 1 of the no-carry two-phase kNN: distance tile + tile-local
+    top-kpad, written out PER TILE.
+
+    Structurally the opposite end of the design space from
+    :func:`_knn_kernel`: no VMEM carry across index tiles, no
+    threshold gate, no data-dependent while loop — both grid dimensions
+    are parallel, so Mosaic can pipeline freely.  Exists to attribute
+    (and, if the r4 80x anomaly is carry/gate/pipeline-bound, to win)
+    the fused kernel's measured-vs-modeled gap: t(twophase) isolates
+    MXU + DMA + the pure selection network with zero cross-tile
+    structure.  Phase 2 (one narrow XLA merge over n_tiles*kpad) lives
+    in :func:`fused_knn_twophase`.
+    """
+    j = pl.program_id(1)
+    acc = jax.lax.dot_general(
+        q_ref[:], x_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dist = qn_ref[:] + xn_ref[:] - 2.0 * acc
+    dist = jnp.maximum(dist, 0.0)
+    inf32 = jnp.float32(_INF)
+    bm = dist.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+    dist = jnp.where(j * bn + col < n_index, dist, inf32)
+
+    sd, si = tile_local_topk(dist, j * bn, kpad=kpad, g=g,
+                             interpret=interpret)
+    od_ref[:] = sd
+    oi_ref[:] = si
+
+
+def fused_knn_twophase(
+    index: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    block_q: int = 256,
+    block_n: int = 1024,
+    precision: str = "highest",
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest index rows: Pallas per-tile select + one XLA merge.
+
+    Same contract as :func:`fused_knn_tile` (exact squared-L2 top-k,
+    ascending, int32 ids; k <= 128).  The kernel emits each index
+    tile's local top-kpad — (nq, n_tiles*kpad) candidates — and a
+    single XLA ``select_k`` merges them: selection work outside the
+    kernel shrinks from width n to n_tiles*kpad (8x at the 100k bench
+    geometry), and the kernel keeps zero cross-tile state.  Measured
+    against ``merge``/``sorttile`` by ``tools/knn_kernel_sweep.py``.
+    """
+    expects(index.ndim == 2 and queries.ndim == 2
+            and index.shape[1] == queries.shape[1],
+            "fused_knn_twophase: shape mismatch")
+    n, d = index.shape
+    nq = queries.shape[0]
+    expects(0 < k <= n,
+            "fused_knn_twophase: k=%d out of range for n=%d", k, n)
+    expects(k <= 128,
+            "fused_knn_twophase: k <= 128 (bitonic width cap; got %d)", k)
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    kpad = 128
+    bm, bn, g, dp, mp, np_ = tile_geometry(nq, n, d, block_q, block_n,
+                                           unit=kpad)
+    xf, xn_row = pad_with_norms(index, np_, dp)
+    qf, qn_row = pad_with_norms(queries, mp, dp)
+    xn = xn_row[None, :]
+    qn = qn_row[:, None]
+
+    grid = (mp // bm, np_ // bn)
+    kern = functools.partial(
+        _knn_twophase_kernel, kpad=kpad, bn=bn, n_index=n, g=g,
+        precision=jax.lax.Precision(precision) if precision else None,
+        interpret=interpret)
+    part_d, part_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, dp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, dp), lambda i, j: (j, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, kpad), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, grid[1] * kpad), jnp.float32),
+            jax.ShapeDtypeStruct((mp, grid[1] * kpad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        interpret=interpret,
+    )(qf, xf, qn, xn)
+
+    # phase 2: one narrow merge (deferred import: spatial.select_k's
+    # pallas impl imports back into ops)
+    from raft_tpu.spatial.select_k import select_k
+
+    out_d, out_i = select_k(part_d[:nq], k, select_min=True,
+                            values=part_i[:nq])
+    # deficit slots (n < kpad per tile never happens since k <= n, but
+    # masked-padding lanes carry -1) — clamp in-range like the others
+    return out_d, jnp.clip(out_i, 0, n - 1)
 
 
 def fused_knn_tile(

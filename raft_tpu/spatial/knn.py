@@ -242,6 +242,9 @@ def brute_force_knn(
             translations.append(total)
             total += p.shape[0]
 
+    expects(isinstance(rerank_ratio, int) and rerank_ratio >= 1,
+            "brute_force_knn: rerank_ratio must be an int >= 1, got %r",
+            rerank_ratio)
     expects(rerank_ratio == 1 or metric in _L2_FAMILY,
             "brute_force_knn: rerank_ratio applies to the L2 family only")
     select_min = metric not in _IP_FAMILY

@@ -237,3 +237,24 @@ class TestXlaPathsExportForTPU:
             return mnmg_knn(x, q, 10, mesh=mesh, axis="ranks")
 
         self._export(f, (1000, 32), (64, 32))
+
+
+class TestTwophaseLowersForTPU:
+    """No-carry two-phase kernel (r5): per-tile select, parallel grid."""
+
+    @pytest.mark.parametrize("k", [8, 100])
+    def test_k_sweep(self, k):
+        from raft_tpu.ops.knn_tile import fused_knn_twophase
+
+        _export_tpu(
+            lambda x, q: fused_knn_twophase(x, q, k, block_n=1024,
+                                            interpret=False),
+            (8192, 128), (256, 128))
+
+    def test_ragged_tail(self):
+        from raft_tpu.ops.knn_tile import fused_knn_twophase
+
+        _export_tpu(
+            lambda x, q: fused_knn_twophase(x, q, 10, block_n=1024,
+                                            interpret=False),
+            (5000, 96), (100, 96))

@@ -682,3 +682,35 @@ class TestRerank:
         with pytest.raises(Exception):
             brute_force_knn([x], q, 4, metric=D.InnerProduct,
                             rerank_ratio=4)
+
+
+@pytest.mark.parametrize("n,nq,d,k", [
+    (300, 17, 13, 5),         # sub-tile, odd sizes (ragged pow2 pad)
+    (3000, 33, 128, 100),     # multi index tile, north-star k
+    (2500, 24, 64, 10),
+])
+def test_fused_knn_twophase_exact(rng, n, nq, d, k):
+    """No-carry two-phase kernel (r5): per-tile select + XLA merge must
+    match the naive reference exactly (interpret mode on CPU)."""
+    from raft_tpu.ops.knn_tile import fused_knn_twophase
+
+    index = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((nq, d)).astype(np.float32)
+    dist, idx = fused_knn_twophase(jnp.asarray(index),
+                                   jnp.asarray(queries), k)
+    ref_d, _ = naive_knn(index, queries, k)
+    np.testing.assert_allclose(np.asarray(dist), ref_d, rtol=1e-4,
+                               atol=1e-4)
+    full = ((queries[:, None, :] - index[None, :, :]) ** 2).sum(-1)
+    chosen = np.take_along_axis(full, np.asarray(idx), axis=1)
+    np.testing.assert_allclose(chosen, ref_d, rtol=1e-4, atol=1e-4)
+    assert (np.asarray(idx) >= 0).all() and (np.asarray(idx) < n).all()
+
+
+def test_fused_knn_twophase_k_cap(rng):
+    from raft_tpu.ops.knn_tile import fused_knn_twophase
+
+    x = jnp.asarray(rng.standard_normal((400, 8)).astype(np.float32))
+    q = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32))
+    with pytest.raises(Exception):
+        fused_knn_twophase(x, q, 129)

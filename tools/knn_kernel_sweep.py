@@ -68,6 +68,24 @@ def main():
     emit({"config": "xla_scan", "seconds_per_batch": round(dt, 4),
           "qps": round(nq / dt, 1)})
 
+    # bf16 stage-1 + exact f32 re-rank (r5): the candidate-set answer
+    # to selection cost — ride the same honest step shape
+    from raft_tpu.spatial import brute_force_knn
+
+    for ratio in (2, 4):
+        def rstep(qq, ratio=ratio):
+            d2, i2 = brute_force_knn([x], qq, k, rerank_ratio=ratio)
+            return d2 + i2.astype(d2.dtype)
+        try:
+            dt = _time_chained(rstep, q, 2)
+            emit({"config": f"xla_rerank{ratio}",
+                  "seconds_per_batch": round(dt, 4),
+                  "qps": round(nq / dt, 1)})
+        except Exception as e:
+            emit({"config": f"xla_rerank{ratio}", "error": str(e)[-200:]})
+            if "UNAVAILABLE" in str(e):
+                return
+
     # XLA-path merge/select variants (same honest step shape);
     # tile_n scan rides on the winner question too
     for name, kw in (("xla_direct", {"merge": "direct"}),
@@ -96,6 +114,31 @@ def main():
             emit({"config": name, "error": str(e)[-200:]})
             if "UNAVAILABLE" in str(e):
                 return
+
+    # two-phase no-carry kernel (r5): per-tile select in-kernel, one
+    # narrow XLA merge outside — zero cross-tile state, both grid dims
+    # parallel.  t(twophase) vs t(sorttile) attributes the carry/gate/
+    # pipeline share of the r4 80x anomaly directly.
+    from raft_tpu.ops.knn_tile import fused_knn_twophase
+
+    for bq in (64, 256):
+        for bn in (1024, 2048):
+            def tstep(qq, bq=bq, bn=bn):
+                d, i = fused_knn_twophase(x, qq, k, block_q=bq,
+                                          block_n=bn)
+                return d + i.astype(d.dtype)
+            try:
+                t0 = time.time()
+                dt = _time_chained(tstep, q, 2)
+                emit({"config": f"pallas_twophase_bq{bq}_bn{bn}",
+                      "seconds_per_batch": round(dt, 4),
+                      "qps": round(nq / dt, 1),
+                      "t_incl_compile": round(time.time() - t0, 1)})
+            except Exception as e:
+                emit({"config": f"pallas_twophase_bq{bq}_bn{bn}",
+                      "error": str(e)[-200:]})
+                if "UNAVAILABLE" in str(e):
+                    return
 
     # "skip" is the attribution probe (WRONG results by design): its
     # time is the kernel's MXU+DMA+grid+gate floor, so
