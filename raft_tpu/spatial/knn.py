@@ -31,6 +31,7 @@ uses int64_t for Dask-global ids; the MNMG layer widens at the boundary).
 from __future__ import annotations
 
 import functools
+import numbers
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -242,9 +243,11 @@ def brute_force_knn(
             translations.append(total)
             total += p.shape[0]
 
-    expects(isinstance(rerank_ratio, int) and rerank_ratio >= 1,
-            "brute_force_knn: rerank_ratio must be an int >= 1, got %r",
+    expects(isinstance(rerank_ratio, numbers.Integral)
+            and not isinstance(rerank_ratio, bool) and rerank_ratio >= 1,
+            "brute_force_knn: rerank_ratio must be an integer >= 1, got %r",
             rerank_ratio)
+    rerank_ratio = int(rerank_ratio)
     expects(rerank_ratio == 1 or metric in _L2_FAMILY,
             "brute_force_knn: rerank_ratio applies to the L2 family only")
     select_min = metric not in _IP_FAMILY

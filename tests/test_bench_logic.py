@@ -285,3 +285,34 @@ class TestEnvPins:
         except RuntimeError:
             pass
         assert os.environ["RAFT_TPU_SELECT_IMPL"] == "topk"
+
+
+class TestTwophase1mGate:
+    """knn_1m_twophase runs ONLY after the two-phase kernel proves
+    correct and fastest at 100k (r5); wrong-but-fast or unvalidated
+    states must skip."""
+
+    def test_skips_when_not_validated(self):
+        out = bench._bench_knn_twophase_1m(
+            {"pallas_check": {"twophase_qps_100k": 9999.0,
+                              "xla_qps_100k": 1.0}})
+        assert out["status"] == "skipped_twophase_not_validated"
+
+    def test_skips_when_not_faster(self):
+        out = bench._bench_knn_twophase_1m(
+            {"pallas_check": {"twophase_dist_close": True,
+                              "twophase_idx_match": True,
+                              "twophase_qps_100k": 10.0,
+                              "xla_qps_100k": 20.0,
+                              "pallas_qps_100k": 1.0}})
+        assert out["status"] == "skipped_twophase_not_faster"
+
+    def test_skips_on_missing_check(self):
+        out = bench._bench_knn_twophase_1m({})
+        assert out["status"].startswith("skipped")
+
+    def test_assemble_prefers_best_1m_rung(self):
+        tpu = {"knn_1m": {"qps": 100.0, "n_index": 1_000_000},
+               "knn_1m_twophase": {"qps": 250.0, "n_index": 1_000_000}}
+        out = bench.assemble(tpu, {})
+        assert out["value"] == 250.0
