@@ -1151,6 +1151,12 @@ def child_main():
             # affordable on CPU since the r5 single-jit Lanczos (~12 s
             # incl the graph build; was hours-scale retrace before)
             ("spectral_100k", 40, _bench_spectral_100k),
+            # r5: retrace fixes made the 50k linkage pipeline ~60 s on
+            # CPU; banked when budget remains so a no-hardware round
+            # still carries HAC evidence
+            ("linkage_50k", 150, _bench_linkage_50k),
+            ("knn_100k_rerank", 90,
+             lambda: _bench_knn_rerank(100_000, 512, 2)),
         ]
     else:
         def best_select():
