@@ -99,15 +99,23 @@ def check_merge_impls(n, nq, d, k, seed=0):
     (docs/TUNING.md "Open question")."""
     import jax
 
-    from raft_tpu.ops.knn_tile import fused_knn_tile
+    from raft_tpu.ops.knn_tile import fused_knn_tile, fused_knn_twophase
 
     x = rand((n, d), seed)
     q = rand((nq, d), seed + 1)
     rec = {"check": "knn_merge_impls", "n": n, "nq": nq, "d": d, "k": k}
+    impls = ["merge", "fullsort", "sorttile"]
+    if k <= 128:
+        # r5 no-carry kernel (per-tile select + XLA merge) joins the
+        # A/B whenever its bitonic-width cap allows
+        impls.append("twophase")
     outs = {}
-    for impl in ("merge", "fullsort", "sorttile"):
-        f = jax.jit(lambda xx, qq, impl=impl: fused_knn_tile(
-            xx, qq, k, merge_impl=impl))
+    for impl in impls:
+        if impl == "twophase":
+            f = jax.jit(lambda xx, qq: fused_knn_twophase(xx, qq, k))
+        else:
+            f = jax.jit(lambda xx, qq, impl=impl: fused_knn_tile(
+                xx, qq, k, merge_impl=impl))
         t0 = time.time()
         dd, ii = f(x, q)
         jax.block_until_ready((dd, ii))
@@ -120,13 +128,12 @@ def check_merge_impls(n, nq, d, k, seed=0):
             ts.append(time.time() - t0)
         rec[f"t_{impl}_steady"] = round(min(ts), 4)
         outs[impl] = (np.asarray(dd), np.asarray(ii))
-    rec["dist_ok"] = bool(
-        np.allclose(outs["merge"][0], outs["fullsort"][0],
-                    rtol=1e-5, atol=1e-3)
-        and np.allclose(outs["merge"][0], outs["sorttile"][0],
-                        rtol=1e-5, atol=1e-3))
-    mism = ((outs["merge"][1] != outs["fullsort"][1])
-            | (outs["merge"][1] != outs["sorttile"][1]))
+    rec["dist_ok"] = bool(all(
+        np.allclose(outs[i][0], outs["fullsort"][0], rtol=1e-5, atol=1e-3)
+        for i in impls))
+    mism = np.zeros_like(outs["merge"][1], dtype=bool)
+    for i in impls:
+        mism |= outs[i][1] != outs["fullsort"][1]
     rec["idx_mismatch_frac"] = float(mism.mean())
     # every index mismatch must be a genuine tie: RECOMPUTE the distance
     # at the id EACH network claims (same guard as check_knn — a
@@ -136,7 +143,7 @@ def check_merge_impls(n, nq, d, k, seed=0):
     qh = np.asarray(q, np.float64)
     rows, poss = np.nonzero(mism)
     ties_ok = True
-    for impl in ("merge", "fullsort", "sorttile"):
+    for impl in impls:
         d_at_claim = ((qh[rows] - xh[outs[impl][1][rows, poss]]) ** 2
                       ).sum(axis=1)
         ties_ok = ties_ok and bool(np.allclose(
@@ -148,6 +155,9 @@ def check_merge_impls(n, nq, d, k, seed=0):
         rec["t_fullsort_steady"] / max(rec["t_merge_steady"], 1e-9), 2)
     rec["speedup_sorttile_vs_merge"] = round(
         rec["t_merge_steady"] / max(rec["t_sorttile_steady"], 1e-9), 2)
+    if "twophase" in impls:
+        rec["speedup_twophase_vs_merge"] = round(
+            rec["t_merge_steady"] / max(rec["t_twophase_steady"], 1e-9), 2)
     emit(rec)
     return rec["ok"]
 

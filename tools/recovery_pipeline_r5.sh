@@ -8,9 +8,10 @@
 # are the suspected wedge cause, so we avoid them except as backstop).
 #
 # Priority on recovery: the full bench FIRST (banks rungs
-# incrementally, contains every open measurement), then the kNN
-# selection sweep (VERDICT r4 item 1/2), then pairwise + spectral +
-# second-tier tools.
+# incrementally, contains every open measurement, and its pallas_check
+# cross-validates every kernel — incl. twophase — before any timing is
+# trusted), then the kNN selection sweep (VERDICT r4 item 1/2), then
+# the full on-chip validation suite and the second-tier timing tools.
 #
 # Stand-down: past 03:00 UTC (and before 16:00 UTC, i.e. next-day
 # morning) the pipeline exits so the driver's round-end bench finds a
@@ -92,5 +93,7 @@ run_tool() {  # run_tool <script> <logfile>
   echo "$1 rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
 }
 run_tool tools/knn_kernel_sweep.py .knn_sweep_r5.log
+run_tool tools/onchip_check.py .onchip_r05.log
 run_tool tools/select_variants.py .select_variants_r5.log
+run_tool tools/steady_knn.py .steady_knn_r5.log
 echo "=== r5 pipeline done $(date -u +%H:%M:%S) ===" >> "$LOG"
