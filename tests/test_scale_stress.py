@@ -3,17 +3,16 @@
 The reference stresses single_linkage / spectral at real sizes
 (cpp/test/sparse/linkage.cu end-to-end, cpp/bench/spatial/knn.cu);
 until round 3 ours were only exercised at m ~ 2k.  These run the same
-algorithms at 50k / 100k vertices on the virtual CPU mesh — minutes,
-not seconds, hence the ``slow`` marker (deselect with ``-m "not
-slow"``).
+algorithms at 50k / 100k vertices on the virtual CPU mesh.  The 50k
+linkage still takes minutes and keeps the ``slow`` marker (deselect
+with ``-m "not slow"``); the 100k spectral partition dropped to ~10 s
+with the r5 single-jit Lanczos and now runs by default.
 """
 
 import time
 
 import numpy as np
 import pytest
-
-pytestmark = pytest.mark.slow
 
 
 def _adjusted_rand_index(a: np.ndarray, b: np.ndarray) -> float:
@@ -39,6 +38,7 @@ def _adjusted_rand_index(a: np.ndarray, b: np.ndarray) -> float:
     return (sum_ij - expected) / (max_index - expected)
 
 
+@pytest.mark.slow
 def test_single_linkage_50k(rng):
     """m=50k single-linkage: full-size run recovers the blob structure,
     and agrees with scipy single linkage on a subsample (the reference's

@@ -9,6 +9,7 @@ answers:
     python tools/knn_kernel_sweep.py > .knn_sweep.log 2>&1
 """
 
+import contextlib
 import json
 import os
 import sys
@@ -121,24 +122,33 @@ def main():
     # pipeline share of the r4 80x anomaly directly.
     from raft_tpu.ops.knn_tile import fused_knn_twophase
 
+    from raft_tpu import config as rt_config
+
     for bq in (64, 256):
         for bn in (1024, 2048):
-            def tstep(qq, bq=bq, bn=bn):
-                d, i = fused_knn_twophase(x, qq, k, block_q=bq,
-                                          block_n=bn)
-                return d + i.astype(d.dtype)
-            try:
-                t0 = time.time()
-                dt = _time_chained(tstep, q, 2)
-                emit({"config": f"pallas_twophase_bq{bq}_bn{bn}",
-                      "seconds_per_batch": round(dt, 4),
-                      "qps": round(nq / dt, 1),
-                      "t_incl_compile": round(time.time() - t0, 1)})
-            except Exception as e:
-                emit({"config": f"pallas_twophase_bq{bq}_bn{bn}",
-                      "error": str(e)[-200:]})
-                if "UNAVAILABLE" in str(e):
-                    return
+            for sel in (None, "chunked"):
+                def tstep(qq, bq=bq, bn=bn, sel=sel):
+                    # sel pins phase 2's merge select (width
+                    # n_tiles*kpad): chunked may beat one wide top_k
+                    ctx = (rt_config.override(select_impl=sel) if sel
+                           else contextlib.nullcontext())
+                    with ctx:
+                        d, i = fused_knn_twophase(x, qq, k, block_q=bq,
+                                                  block_n=bn)
+                    return d + i.astype(d.dtype)
+                name = (f"pallas_twophase_bq{bq}_bn{bn}"
+                        + (f"_{sel}" if sel else ""))
+                try:
+                    t0 = time.time()
+                    dt = _time_chained(tstep, q, 2)
+                    emit({"config": name,
+                          "seconds_per_batch": round(dt, 4),
+                          "qps": round(nq / dt, 1),
+                          "t_incl_compile": round(time.time() - t0, 1)})
+                except Exception as e:
+                    emit({"config": name, "error": str(e)[-200:]})
+                    if "UNAVAILABLE" in str(e):
+                        return
 
     # "skip" is the attribution probe (WRONG results by design): its
     # time is the kernel's MXU+DMA+grid+gate floor, so
