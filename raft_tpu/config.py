@@ -46,7 +46,8 @@ pq_adc
     Resolved at call time, not trace time.
 spmv_impl
     CSR SpMV (:func:`raft_tpu.sparse.linalg.csr_spmv`): ``segment``
-    (gather + sorted segment-sum) | ``cumsum`` (prefix-sum form).
+    (gather + sorted segment-sum) | ``cumsum`` (prefix-sum form) |
+    ``sortscan`` (gather-free: sort+scan formulation of the x read).
 """
 
 from __future__ import annotations
@@ -70,7 +71,8 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Tuple[str, ...]]] = {
     "fused_knn_impl": ("RAFT_TPU_FUSED_KNN_IMPL", None,
                        ("xla", "pallas")),
     "pq_adc": ("RAFT_TPU_PQ_ADC", "gather", ("gather", "onehot")),
-    "spmv_impl": ("RAFT_TPU_SPMV_IMPL", "segment", ("segment", "cumsum")),
+    "spmv_impl": ("RAFT_TPU_SPMV_IMPL", "segment",
+                  ("segment", "cumsum", "sortscan")),
 }
 
 _values: Dict[str, Optional[str]] = {}

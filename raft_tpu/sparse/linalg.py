@@ -199,12 +199,58 @@ def symmetrize_knn(knn_indices: jnp.ndarray, knn_dists: jnp.ndarray,
 # --------------------------------------------------------------------- #
 # SpMV
 # --------------------------------------------------------------------- #
+def gather_via_sortscan(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``x[idx]`` with NO gather op: two variadic sorts + one
+    associative scan, all vector-shaped on TPU.
+
+    The per-element gather is the serial shape on a TPU (r4 finding for
+    2-D take_along_axis; the same lowering serves 1-D LUT reads).  The
+    sort formulation interleaves the n sources with the m probes —
+    source i keyed ``2·i``, probe j keyed ``2·idx[j]+1``, so each probe
+    lands immediately after its source — then a "last source value"
+    associative scan fills every probe, and a second sort restores
+    probe order.  O((n+m)·log(n+m)) fully-parallel work instead of m
+    serial reads; wins whenever the gather is serial and loses only the
+    log factor where it is not (the spmv_impl knob A/Bs both on chip).
+
+    Indices must be in ``[0, n)``; out-of-range values (either side)
+    are CLAMPED into range.  Unlike numpy fancy indexing, negative
+    indices do not wrap — a pre-sorted ``-1`` probe would silently fill
+    0.0 without the clamp, so the clamp makes the contract deterministic
+    instead (the same rule csr_spmv's padding mask relies on).
+    """
+    n = x.shape[0]
+    m = idx.shape[0]
+    i32 = jnp.int32
+    idx = jnp.clip(idx, 0, n - 1)
+    keys = jnp.concatenate([
+        2 * jnp.arange(n, dtype=i32),
+        2 * idx.astype(i32) + 1])
+    vals = jnp.concatenate([x, jnp.zeros((m,), x.dtype)])
+    is_src = jnp.concatenate([jnp.ones((n,), i32), jnp.zeros((m,), i32)])
+    pos = jnp.concatenate([
+        jnp.full((n,), m, i32),          # sources sort AFTER all probes
+        jnp.arange(m, dtype=i32)])       # in the restore pass
+    _, sv, ssrc, spos = jax.lax.sort((keys, vals, is_src, pos),
+                                     num_keys=1)
+
+    def last_source(a, b):
+        av, asrc = a
+        bv, bsrc = b
+        return jnp.where(bsrc > 0, bv, av), jnp.maximum(asrc, bsrc)
+
+    filled, _ = jax.lax.associative_scan(last_source, (sv, ssrc), axis=0)
+    _, out = jax.lax.sort((spos, filled), num_keys=1)
+    return out[:m]
+
+
 def csr_spmv(csr: CSR, x: jnp.ndarray,
              impl: Optional[str] = None) -> jnp.ndarray:
     """y = A @ x (replaces cusparseSpMV; the Lanczos hot loop rides
     this, see spectral/matrix_wrappers.hpp:180).
 
-    ``impl`` (env default ``RAFT_TPU_SPMV_IMPL``):
+    ``impl`` (default: the ``spmv_impl`` knob of :mod:`raft_tpu.config`,
+    env alias ``RAFT_TPU_SPMV_IMPL``):
 
     - ``"segment"`` (default): gather + sorted segment-sum.
     - ``"cumsum"``: prefix-sum formulation — y[i] = cs[indptr[i+1]] -
@@ -219,10 +265,16 @@ def csr_spmv(csr: CSR, x: jnp.ndarray,
       graph-Laplacian-shaped data (alternating signs, bounded rows);
       prefer "segment" when row sums are tiny relative to the global
       mass.
+    - ``"sortscan"``: like ``"segment"`` but the nnz-sized
+      ``x[indices]`` read goes through :func:`gather_via_sortscan`
+      (no gather op at all) — the candidate win where the serial
+      element gather, not the reduction, bounds the TPU matvec (the
+      large-graph spectral regime; small graphs densify instead,
+      spectral/matrix_wrappers.py).
     """
     if impl is None:
         impl = config.get("spmv_impl")
-    expects(impl in ("segment", "cumsum"),
+    expects(impl in ("segment", "cumsum", "sortscan"),
             "csr_spmv: unknown impl %s", impl)
     if impl == "cumsum":
         # validity needs only the entry position vs nnz (the tail is
@@ -238,7 +290,11 @@ def csr_spmv(csr: CSR, x: jnp.ndarray,
         return cs[csr.indptr[1:]] - cs[csr.indptr[:-1]]
     rows = csr.row_ids()
     valid = rows < csr.n_rows
-    xv = x[jnp.where(valid, csr.indices, 0)]
+    safe_idx = jnp.where(valid, csr.indices, 0)
+    if impl == "sortscan":
+        xv = gather_via_sortscan(x, safe_idx)
+    else:
+        xv = x[safe_idx]
     contrib = jnp.where(valid, csr.data * xv, 0)
     # rows ascending (padding tail = n_rows): sorted segmented sum, not
     # random scatter-add — the Lanczos hot loop rides this

@@ -258,3 +258,24 @@ class TestTwophaseLowersForTPU:
             lambda x, q: fused_knn_twophase(x, q, 10, block_n=1024,
                                             interpret=False),
             (5000, 96), (100, 96))
+
+
+class TestSortscanSpmvLowersForTPU:
+    """Not a Pallas kernel, but the gather-free SpMV's sort+scan must
+    lower for TPU (variadic 4-operand sort + tuple associative_scan)."""
+
+    def test_sortscan_spmv(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.sparse.formats import CSR
+        from raft_tpu.sparse.linalg import csr_spmv
+
+        def f(indptr, indices, data, x):
+            a = CSR(indptr, indices, data, shape=(512, 400))
+            return csr_spmv(a, x, impl="sortscan")
+
+        args = [jax.ShapeDtypeStruct((513,), jnp.int32),
+                jax.ShapeDtypeStruct((4096,), jnp.int32),
+                jax.ShapeDtypeStruct((4096,), jnp.float32),
+                jax.ShapeDtypeStruct((400,), jnp.float32)]
+        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
