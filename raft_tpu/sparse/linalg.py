@@ -227,12 +227,13 @@ def gather_via_sortscan(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
         2 * jnp.arange(n, dtype=i32),
         2 * idx.astype(i32) + 1])
     vals = jnp.concatenate([x, jnp.zeros((m,), x.dtype)])
-    is_src = jnp.concatenate([jnp.ones((n,), i32), jnp.zeros((m,), i32)])
     pos = jnp.concatenate([
         jnp.full((n,), m, i32),          # sources sort AFTER all probes
         jnp.arange(m, dtype=i32)])       # in the restore pass
-    _, sv, ssrc, spos = jax.lax.sort((keys, vals, is_src, pos),
-                                     num_keys=1)
+    _, sv, spos = jax.lax.sort((keys, vals, pos), num_keys=1)
+    # source flag derived from pos (sources carry m) — one fewer
+    # (n+m)-sized operand through the variadic sort
+    ssrc = (spos == m).astype(i32)
 
     def last_source(a, b):
         av, asrc = a

@@ -47,21 +47,31 @@ class SparseMatrix:
 
     _leaf_fields = ("csr", "dense")
 
-    def __init__(self, csr: CSR, densify: bool | None = None):
+    def __init__(self, csr: CSR, densify: bool | None = None,
+                 spmv_impl: str | None = None):
         self.csr = csr
         if densify is None:
             densify = (is_tpu_backend()
                        and csr.n_rows * csr.n_cols <= _DENSIFY_ELEMS)
         self.dense = csr.to_dense() if densify else None
+        # pinned SpMV impl (None = the config knob at trace time).  AUX
+        # data, not a leaf: it participates in the treedef, so two
+        # operators pinned to different impls compile to different
+        # executables — a config-only switch cannot reach an
+        # already-compiled solver (the raft_tpu.config caveat; this
+        # probe-bit the r5 spectral A/B until the pin existed)
+        self.spmv_impl = spmv_impl
 
     def tree_flatten(self):
-        return tuple(getattr(self, f) for f in self._leaf_fields), ()
+        return (tuple(getattr(self, f) for f in self._leaf_fields),
+                (self.spmv_impl,))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         obj = cls.__new__(cls)
         for f, v in zip(cls._leaf_fields, leaves):
             setattr(obj, f, v)
+        obj.spmv_impl = aux[0]
         return obj
 
     @property
@@ -71,7 +81,7 @@ class SparseMatrix:
     def _ax(self, x: jnp.ndarray) -> jnp.ndarray:
         if self.dense is not None:
             return jnp.matmul(self.dense, x, precision="highest")
-        return csr_spmv(self.csr, x)
+        return csr_spmv(self.csr, x, impl=self.spmv_impl)
 
     def mv(self, x: jnp.ndarray) -> jnp.ndarray:
         return self._ax(x)
@@ -85,8 +95,9 @@ class LaplacianMatrix(SparseMatrix):
     _leaf_fields = ("csr", "dense", "diagonal")
 
     def __init__(self, csr: CSR, diagonal: jnp.ndarray | None = None,
-                 densify: bool | None = None):
-        super().__init__(csr, densify=densify)
+                 densify: bool | None = None,
+                 spmv_impl: str | None = None):
+        super().__init__(csr, densify=densify, spmv_impl=spmv_impl)
         if diagonal is None:
             if self.dense is not None:
                 # degree from the dense form (one MXU-friendly row sum)
@@ -95,7 +106,9 @@ class LaplacianMatrix(SparseMatrix):
                 diagonal = jnp.sum(self.dense, axis=1)
             else:
                 ones = jnp.ones((csr.n_cols,), dtype=csr.data.dtype)
-                diagonal = csr_spmv(csr, ones)
+                # the pin covers EVERY matvec the operator performs,
+                # the degree precompute included
+                diagonal = csr_spmv(csr, ones, impl=self.spmv_impl)
         self.diagonal = diagonal
 
     def mv(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -110,8 +123,10 @@ class ModularityMatrix(LaplacianMatrix):
     _leaf_fields = ("csr", "dense", "diagonal", "edge_sum")
 
     def __init__(self, csr: CSR, diagonal: jnp.ndarray | None = None,
-                 densify: bool | None = None):
-        super().__init__(csr, diagonal, densify=densify)
+                 densify: bool | None = None,
+                 spmv_impl: str | None = None):
+        super().__init__(csr, diagonal, densify=densify,
+                         spmv_impl=spmv_impl)
         self.edge_sum = jnp.sum(jnp.abs(self.diagonal))
 
     def mv(self, x: jnp.ndarray) -> jnp.ndarray:

@@ -161,6 +161,20 @@ class TestXlaPathsExportForTPU:
 
         self._export(lambda x, y: fused_l2_nn(x, y), (4096, 64), (4096, 64))
 
+    def test_sortscan_spmv(self):
+        """Gather-free SpMV (r5): variadic sort + tuple
+        associative_scan must lower for TPU."""
+        from raft_tpu.sparse.formats import CSR
+        from raft_tpu.sparse.linalg import csr_spmv
+
+        def f(indptr, indices, data, x):
+            a = CSR(indptr, indices, data, shape=(512, 400))
+            return csr_spmv(a, x, impl="sortscan")
+
+        self._export(f, (513,), (4096,), (4096,), (400,),
+                     dtypes=[jnp.int32, jnp.int32, jnp.float32,
+                             jnp.float32])
+
     def test_tiled_knn_direct_merge(self):
         """The r4 'direct' merge mode (single (k+tile_n)-wide variadic
         sort per tile) must lower for tpu."""
@@ -260,22 +274,4 @@ class TestTwophaseLowersForTPU:
             (5000, 96), (100, 96))
 
 
-class TestSortscanSpmvLowersForTPU:
-    """Not a Pallas kernel, but the gather-free SpMV's sort+scan must
-    lower for TPU (variadic 4-operand sort + tuple associative_scan)."""
 
-    def test_sortscan_spmv(self):
-        import jax.numpy as jnp
-
-        from raft_tpu.sparse.formats import CSR
-        from raft_tpu.sparse.linalg import csr_spmv
-
-        def f(indptr, indices, data, x):
-            a = CSR(indptr, indices, data, shape=(512, 400))
-            return csr_spmv(a, x, impl="sortscan")
-
-        args = [jax.ShapeDtypeStruct((513,), jnp.int32),
-                jax.ShapeDtypeStruct((4096,), jnp.int32),
-                jax.ShapeDtypeStruct((4096,), jnp.float32),
-                jax.ShapeDtypeStruct((400,), jnp.float32)]
-        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)

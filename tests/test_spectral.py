@@ -266,3 +266,27 @@ class TestR5Regressions:
         assert Bd2.dense is not None
         np.testing.assert_allclose(np.asarray(Bd2.mv(x)),
                                    np.asarray(Bd.mv(x)), rtol=1e-6)
+
+    def test_operator_spmv_impl_pin_keys_executables(self):
+        """spmv_impl pinned on the operator is AUX data: operators
+        pinned to different impls must produce different treedefs (so
+        the jitted solver compiles each genuinely — the r5 spectral A/B
+        initially timed one executable three times without this)."""
+        rng = np.random.default_rng(3)
+        adj = planted_two_blocks(rng, 8)
+        x = jnp.asarray(rng.random(16).astype(np.float32))
+        L_ref = np.diag(adj.sum(1)) - adj
+        defs = set()
+        for impl in ("segment", "cumsum", "sortscan"):
+            L = LaplacianMatrix(CSR.from_dense(adj), spmv_impl=impl)
+            _, treedef = jax.tree_util.tree_flatten(L)
+            defs.add(str(treedef))
+            np.testing.assert_allclose(np.asarray(L.mv(x)), L_ref @
+                                       np.asarray(x), rtol=1e-3,
+                                       atol=1e-3)
+        assert len(defs) == 3
+        # pin survives the round-trip
+        L = ModularityMatrix(CSR.from_dense(adj), spmv_impl="sortscan")
+        leaves, td = jax.tree_util.tree_flatten(L)
+        assert jax.tree_util.tree_unflatten(td, leaves).spmv_impl == \
+            "sortscan"

@@ -1,0 +1,116 @@
+"""On-chip spectral timing: SpMV impl x operator densification A/B.
+
+VERDICT r4 item 5's hardware half: after the r5 single-jit Lanczos fixed
+the CPU retrace pathology, the remaining spectral question is which
+matvec shape wins on the TPU — the gather+segment SpMV (``segment``),
+the prefix-sum form (``cumsum``), the gather-free sort+scan form
+(``sortscan``), or (small graphs only) the densified MXU matvec.  One
+flushed JSON line per config; steady-state timed by repeat solves of
+the SAME operator (executable cache hits — the honest regime after the
+retrace fix).
+
+    python tools/spectral_probe.py > .spectral_probe.log 2>&1
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("RAFT_TPU_BENCH_DEADLINE", str(time.time() + 1800))
+
+T0 = time.time()
+
+
+def emit(rec):
+    rec["t"] = round(time.time() - T0, 1)
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from bench import _enable_compile_cache, two_community_graph
+
+    _enable_compile_cache()
+    dev = jax.devices()[0]
+    emit({"config": "init", "device": str(dev.device_kind),
+          "platform": dev.platform})
+
+    from raft_tpu.spectral import partition
+    from raft_tpu.spectral.eigen_solvers import (EigenSolverConfig,
+                                                 LanczosSolver)
+    from raft_tpu.spectral.matrix_wrappers import LaplacianMatrix
+
+    # RAFT_TPU_SWEEP_SMOKE=1: tiny wiring check
+    smoke = os.environ.get("RAFT_TPU_SWEEP_SMOKE") == "1"
+    shapes = ([(500, 4)] if smoke
+              else [(1024, 20), (50_000, 40)])   # (n_half, n_cross)
+
+    for n_half, n_cross in shapes:
+        n = 2 * n_half
+        csr = two_community_graph(n_half, n_cross,
+                                  np.random.default_rng(0))
+        solver = LanczosSolver(EigenSolverConfig(
+            n_eig_vecs=2, max_iter=6000, restart_iter=80, tol=1e-3,
+            seed=42))
+        # eigensolver (the hot loop) per matvec shape; densify only
+        # where the dense matrix fits the operator budget
+        variants = [("segment", False), ("cumsum", False),
+                    ("sortscan", False)]
+        if n * n <= (1 << 22):
+            variants.append(("segment", True))
+        for impl, densify in variants:
+            name = (f"lanczos_{n}_{impl}" + ("_dense" if densify else ""))
+            try:
+                # impl pinned ON the operator (aux data -> distinct
+                # executables); a config override could not reach an
+                # already-compiled solver
+                op = LaplacianMatrix(csr, densify=densify,
+                                     spmv_impl=impl)
+                t0 = time.time()
+                vals, _, iters = solver.solve_smallest_eigenvectors(
+                    op, n)
+                jax.block_until_ready(vals)
+                first = time.time() - t0
+                ts = []
+                for _ in range(3):
+                    t0 = time.time()
+                    vals, _, iters = (
+                        solver.solve_smallest_eigenvectors(op, n))
+                    jax.block_until_ready(vals)
+                    ts.append(time.time() - t0)
+                emit({"config": name, "n_vertices": n,
+                      "first_incl_compile_s": round(first, 2),
+                      "steady_s": round(min(ts), 4),
+                      "iters": int(iters),
+                      "fiedler": round(float(np.asarray(vals)[1]), 6)})
+            except Exception as e:
+                emit({"config": name, "error": str(e)[-200:]})
+                if "UNAVAILABLE" in str(e):
+                    return
+        # public end-to-end path once per graph (auto operator choice)
+        try:
+            t0 = time.time()
+            res = partition(csr, eigen_solver=solver, n_clusters=2)
+            wall = time.time() - t0
+            truth = np.arange(n) >= n_half
+            cl = np.asarray(res.clusters)
+            acc = max((cl == truth).mean(), (cl != truth).mean())
+            emit({"config": f"partition_{n}_auto", "n_vertices": n,
+                  "wall_s": round(wall, 2),
+                  "community_accuracy": round(float(acc), 4)})
+        except Exception as e:
+            emit({"config": f"partition_{n}_auto",
+                  "error": str(e)[-200:]})
+            if "UNAVAILABLE" in str(e):
+                return
+
+
+if __name__ == "__main__":
+    main()
