@@ -94,6 +94,7 @@ run_tool() {  # run_tool <script> <logfile>
 }
 run_tool tools/knn_kernel_sweep.py .knn_sweep_r5.log
 run_tool tools/onchip_check.py .onchip_r05.log
+run_tool tools/spectral_probe.py .spectral_probe_r5.log
 run_tool tools/select_variants.py .select_variants_r5.log
 run_tool tools/steady_knn.py .steady_knn_r5.log
 echo "=== r5 pipeline done $(date -u +%H:%M:%S) ===" >> "$LOG"
