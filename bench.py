@@ -1439,6 +1439,36 @@ def parent_main():
     budget = float(os.environ.get(_BUDGET_ENV, "420"))
     deadline = t_start + budget - _SAFETY
 
+    if os.environ.get("RAFT_TPU_BENCH_NO_TPU") == "1":
+        # CPU-only evidence run: never spawns the accelerator child, so
+        # it cannot collide with a recovery pipeline probing a wedged
+        # endpoint (the r4 policy: the driver's bench must find a free
+        # endpoint, never a competing client)
+        cpu = _Child(deadline, cpu=True)
+        while (time.time() < deadline and cpu.final is None
+               and cpu.proc.poll() is None):
+            time.sleep(0.5)
+        t_grace = time.time() + 1.0
+        while time.time() < t_grace:
+            time.sleep(0.1)
+        cpu_state = dict(cpu.state)
+        cpu_state.pop("fallback", None)
+        cpu_state.pop("init_log", None)
+        cpu_state["tpu_attempt"] = {"status": "skipped_by_env_no_tpu"}
+        if not _has_rung(cpu_state):
+            # an "evidence run" must never report zeros without saying
+            # why: keep the child's exit/stderr diagnostics (the role
+            # _tpu_attempt_note plays for the accelerator child)
+            rc = cpu.proc.poll()
+            note = {"status": ("child_died_rc=%s" % rc)
+                    if rc not in (None, 0) else "no_rungs_banked"}
+            if cpu.stderr_tail:
+                note["stderr_tail"] = cpu.stderr_tail
+            cpu_state["cpu_attempt"] = note
+        cpu.kill()
+        print(json.dumps(assemble(None, cpu_state)), flush=True)
+        return
+
     # BOTH children at t=0: the TPU child owns the whole budget (hung
     # init costs nothing), the CPU child banks fallback rungs for free.
     tpu = _Child(deadline, cpu=False)
