@@ -409,6 +409,24 @@ def _rung_init():
     }
 
 
+def _wall_check(step, queries):
+    """Wall-clock cross-check: one plain timed call of the jitted step.
+
+    After the r4 dead-code findings, chained and wall must agree within
+    dispatch overhead — a large ratio in a report is the red flag that
+    something is being optimized away again.  Headline rungs only: the
+    check costs one extra compile.  One owner so every headline rung
+    measures under the same bar.
+    """
+    import jax
+
+    jstep = jax.jit(step)
+    jax.block_until_ready(jstep(queries))    # compile + warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(jstep(queries))
+    return time.perf_counter() - t0
+
+
 def _bench_micro():
     """<10 s first rung (warm cache): one 512³ matmul, chain-timed.
 
@@ -487,21 +505,7 @@ def _bench_knn(n_index, n_query, iters, impl, select_impl=None,
                     "RAFT_TPU_SELECT_IMPL": select_impl or None,
                     "RAFT_TPU_TILE_MERGE": merge or None}):
         dt = _time_chained(step, queries, iters)
-        wall = None
-        if wall_check:
-            # wall-clock cross-check: one plain timed call of the
-            # jitted step.  After the r4 dead-code findings, chained
-            # and wall must agree within dispatch overhead — a large
-            # ratio in a report is the red flag that something is being
-            # optimized away again.  Headline rungs only: the check
-            # costs one extra compile.
-            import jax
-
-            jstep = jax.jit(step)
-            jax.block_until_ready(jstep(queries))    # compile + warm
-            t0 = time.perf_counter()
-            jax.block_until_ready(jstep(queries))
-            wall = time.perf_counter() - t0
+        wall = _wall_check(step, queries) if wall_check else None
     qps = n_query / dt
     out = {
         "qps": round(qps, 1),
@@ -638,10 +642,14 @@ def _bench_knn_twophase_1m(state):
         return d + i.astype(d.dtype)
 
     dt = _time_chained(step, queries, 2)
+    # same bar as the headline knn_1m rung: a NEW kernel path must
+    # never set the headline on chained timing alone
+    wall = _wall_check(step, queries)
     qps = n_query / dt
     return {
         "qps": round(qps, 1),
         "seconds_per_batch": round(dt, 4),
+        "wall_seconds_per_batch": round(wall, 4),
         "n_index": n_index, "n_query": n_query, "dim": dim, "k": k,
         "impl": "twophase", "block_n": 2048,
         "mfu": _mfu(2.0 * n_query * n_index * dim, dt),
@@ -1210,7 +1218,9 @@ def child_main():
                                 *best_select(), wall_check=True)),
             ("pallas_check", 100, lambda: _bench_pallas(state)),
             ("knn_1m_pallas", 120, knn_pallas_1m),
-            ("knn_1m_twophase", 120,
+            # est = chained timing (120) + the wall cross-check's extra
+            # compile + executions (60), the knn_1m convention
+            ("knn_1m_twophase", 120 + 60,
              lambda: _bench_knn_twophase_1m(state)),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
             ("knn_100k_bf16", 60,
@@ -1457,14 +1467,9 @@ def parent_main():
         cpu_state["tpu_attempt"] = {"status": "skipped_by_env_no_tpu"}
         if not _has_rung(cpu_state):
             # an "evidence run" must never report zeros without saying
-            # why: keep the child's exit/stderr diagnostics (the role
-            # _tpu_attempt_note plays for the accelerator child)
-            rc = cpu.proc.poll()
-            note = {"status": ("child_died_rc=%s" % rc)
-                    if rc not in (None, 0) else "no_rungs_banked"}
-            if cpu.stderr_tail:
-                note["stderr_tail"] = cpu.stderr_tail
-            cpu_state["cpu_attempt"] = note
+            # why: the generic attempt note distinguishes died-early /
+            # killed-at-deadline / init-only, with stderr + init_log
+            cpu_state["cpu_attempt"] = _tpu_attempt_note(cpu, deadline)
         cpu.kill()
         print(json.dumps(assemble(None, cpu_state)), flush=True)
         return
