@@ -1165,6 +1165,13 @@ def child_main():
             ("linkage_50k", 150, _bench_linkage_50k),
             ("knn_100k_rerank", 90,
              lambda: _bench_knn_rerank(100_000, 512, 2)),
+            # the TRUE north-star config on CPU (generous budgets only):
+            # r5 measured 79.5 QPS wall-verified — notably faster than
+            # r4's honest TPU number (~59 QPS 1M-equiv), the cleanest
+            # statement of how selection-bound the chip path was
+            ("knn_1m", 160,
+             lambda: _bench_knn(1_000_000, 1024, 2, "xla",
+                                wall_check=True)),
         ]
     else:
         def best_select():
