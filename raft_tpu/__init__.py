@@ -38,5 +38,12 @@ Layout (mirrors the reference's module inventory, see SURVEY.md section 2):
 __version__ = "0.1.0"
 
 from raft_tpu import config  # noqa: F401
-from raft_tpu.core.error import RaftError, expects, fail  # noqa: F401
+from raft_tpu.core.error import (  # noqa: F401
+    CommAbortedError,
+    CommError,
+    CommTimeoutError,
+    RaftError,
+    expects,
+    fail,
+)
 from raft_tpu.core.handle import Handle  # noqa: F401

@@ -10,12 +10,25 @@ collectives compile onto ICI) and :class:`HostComms` for eager host-level
 orchestration, tagged p2p, comm_split and status-returning sync.
 ``build_comms`` injects a communicator into a :class:`raft_tpu.Handle`
 (reference helper.hpp:39 build_comms_nccl_only).
+
+Failure contract (docs/FAULT_MODEL.md): verbs on a latched-aborted
+communicator fail fast with :class:`CommAbortedError` (the
+``ncclCommAbort`` contract, std_comms.hpp:443-475); an optional
+:class:`RetryPolicy` retries transient verb failures with deterministic
+backoff and a watchdog deadline; :mod:`~raft_tpu.comms.faults` injects
+failures at the eager execute seam so every path is CPU-testable.
 """
 
 from raft_tpu.comms.types import Datatype, Op, Status, get_type  # noqa: F401
 from raft_tpu.comms.mesh_comms import MeshComms  # noqa: F401
 from raft_tpu.comms.host_comms import HostComms, default_mesh  # noqa: F401
-from raft_tpu.comms import selftest  # noqa: F401
+from raft_tpu.comms.resilience import RetryPolicy  # noqa: F401
+from raft_tpu.comms import faults, selftest  # noqa: F401
+from raft_tpu.core.error import (  # noqa: F401
+    CommAbortedError,
+    CommError,
+    CommTimeoutError,
+)
 
 
 def build_comms(handle, mesh=None, n_devices=None):

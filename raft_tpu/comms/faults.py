@@ -1,0 +1,242 @@
+"""Deterministic, seedable fault injection for the eager comms boundary.
+
+The reference validates its failure contract on live clusters only
+(test.hpp self-tests on real NCCL communicators); a TPU outage cannot be
+scripted into CI.  This harness makes every failure path testable on the
+simulated CPU mesh: it wraps :class:`~raft_tpu.comms.host_comms.HostComms`
+verb *execution* (the ``_execute`` seam every eager collective and the
+p2p ``waitall`` funnel through) and injects configured failures before
+the real XLA program runs.
+
+Layering contract: the injector patches **below** the communicator's
+retry/abort machinery (``_run`` = abort latch + RetryPolicy →
+``_execute`` = compile+run).  An injected transient failure is therefore
+seen — and retried — by the same code path a real XLA runtime error
+takes, which is the point: the resilience layer is exercised, not
+bypassed.
+
+Faults (compose freely, first match wins per call):
+
+- :class:`FailNth` — raise on the nth matching call (transient by
+  default; ``persistent=True`` keeps failing from then on).
+- :class:`Delay` — sleep before executing a matching verb (drives the
+  watchdog timeout path); optionally scoped to calls whose static
+  parameters involve a given rank (root / permutation member).
+- :class:`Abort` — from the nth matching call on, latch the communicator
+  aborted and raise :class:`~raft_tpu.core.error.CommAbortedError`
+  (the injected analog of ``ncclCommAbort`` fired by a peer).
+- :class:`RandomFail` — fail each matching call with probability ``p``
+  from a private ``random.Random(seed)`` stream: deterministic for a
+  given seed, rotated by ``stress.sh faults`` to shake out
+  order-dependence.
+
+Usage::
+
+    with faults.inject(comms, faults.FailNth(1, verb="allreduce")) as log:
+        out = comms.allreduce(x)      # first execution fails, retry wins
+    assert log.injected[0].verb == "allreduce"
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import random
+import time
+from typing import Iterator, List, NamedTuple, Optional, Tuple
+
+from raft_tpu.core import tracing
+from raft_tpu.core.error import CommAbortedError, CommError
+
+
+class InjectedError(CommError):
+    """A transient failure raised by the injection harness (stands in
+    for an XLA runtime / ICI transport error)."""
+
+
+def _ranks_in_key(key: tuple) -> Tuple[int, ...]:
+    """Static rank parameters mentioned by a verb's cache key: roots
+    (bcast/gather*; reduce's key has no root — its result is replicated)
+    and permutation/multicast endpoints.  Enum statics (Op/Status) are
+    not ranks and are excluded."""
+    ranks: List[int] = []
+    for part in key[1:]:
+        if (isinstance(part, int) and not isinstance(part, bool)
+                and not isinstance(part, enum.Enum)):
+            ranks.append(part)
+        elif isinstance(part, tuple):
+            for p in part:
+                if isinstance(p, tuple):
+                    ranks.extend(q for q in p if isinstance(q, int))
+    return tuple(ranks)
+
+
+class Fault:
+    """Base fault: matching by verb name (None = every verb)."""
+
+    def __init__(self, verb: Optional[str] = None):
+        self.verb = verb
+
+    def matches(self, verb: str, key: tuple) -> bool:
+        return self.verb is None or self.verb == verb
+
+    def apply(self, comms, verb: str, key: tuple, n_match: int) -> bool:
+        """Called before a matching execution (``n_match`` is 1-based
+        count of matching calls so far).  Raise to inject a failure;
+        return True for a non-raising effect (a delay) so the injector
+        records it."""
+        raise NotImplementedError
+
+
+class FailNth(Fault):
+    """Raise :class:`InjectedError` on the nth matching call (1-based);
+    with ``persistent=True``, on every call from the nth onward."""
+
+    def __init__(self, n: int = 1, verb: Optional[str] = None,
+                 persistent: bool = False):
+        super().__init__(verb)
+        self.n = int(n)
+        self.persistent = persistent
+
+    def apply(self, comms, verb, key, n_match):
+        if n_match == self.n or (self.persistent and n_match >= self.n):
+            raise InjectedError(
+                "injected transient failure: verb=%s call=%d" % (verb, n_match))
+        return False
+
+
+class Delay(Fault):
+    """Sleep ``seconds`` before a matching verb executes.  ``rank``
+    restricts to calls whose static parameters (root, permutation
+    endpoints) involve that rank; ``times`` bounds how many calls are
+    delayed (None = all)."""
+
+    def __init__(self, seconds: float, verb: Optional[str] = None,
+                 rank: Optional[int] = None, times: Optional[int] = None,
+                 sleep=time.sleep):
+        super().__init__(verb)
+        self.seconds = float(seconds)
+        self.rank = rank
+        self.times = times
+        self._sleep = sleep
+
+    def matches(self, verb, key):
+        if not super().matches(verb, key):
+            return False
+        return self.rank is None or self.rank in _ranks_in_key(key)
+
+    def apply(self, comms, verb, key, n_match):
+        if self.times is None or n_match <= self.times:
+            # count before sleeping: a delayed attempt may be abandoned
+            # by the watchdog, and the injection must be visible on the
+            # counter while the delay is still in flight
+            tracing.counter_inc("comms.fault_injected")
+            self._sleep(self.seconds)
+            return True
+        return False
+
+
+class Abort(Fault):
+    """From the nth matching call on: latch the communicator aborted and
+    raise :class:`CommAbortedError` — the peer-observed ``ncclCommAbort``.
+    Persistent by construction (the latch outlives the injector)."""
+
+    def __init__(self, n: int = 1, verb: Optional[str] = None):
+        super().__init__(verb)
+        self.n = int(n)
+
+    def apply(self, comms, verb, key, n_match):
+        if n_match >= self.n:
+            comms.abort()
+            raise CommAbortedError(
+                "injected abort: verb=%s call=%d" % (verb, n_match))
+
+
+class RandomFail(Fault):
+    """Fail each matching call with probability ``p``, drawn from a
+    private seeded stream — deterministic per seed, independent of any
+    other randomness in the process."""
+
+    def __init__(self, p: float, seed: int, verb: Optional[str] = None):
+        super().__init__(verb)
+        self.p = float(p)
+        self._rng = random.Random(seed)
+
+    def apply(self, comms, verb, key, n_match):
+        if self._rng.random() < self.p:
+            raise InjectedError(
+                "injected random failure: verb=%s call=%d" % (verb, n_match))
+        return False
+
+
+class Injection(NamedTuple):
+    """One injected (or delayed) event, recorded for assertions."""
+
+    verb: str
+    call: int
+    fault: Fault
+
+
+class FaultInjector:
+    """Instance-level wrapper around one communicator's ``_execute``.
+
+    Counts calls per fault (a fault's ``n`` is relative to *its* matching
+    stream, not the global call count), applies the first matching fault,
+    and records every injection in :attr:`injected`.  ``calls`` counts
+    every execution attempt that reached the harness — retries included —
+    so tests can assert exactly how many times the transport was hit.
+    """
+
+    def __init__(self, comms, faults_: List[Fault]):
+        self._comms = comms
+        self._faults = list(faults_)
+        self._match_counts = [0] * len(self._faults)
+        self._orig_execute = None
+        self.calls: List[Tuple[str, tuple]] = []
+        self.injected: List[Injection] = []
+
+    def activate(self) -> None:
+        assert self._orig_execute is None, "injector already active"
+        self._orig_execute = self._comms._execute
+        orig = self._orig_execute
+
+        def patched(key, fn, *args):
+            verb = key[0]
+            self.calls.append((verb, key))
+            for i, fault in enumerate(self._faults):
+                if not fault.matches(verb, key):
+                    continue
+                self._match_counts[i] += 1
+                n = self._match_counts[i]
+                try:
+                    applied = fault.apply(self._comms, verb, key, n)
+                except Exception:
+                    self.injected.append(Injection(verb, n, fault))
+                    tracing.counter_inc("comms.fault_injected")
+                    raise
+                if applied:
+                    # counter already incremented by the fault itself
+                    # (pre-sleep); only the log entry lands here
+                    self.injected.append(Injection(verb, n, fault))
+                break  # first matching fault owns this call
+            return orig(key, fn, *args)
+
+        self._comms._execute = patched
+
+    def deactivate(self) -> None:
+        if self._orig_execute is not None:
+            self._comms._execute = self._orig_execute
+            self._orig_execute = None
+
+
+@contextlib.contextmanager
+def inject(comms, *faults_: Fault) -> Iterator[FaultInjector]:
+    """Scoped fault injection on ``comms``: patch the execute seam for
+    the duration of the block, restore it after (even on error — but an
+    :class:`Abort`'s latch, like the real thing, persists)."""
+    injector = FaultInjector(comms, list(faults_))
+    injector.activate()
+    try:
+        yield injector
+    finally:
+        injector.deactivate()

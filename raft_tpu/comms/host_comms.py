@@ -54,7 +54,14 @@ def shard_map(fn, **kw):
     kw[_CHECK_ARG] = kw.pop("check_rep")
     return _shard_map(fn, **kw)
 
-from raft_tpu.core.error import expects
+from raft_tpu.core import tracing
+from raft_tpu.core.error import (
+    CALLER_BUG_ERRORS,
+    CommAbortedError,
+    CommError,
+    CommTimeoutError,
+    expects,
+)
 from raft_tpu.comms.mesh_comms import MeshComms
 from raft_tpu.comms.types import Op, Status
 
@@ -96,7 +103,8 @@ class HostComms:
     true root-only semantics (non-root rows are zeros).
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, axis: str = _AXIS):
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = _AXIS,
+                 retry_policy=None):
         self.mesh = mesh if mesh is not None else default_mesh()
         self.axis = axis
         expects(axis in self.mesh.axis_names, "axis %s not in mesh", axis)
@@ -104,6 +112,10 @@ class HostComms:
         self._requests: List[_Request] = []
         self._aborted = False
         self._progs: Dict[tuple, object] = {}
+        # optional RetryPolicy (raft_tpu.comms.resilience) applied around
+        # every eager verb execution; None = fail on first error, the
+        # reference's behavior
+        self.retry_policy = retry_policy
 
     # ------------------------------------------------------------------ #
     # topology
@@ -120,6 +132,49 @@ class HostComms:
     # eager collective execution
     # ------------------------------------------------------------------ #
     def _run(self, key: tuple, fn, *args):
+        """Policy layer for one eager verb: fail fast if the communicator
+        is latched aborted (the ``ncclCommAbort`` contract,
+        std_comms.hpp:443-475), apply the :attr:`retry_policy` around the
+        execution, and on unrecoverable failure latch the abort so every
+        *subsequent* verb fails fast too.  Malformed calls do not poison
+        the communicator: ``LogicError`` (RAFT_EXPECTS) and the
+        Python-level errors JAX tracing raises for bad shapes / indices
+        / dtypes (``TypeError``/``ValueError``/``IndexError``/
+        ``KeyError``) propagate unchanged — they are deterministic
+        caller bugs, not fabric faults, and retrying or aborting on
+        them would kill a healthy communicator for every consumer
+        sharing the handle.
+
+        The execution itself lives in :meth:`_execute`, which is also the
+        seam :mod:`raft_tpu.comms.faults` patches — injected faults are
+        seen (and retried) exactly like real runtime errors."""
+        self._ensure_alive(key[0])
+        try:
+            if self.retry_policy is None:
+                return self._execute(key, fn, *args)
+            return self.retry_policy.call(
+                self._execute, key, fn, *args, verb=key[0])
+        except CALLER_BUG_ERRORS:
+            raise
+        except CommAbortedError:
+            self.abort()
+            raise
+        except CommTimeoutError:
+            # preserve the documented taxonomy: deadline expiries reach
+            # callers as CommTimeoutError, not a generic CommError
+            self.abort()
+            raise
+        except Exception as e:
+            self.abort()
+            raise CommError(
+                "%s failed unrecoverably%s; communicator aborted: %s"
+                % (key[0],
+                   "" if self.retry_policy is None
+                   else " after %d attempts"
+                        % (self.retry_policy.max_retries + 1),
+                   e)) from e
+
+    def _execute(self, key: tuple, fn, *args):
         """shard_map-execute ``fn(mesh_comms-visible blocks)`` with
         rank-major in/out over the mesh axis.  Programs are cached by
         ``key`` (verb + static parameters) so repeated eager calls reuse
@@ -133,6 +188,15 @@ class HostComms:
                 check_rep=False))
             self._progs[key] = prog
         return self._host_view(prog(*args))
+
+    def _ensure_alive(self, verb: str) -> None:
+        """Fail fast once aborted: every verb on a latched communicator
+        raises :class:`CommAbortedError` without touching the mesh."""
+        if self._aborted:
+            raise CommAbortedError(
+                "%s on aborted communicator (size=%d); rebuild via "
+                "Comms.recover()" % (verb, self.get_size()),
+                collect_stack=False)
 
     def _host_view(self, out):
         """Make an eager-verb result host-readable on every process.
@@ -217,12 +281,14 @@ class HostComms:
     # ------------------------------------------------------------------ #
     def isend(self, buf, rank: int, dest: int, tag: int = 0) -> _Request:
         """Queue a tagged send of ``buf`` from ``rank`` to ``dest``."""
+        self._ensure_alive("isend")
         req = _Request("send", rank, dest, tag, jnp.asarray(buf))
         self._requests.append(req)
         return req
 
     def irecv(self, rank: int, source: int, tag: int = 0) -> _Request:
         """Queue a tagged receive on ``rank`` from ``source``."""
+        self._ensure_alive("irecv")
         req = _Request("recv", rank, source, tag)
         self._requests.append(req)
         return req
@@ -232,57 +298,68 @@ class HostComms:
         partitioned into disjoint permutation layers (unique source AND
         destination per layer — a ppermute must be a bijection), one
         ppermute each.  Unmatched requests raise, standing in for the
-        reference's UCX progress-timeout abort (std_comms.hpp:234-298)."""
+        reference's UCX progress-timeout abort (std_comms.hpp:234-298).
+
+        Success or failure, the requests this call waited on are
+        *consumed* (dequeued) — the reference's timeout abort likewise
+        fails its requests.  A stale unmatched request must not poison
+        every later ``waitall()`` on the communicator."""
+        self._ensure_alive("waitall")
         reqs = list(requests) if requests is not None else list(self._requests)
-        sends = [r for r in reqs if r.kind == "send"]
-        recvs = [r for r in reqs if r.kind == "recv"]
-        pairs: List[Tuple[_Request, _Request]] = []
-        taken: set = set()
-        for s in sends:
-            match = next(
-                (r for r in recvs
-                 if r.tag == s.tag and r.peer == s.rank and s.peer == r.rank
-                 and r.result is None and id(r) not in taken),
-                None)
-            expects(match is not None,
-                    "waitall: unmatched send rank=%d->%d tag=%d",
-                    s.rank, s.peer, s.tag)
-            taken.add(id(match))
-            pairs.append((s, match))
-        leftover = [r for r in recvs
-                    if id(r) not in taken and r.result is None]
-        expects(not leftover, "waitall: %d unmatched irecv(s)", len(leftover))
+        try:
+            sends = [r for r in reqs if r.kind == "send"]
+            recvs = [r for r in reqs if r.kind == "recv"]
+            pairs: List[Tuple[_Request, _Request]] = []
+            taken: set = set()
+            for s in sends:
+                match = next(
+                    (r for r in recvs
+                     if r.tag == s.tag and r.peer == s.rank
+                     and s.peer == r.rank
+                     and r.result is None and id(r) not in taken),
+                    None)
+                expects(match is not None,
+                        "waitall: unmatched send rank=%d->%d tag=%d",
+                        s.rank, s.peer, s.tag)
+                taken.add(id(match))
+                pairs.append((s, match))
+            leftover = [r for r in recvs
+                        if id(r) not in taken and r.result is None]
+            expects(not leftover,
+                    "waitall: %d unmatched irecv(s)", len(leftover))
 
-        # greedy layering: each layer is a bijection (src and dst unique)
-        layers: List[List[Tuple[_Request, _Request]]] = []
-        for s, r in pairs:
-            placed = False
+            # greedy layering: each layer is a bijection (src/dst unique)
+            layers: List[List[Tuple[_Request, _Request]]] = []
+            for s, r in pairs:
+                placed = False
+                for layer in layers:
+                    if all(s.rank != ls.rank and s.peer != ls.peer
+                           and s.data.shape == ls.data.shape
+                           and s.data.dtype == ls.data.dtype
+                           for ls, _ in layer):
+                        layer.append((s, r))
+                        placed = True
+                        break
+                if not placed:
+                    layers.append([(s, r)])
+
+            size = self.get_size()
             for layer in layers:
-                if all(s.rank != ls.rank and s.peer != ls.peer
-                       and s.data.shape == ls.data.shape
-                       and s.data.dtype == ls.data.dtype
-                       for ls, _ in layer):
-                    layer.append((s, r))
-                    placed = True
-                    break
-            if not placed:
-                layers.append([(s, r)])
-
-        size = self.get_size()
-        for layer in layers:
-            perm = [(s.rank, s.peer) for s, _ in layer]
-            shape = layer[0][0].data.shape
-            dtype = layer[0][0].data.dtype
-            buf = np.zeros((size,) + shape, dtype)
-            for s, _ in layer:
-                buf[s.rank] = np.asarray(s.data)
-            out = self._run(("p2p", tuple(perm)),
-                            lambda b: self._mc.device_sendrecv(b, perm),
-                            jnp.asarray(buf))
-            for s, r in layer:
-                r.result = out[r.rank]
-        done = {id(r) for r in reqs}
-        self._requests = [r for r in self._requests if id(r) not in done]
+                perm = [(s.rank, s.peer) for s, _ in layer]
+                shape = layer[0][0].data.shape
+                dtype = layer[0][0].data.dtype
+                buf = np.zeros((size,) + shape, dtype)
+                for s, _ in layer:
+                    buf[s.rank] = np.asarray(s.data)
+                out = self._run(("p2p", tuple(perm)),
+                                lambda b: self._mc.device_sendrecv(b, perm),
+                                jnp.asarray(buf))
+                for s, r in layer:
+                    r.result = out[r.rank]
+        finally:
+            done = {id(r) for r in reqs}
+            self._requests = [r for r in self._requests
+                              if id(r) not in done]
 
     # device_send/recv parity shims: in the reference these are the
     # stream-ordered NCCL p2p verbs (comms.hpp:508,522); here they share
@@ -315,7 +392,11 @@ class HostComms:
         """Partition the communicator by color; within a color, ranks are
         ordered by key (reference comm_split semantics — there each rank
         passes its own (color, key); single-controller passes the full
-        vectors).  Returns {color: sub-communicator}."""
+        vectors).  Returns {color: sub-communicator}.  Children inherit
+        the parent's retry policy; splitting a latched-aborted
+        communicator fails fast (ncclCommSplit on an aborted comm
+        errors the same way)."""
+        self._ensure_alive("comm_split")
         size = self.get_size()
         expects(len(colors) == size, "comm_split: need one color per rank")
         keys = list(keys) if keys is not None else list(range(size))
@@ -327,16 +408,25 @@ class HostComms:
                 (r for r in range(size) if colors[r] == color),
                 key=lambda r: (keys[r], r))
             sub_mesh = Mesh(np.asarray([devs[r] for r in members]), (self.axis,))
-            out[color] = HostComms(sub_mesh, self.axis)
+            out[color] = HostComms(sub_mesh, self.axis,
+                                   retry_policy=self.retry_policy)
         return out
 
     # ------------------------------------------------------------------ #
     # failure surfacing (reference sync_stream, std_comms.hpp:443-475)
     # ------------------------------------------------------------------ #
+    @property
+    def aborted(self) -> bool:
+        """Whether the communicator has latched aborted (permanent;
+        every verb on it fails fast with :class:`CommAbortedError`)."""
+        return self._aborted
+
     def abort(self) -> None:
-        """Mark the communicator unusable (reference ncclCommAbort,
-        exposed to Python via nccl.pyx:173)."""
-        self._aborted = True
+        """Latch the communicator unusable (reference ncclCommAbort,
+        exposed to Python via nccl.pyx:173).  Idempotent; counted once."""
+        if not self._aborted:
+            self._aborted = True
+            tracing.counter_inc("comms.abort")
 
     def sync_stream(self, *arrays) -> Status:
         """Block until the given in-flight arrays complete; map failures
@@ -347,5 +437,5 @@ class HostComms:
             jax.block_until_ready(arrays)
             return Status.SUCCESS
         except Exception:
-            self._aborted = True
+            self.abort()
             return Status.ERROR

@@ -46,7 +46,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.core.error import expects
+from raft_tpu.core.error import expects, fail
 from raft_tpu.comms.types import Op
 
 AxisName = Union[str, Tuple[str, ...]]
@@ -92,7 +92,7 @@ class MeshComms:
             return lax.pmin(x, self.axis)
         if op == Op.PROD:
             return jnp.prod(lax.all_gather(x, self.axis), axis=0)
-        raise ValueError(f"unknown reduction op {op}")
+        fail("allreduce: unknown reduction op %s", op)
 
     def bcast(self, x, root: int = 0):
         """Every rank receives root's value (reference bcast,

@@ -103,13 +103,17 @@ def test_collective_reducescatter(comms: HostComms) -> bool:
 
 def test_pointToPoint_simple_send_recv(comms: HostComms) -> bool:
     """Ring exchange: rank r sends its payload to (r+1) % size
-    (reference test.hpp:385 pointToPoint tag matching)."""
+    (reference test.hpp:385 pointToPoint tag matching).  The battery
+    passes its own requests to ``waitall`` explicitly so running it as a
+    health probe never sweeps in (or strands) p2p work the *user* has
+    queued on the live communicator."""
     size = comms.get_size()
-    recvs = []
+    reqs, recvs = [], []
     for r in range(size):
-        comms.isend(jnp.full((3,), float(r)), rank=r, dest=(r + 1) % size, tag=7)
+        reqs.append(comms.isend(jnp.full((3,), float(r)), rank=r,
+                                dest=(r + 1) % size, tag=7))
         recvs.append(comms.irecv(rank=r, source=(r - 1) % size, tag=7))
-    comms.waitall()
+    comms.waitall(reqs + recvs)
     return all(
         (np.asarray(recvs[r].result) == float((r - 1) % size)).all()
         for r in range(size))
@@ -121,11 +125,12 @@ def test_pointToPoint_device_send_or_recv(comms: HostComms) -> bool:
     size = comms.get_size()
     if size < 2:
         return True
-    recvs = {}
+    reqs, recvs = [], {}
     for r in range(0, size - 1, 2):
-        comms.device_send(jnp.full((2,), float(r)), rank=r, dest=r + 1)
+        reqs.append(comms.device_send(jnp.full((2,), float(r)),
+                                      rank=r, dest=r + 1))
         recvs[r + 1] = comms.device_recv(rank=r + 1, source=r)
-    comms.waitall()
+    comms.waitall(reqs + list(recvs.values()))
     return all(
         (np.asarray(req.result) == float(r - 1)).all()
         for r, req in recvs.items())
@@ -190,3 +195,24 @@ ALL_TESTS = [
     test_pointToPoint_device_multicast_sendrecv,
     test_commsplit,
 ]
+
+
+def run_all(comms: HostComms) -> dict:
+    """Run the whole battery against a live communicator, one verdict per
+    test (reference test.hpp pattern: one exported runner per verb, driven
+    together by the session layer).  A test that *raises* — e.g. every
+    verb on an aborted communicator — counts as False rather than
+    propagating: this is a health probe, and "the probe crashed" is
+    exactly the unhealthy signal it exists to report.  Excludes
+    ``test_sync_stream_status``, which intentionally poisons the
+    communicator it runs on.
+
+    This is the engine of :meth:`raft_tpu.session.Comms.health_check`.
+    """
+    results = {}
+    for fn in ALL_TESTS:
+        try:
+            results[fn.__name__] = bool(fn(comms))
+        except Exception:
+            results[fn.__name__] = False
+    return results

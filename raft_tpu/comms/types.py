@@ -13,6 +13,8 @@ import enum
 
 import jax.numpy as jnp
 
+from raft_tpu.core.error import fail
+
 
 class Op(enum.IntEnum):
     """Reduction operator (reference op_t, comms.hpp:34)."""
@@ -63,5 +65,16 @@ _DTYPE_MAP = {
 
 def get_type(dtype) -> Datatype:
     """Map a JAX/numpy dtype to its wire id (reference get_type<T>(),
-    comms.hpp:62-89)."""
-    return _DTYPE_MAP[jnp.dtype(dtype)]
+    comms.hpp:62-89).
+
+    Unsupported dtypes raise :class:`~raft_tpu.core.error.LogicError`
+    naming the dtype — the runtime analog of the reference's
+    compile-time error for an unmapped ``get_type<T>()`` instantiation.
+    """
+    dt = jnp.dtype(dtype)
+    wire = _DTYPE_MAP.get(dt)
+    if wire is None:
+        fail("get_type: dtype %s has no communicator wire type "
+             "(supported: %s)", dt,
+             ", ".join(str(k) for k in _DTYPE_MAP))
+    return wire

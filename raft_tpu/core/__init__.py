@@ -5,7 +5,15 @@ TPU-native equivalent of the reference's layer-1 core
 pow2_utils.cuh, integer_utils.h, common/nvtx.hpp).
 """
 
-from raft_tpu.core.error import RaftError, expects, fail
+from raft_tpu.core.error import (
+    CommAbortedError,
+    CommError,
+    CommTimeoutError,
+    LogicError,
+    RaftError,
+    expects,
+    fail,
+)
 from raft_tpu.core.handle import Handle
 from raft_tpu.core.tracing import annotate, range_pop, range_push
 from raft_tpu.core.utils import (
@@ -19,6 +27,10 @@ from raft_tpu.core.utils import (
 
 __all__ = [
     "RaftError",
+    "LogicError",
+    "CommError",
+    "CommAbortedError",
+    "CommTimeoutError",
     "expects",
     "fail",
     "Handle",

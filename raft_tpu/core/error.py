@@ -34,6 +34,35 @@ class LogicError(RaftError):
     """Invariant violation (analog of raft::logic_error, error.hpp:94)."""
 
 
+class CommError(RaftError):
+    """Communicator failure (analog of the reference's NCCL/UCX error
+    surfacing: ``RAFT_NCCL_TRY`` / the ERROR arm of ``status_t``,
+    comms.hpp:41).  Transient instances are retryable by
+    :class:`raft_tpu.comms.resilience.RetryPolicy`; a communicator that
+    exhausts its retries latches aborted."""
+
+
+class CommAbortedError(CommError):
+    """The communicator is latched aborted (the ``ncclCommAbort``
+    contract, std_comms.hpp:443-475: once any participant observes a
+    failure the communicator is permanently unusable).  Every subsequent
+    verb fails fast with this error; recovery requires rebuilding the
+    communicator (``Comms.recover``)."""
+
+
+class CommTimeoutError(CommError):
+    """A communicator verb (or the multi-host bootstrap) exceeded its
+    watchdog deadline (the analog of the reference's UCX progress-loop
+    timeout abort, std_comms.hpp:234-298)."""
+
+
+# Deterministic caller bugs: invariant violations (RAFT_EXPECTS) plus the
+# Python-level errors JAX tracing raises for bad shapes/indices/dtypes.
+# Shared by the comms retry policy (never retried) and the verb layer
+# (never poisons the communicator) so the two taxonomies cannot drift.
+CALLER_BUG_ERRORS = (LogicError, TypeError, ValueError, IndexError, KeyError)
+
+
 def expects(cond: bool, fmt: str, *args) -> None:
     """Raise :class:`LogicError` unless ``cond`` holds.
 

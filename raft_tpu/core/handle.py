@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional
 
 import jax
 
-from raft_tpu.core.error import expects
+from raft_tpu.core.error import CommAbortedError, RaftError, expects
 
 
 class Stream:
@@ -54,9 +54,26 @@ class Stream:
         self._pending.extend(arrays)
 
     def sync(self) -> None:
-        """Block until all recorded work is complete."""
-        if self._pending:
+        """Block until all recorded work is complete.
+
+        The pending list is cleared even when blocking *fails*: keeping
+        the poisoned arrays would make every later ``sync`` re-raise on
+        stale work (a CUDA stream does not replay a past fault either —
+        ``cudaStreamSynchronize`` reports it once and the stream moves
+        on).  The failure is wrapped in :class:`RaftError` so async XLA
+        dispatch errors surface through the library's taxonomy.
+        """
+        if not self._pending:
+            return
+        try:
             jax.block_until_ready(self._pending)
+        except RaftError:
+            raise
+        except Exception as e:
+            raise RaftError(
+                "stream '%s' sync failed on dispatched work: %s"
+                % (self.name, e)) from e
+        finally:
             self._pending.clear()
 
 
@@ -141,6 +158,10 @@ class Handle:
 
     def get_comms(self):
         expects(self._comms is not None, "ERROR: Communicator was not initialized on the handle")
+        if getattr(self._comms, "aborted", False):
+            raise CommAbortedError(
+                "communicator on this handle is latched aborted; rebuild "
+                "it (Comms.recover()) before issuing collectives")
         return self._comms
 
     def comms_initialized(self) -> bool:

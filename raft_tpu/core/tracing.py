@@ -7,18 +7,27 @@ profiler: ``jax.profiler.TraceAnnotation`` shows up on the host timeline and
 ``jax.named_scope`` attaches names to the lowered HLO.  Ranges are cheap but
 can be disabled globally (the NVTX=OFF analog) via :func:`set_enabled` or the
 ``RAFT_TPU_TRACING`` environment variable ("0" disables).
+
+Event counters: the resilience layer (comms retry / abort / recovery,
+see :mod:`raft_tpu.comms.resilience`) reports every event both as a
+trace span and as a named monotonic counter.  Counters are always on —
+they are a few dict ops, they feed health dashboards and tests, and
+unlike spans they must not disappear when profiling is off.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
-from typing import Iterator, List
+import threading
+from typing import Dict, Iterator, List
 
 import jax
 
 _enabled = os.environ.get("RAFT_TPU_TRACING", "1") != "0"
 _range_stack: List[object] = []
+_counters: Dict[str, int] = {}
+_counter_lock = threading.Lock()
 
 
 def set_enabled(on: bool) -> None:
@@ -67,3 +76,41 @@ def range_pop() -> None:
         return
     cm = _range_stack.pop()
     cm.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------- #
+# event counters (resilience/observability; always on, thread-safe —
+# watchdog threads increment concurrently with the main thread)
+# ---------------------------------------------------------------------- #
+def counter_inc(name: str, n: int = 1) -> int:
+    """Increment the named monotonic counter, returning the new value."""
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + n
+        return _counters[name]
+
+
+def get_counter(name: str) -> int:
+    with _counter_lock:
+        return _counters.get(name, 0)
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of every counter (copy; safe to iterate/serialize)."""
+    with _counter_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Zero all counters (test isolation / stats-window rollover)."""
+    with _counter_lock:
+        _counters.clear()
+
+
+@contextlib.contextmanager
+def event(name: str, fmt: str = "", *args) -> Iterator[None]:
+    """Span + counter for one resilience event: increments ``name`` and
+    opens an :func:`annotate` range carrying the formatted detail."""
+    counter_inc(name)
+    detail = (fmt % args) if args else fmt
+    with annotate("%s%s" % (name, " " + detail if detail else "")):
+        yield

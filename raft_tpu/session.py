@@ -13,17 +13,29 @@ devices driven by one process (or one process per host with
 coordinator address instead of out-of-band uid exchange).  The session
 object keeps the reference's lifecycle and lookup API so consumer code
 (cuML-style) ports unchanged.
+
+Resilience (docs/FAULT_MODEL.md): the session is also the recovery
+authority — the layer that owns enough context (mesh, handles, policy)
+to rebuild a communicator the verbs have latched aborted.
+``health_check`` runs the :mod:`~raft_tpu.comms.selftest` battery plus a
+per-device liveness probe; ``recover`` rebuilds a fresh
+:class:`HostComms` on the surviving sub-mesh and re-injects it on every
+registered handle (the reference's analog is tearing down the Dask comms
+session and re-running ``_func_init_all`` on the surviving workers).
 """
 
 from __future__ import annotations
 
 import uuid
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
+import numpy as np
 
-from raft_tpu.comms import HostComms, default_mesh
-from raft_tpu.core.error import expects
+from raft_tpu.comms import HostComms, default_mesh, selftest
+from raft_tpu.comms.resilience import RetryPolicy
+from raft_tpu.core import tracing
+from raft_tpu.core.error import CommError, expects
 from raft_tpu.core.handle import Handle
 
 # module-level session registry (the reference keeps worker-local state
@@ -36,6 +48,38 @@ def inject_comms_on_handle(handle: Handle, comms: HostComms) -> None:
     comms_utils.pyx inject_comms_on_handle → helper.hpp:39)."""
     handle.set_comms(comms)
     handle.mesh = comms.mesh
+
+
+def _distributed_is_initialized() -> bool:
+    """Whether this process already joined a jax.distributed cluster.
+    Private-API probe, gated: absent the attribute, assume not joined."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _probe_device(device) -> bool:
+    """Liveness probe for one device: round-trip a scalar through it.
+    The per-device analog of the reference's per-worker NCCL health
+    check — a device whose runtime cannot even place a scalar has left
+    the mesh.  Devices owned by *other* processes cannot be probed
+    locally (device_put raises for non-addressable devices, healthy or
+    not); they report live here and process death is the coordination
+    service's job to detect — the reference splits responsibility the
+    same way (NCCL per-device checks vs. Dask worker liveness)."""
+    if device.process_index != jax.process_index():
+        return True
+    try:
+        import jax.numpy as jnp
+
+        jax.block_until_ready(
+            jax.device_put(jnp.zeros((), jnp.int32), device))
+        return True
+    except Exception:
+        return False
 
 
 class Comms:
@@ -52,12 +96,29 @@ class Comms:
     coordinator_address / num_processes / process_id:
         Multi-host bootstrap via ``jax.distributed.initialize`` — the
         NCCL-unique-id exchange analog.  Leave None for single-process.
+    retry_policy:
+        Optional :class:`~raft_tpu.comms.resilience.RetryPolicy` applied
+        to every eager verb of the session's communicator (and its
+        comm_split children) — and, unless ``bootstrap_retry_policy``
+        overrides it, to the multi-host bootstrap.  None preserves
+        fail-on-first-error.
+    bootstrap_retry_policy:
+        Optional separate policy for ``jax.distributed.initialize``.
+        The two call sites want opposite timeout stances
+        (docs/FAULT_MODEL.md): bootstrap connects are genuinely
+        transient (``retry_timeouts=True``), while production verb
+        policies should treat a timeout as fatal
+        (``retry_timeouts=False``) to avoid overlapping an abandoned
+        attempt with its retry on the same mesh.  Defaults to
+        ``retry_policy``.
     """
 
     def __init__(self, comms_p2p: bool = False, mesh=None,
                  coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
                  process_id: Optional[int] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 bootstrap_retry_policy: Optional[RetryPolicy] = None,
                  verbose: bool = False):
         self.comms_p2p = comms_p2p
         self.sessionId = uuid.uuid4().hex
@@ -65,28 +126,82 @@ class Comms:
         self._coordinator = coordinator_address
         self._num_processes = num_processes
         self._process_id = process_id
+        self.retry_policy = retry_policy
+        self.bootstrap_retry_policy = (bootstrap_retry_policy
+                                       if bootstrap_retry_policy is not None
+                                       else retry_policy)
         self.verbose = verbose
         self.initialized = False
         self.comms: Optional[HostComms] = None
         self.handle: Optional[Handle] = None
+        self._handles: List[Handle] = []
         self._owns_distributed = False
 
     # -- lifecycle (reference init/destroy, comms.py:171,228) ---------- #
-    def init(self) -> "Comms":
-        if self.initialized:
-            return self
-        if self._coordinator is not None:
-            # multi-host bring-up: coordination service replaces the
-            # out-of-band NCCL uid exchange (SURVEY.md §3.3)
+    def _bootstrap_distributed(self) -> None:
+        """Join the coordination service (the NCCL-uid-exchange analog),
+        retried under the session policy: bootstrap failures are the
+        most transient failures a cluster has (coordinator not up yet,
+        DNS lag), and each attempt is bounded by the policy watchdog so
+        a black-holed connect cannot hang bring-up forever."""
+        if _distributed_is_initialized():
+            # The user already brought the runtime up themselves: use it
+            # but do NOT claim ownership — destroy() must not shut down
+            # a connection this session never created.  (Known limit: a
+            # watchdog-abandoned attempt from a *previous failed session*
+            # that lands late is indistinguishable from a user-owned
+            # runtime and is likewise adopted unowned; threads cannot be
+            # cancelled, so the only airtight fix is process restart —
+            # the same posture as a leaked ncclCommInitRank.)
+            return
+
+        def connect():
+            # idempotency guard for the retry path: a watchdog-abandoned
+            # attempt keeps running on its worker thread and may land the
+            # connection after the timeout fired; jax.distributed.initialize
+            # may only be called once, so a retry that finds the runtime
+            # up treats that as success instead of a fresh (and fatal)
+            # re-initialize.  (The runtime was down before our first
+            # attempt — checked above — so any connection found here is
+            # ours to own.)
+            if _distributed_is_initialized():
+                return
             jax.distributed.initialize(
                 coordinator_address=self._coordinator,
                 num_processes=self._num_processes,
                 process_id=self._process_id)
-            self._owns_distributed = True
-        mesh = self._mesh if self._mesh is not None else default_mesh()
-        self.comms = HostComms(mesh)
-        self.handle = Handle(mesh=mesh)
-        inject_comms_on_handle(self.handle, self.comms)
+
+        policy = self.bootstrap_retry_policy
+        if policy is None:
+            connect()
+        else:
+            try:
+                policy.call(connect, verb="bootstrap")
+            except Exception as e:
+                raise CommError(
+                    "multi-host bootstrap to %s failed after %d attempts: %s"
+                    % (self._coordinator,
+                       policy.max_retries + 1, e)) from e
+        self._owns_distributed = True
+
+    def init(self) -> "Comms":
+        if self.initialized:
+            return self
+        if self._coordinator is not None:
+            self._bootstrap_distributed()
+        try:
+            mesh = self._mesh if self._mesh is not None else default_mesh()
+            self.comms = HostComms(mesh, retry_policy=self.retry_policy)
+            self.handle = Handle(mesh=mesh)
+            self.register_handle(self.handle)
+        except Exception:
+            # failure after a successful bootstrap: release the owned
+            # distributed connection now — as a context manager,
+            # __exit__/destroy never runs when __enter__ raises, and a
+            # leaked connection would be silently adopted (unowned, so
+            # never shut down) by the next session in this process
+            self.destroy()
+            raise
         _sessions[self.sessionId] = self
         self.initialized = True
         if self.verbose:
@@ -94,19 +209,143 @@ class Comms:
                   f"{mesh.devices.size} devices")
         return self
 
+    def register_handle(self, handle: Handle) -> Handle:
+        """Inject the session communicator on ``handle`` and track it so
+        :meth:`recover` can re-inject after a rebuild (the reference
+        pattern: ``_func_init_all`` re-injects on every worker handle)."""
+        expects(self.comms is not None,
+                "register_handle: session has no communicator")
+        inject_comms_on_handle(handle, self.comms)
+        if handle not in self._handles:
+            self._handles.append(handle)
+        return handle
+
     def destroy(self) -> None:
         """Tear down and deregister (reference destroy, comms.py:228 —
-        which shuts down NCCL/UCX; here the coordination service)."""
-        _sessions.pop(self.sessionId, None)
-        self.comms = None
-        self.handle = None
+        which shuts down NCCL/UCX; here the coordination service).
+
+        Idempotent: a second ``destroy`` (or one on a never-initialized
+        session) is a no-op.  The ``_sessions`` registry entry is removed
+        in a ``finally`` so a teardown failure can never leave a dead
+        session shadowing a later one under the same id."""
+        if not self.initialized:
+            # a bootstrap that succeeded before a later init() failure
+            # still owns the distributed connection — release it here or
+            # the next session's initialize fails with "already
+            # initialized"
+            try:
+                if self._owns_distributed:
+                    self._teardown()
+            finally:
+                _sessions.pop(self.sessionId, None)
+            return
+        try:
+            self._teardown()
+        finally:
+            self.comms = None
+            self.handle = None
+            self._handles = []
+            self.initialized = False
+            _sessions.pop(self.sessionId, None)
+
+    def _teardown(self) -> None:
+        """Release cluster-level resources (separate from bookkeeping so
+        ``destroy`` can guarantee deregistration around it)."""
         if self._owns_distributed:
+            self._owns_distributed = False
             try:
                 jax.distributed.shutdown()
             except Exception:
                 pass
-            self._owns_distributed = False
-        self.initialized = False
+
+    # -- health / recovery (docs/FAULT_MODEL.md) ----------------------- #
+    def health_check(self) -> Dict:
+        """Run the self-test battery plus per-device liveness probes.
+
+        Returns ``{"ok": bool, "tests": {name: bool}, "devices":
+        {device_id: bool}}`` — the per-collective verdicts come from
+        :func:`raft_tpu.comms.selftest.run_all` (reference test.hpp
+        battery) and the per-device verdicts from a scalar round-trip on
+        each mesh device.  On an aborted communicator every collective
+        verdict is False (the probes fail fast) while the device probes
+        still report which devices *could* carry a rebuilt communicator —
+        the input :meth:`recover` needs.
+
+        Cost note: the battery is not free — ``test_commsplit`` builds
+        throwaway sub-communicators whose programs recompile on every
+        probe.  For a recurring high-frequency probe, call a cheap
+        subset directly (e.g. ``selftest.test_collective_allreduce``)
+        and reserve the full battery for pre-/post-recovery checks.
+        """
+        expects(self.initialized, "health_check: session not initialized")
+        with tracing.event("comms.health_check", "session=%s",
+                           self.sessionId):
+            tests = selftest.run_all(self.comms)
+            devices = {int(d.id): _probe_device(d)
+                       for d in self.comms.mesh.devices.ravel()}
+        ok = all(tests.values()) and all(devices.values())
+        return {"ok": ok, "tests": tests, "devices": devices}
+
+    def recover(self, devices: Optional[Sequence] = None,
+                mesh=None) -> HostComms:
+        """Rebuild a fresh communicator on the surviving sub-mesh and
+        re-inject it on every registered handle.
+
+        ``devices`` names the survivors explicitly — as ``jax.Device``
+        objects or as the int device ids :meth:`health_check` keys its
+        verdicts by; None probes every device of the current mesh and
+        keeps the live ones.  The automatic
+        rebuild produces a 1-D mesh over the comms axis, so a session on
+        a multi-axis mesh must pass the replacement ``mesh`` explicitly —
+        silently flattening away the other axes would break every
+        consumer shard_mapping over them.  The old communicator —
+        typically latched aborted — is discarded, its compiled programs
+        with it; the new one spans only survivors, so consumers resume at
+        reduced width rather than not at all (mesh-shrink degradation;
+        the reference analog rebuilds the Dask comms session on the
+        surviving workers).
+        """
+        expects(self.initialized, "recover: session not initialized")
+        expects(devices is None or mesh is None,
+                "recover: pass either devices or mesh, not both — an "
+                "explicit mesh already names its devices")
+        axis = self.comms.axis
+        if mesh is None:
+            expects(len(self.comms.mesh.axis_names) == 1,
+                    "recover: automatic rebuild only supports 1-D meshes; "
+                    "session mesh has axes %s — pass the replacement mesh "
+                    "explicitly", tuple(self.comms.mesh.axis_names))
+            if devices is None:
+                devices = [d for d in self.comms.mesh.devices.ravel()
+                           if _probe_device(d)]
+            by_id = {d.id: d for d in self.comms.mesh.devices.ravel()}
+            resolved = []
+            for d in devices:
+                key = d if isinstance(d, int) else getattr(d, "id", None)
+                expects(key in by_id,
+                        "recover: device %s not in the session mesh", d)
+                resolved.append(by_id[key])
+            devices = resolved
+            expects(len(devices) >= 1, "recover: no surviving devices")
+        else:
+            expects(axis in mesh.axis_names,
+                    "recover: replacement mesh lacks comms axis %s", axis)
+            devices = list(mesh.devices.ravel())
+        with tracing.event("comms.recover", "session=%s survivors=%d",
+                           self.sessionId, len(devices)):
+            from jax.sharding import Mesh
+
+            if mesh is None:
+                mesh = Mesh(np.asarray(devices), (axis,))
+            self.comms = HostComms(mesh, axis,
+                                   retry_policy=self.retry_policy)
+            self._mesh = mesh
+            for h in self._handles:
+                inject_comms_on_handle(h, self.comms)
+        if self.verbose:
+            print(f"Recovered comms session {self.sessionId} on "
+                  f"{len(devices)} surviving devices")
+        return self.comms
 
     def worker_info(self, workers=None) -> Dict:
         """Rank/device map per "worker" (reference Comms.worker_info,
@@ -118,8 +357,6 @@ class Comms:
         position on any
         other mesh axes, process index, and platform.  ``workers``
         optionally restricts to those device ids."""
-        import numpy as np
-
         expects(self.initialized, "worker_info: session not initialized")
         mesh = self.comms.mesh
         axis_idx = mesh.axis_names.index(self.comms.axis)

@@ -8,4 +8,11 @@ set -euo pipefail
 cd "$(dirname "$0")"
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 export RAFT_TPU_TEST_PLATFORM="${RAFT_TPU_TEST_PLATFORM:-cpu}"
+# --faults: only the comms fault-injection/resilience suite (the suite
+# also runs as part of the default invocation; see stress.sh faults for
+# the seed-rotating loop)
+if [[ "${1:-}" == "--faults" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m faults "$@"
+fi
 exec python -m pytest tests/ -q "$@"

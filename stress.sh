@@ -3,8 +3,22 @@
 # the 50k single-linkage; the 100k spectral partition dropped to ~10 s
 # with the r5 single-jit Lanczos and moved into the DEFAULT suite,
 # tests/test_scale_stress.py).  Opt-in, separate from run_tests.sh.
+#
+# `./stress.sh faults [N]` instead loops the comms resilience suite N
+# times (default 10) with a rotating fault seed (RAFT_TPU_FAULT_SEED),
+# shaking nondeterminism out of the retry/abort/recovery paths — the
+# injection harness is fully seeded, so any failure reproduces with the
+# printed seed.
 set -euo pipefail
 cd "$(dirname "$0")"
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 export RAFT_TPU_TEST_PLATFORM="${RAFT_TPU_TEST_PLATFORM:-cpu}"
+if [[ "${1:-}" == "faults" ]]; then
+    n="${2:-10}"
+    for i in $(seq 1 "$n"); do
+        echo "== faults stress $i/$n (RAFT_TPU_FAULT_SEED=$i) =="
+        RAFT_TPU_FAULT_SEED="$i" python -m pytest tests/ -q -m faults
+    done
+    exit 0
+fi
 exec python -m pytest tests/ -q -m slow "$@"

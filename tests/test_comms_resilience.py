@@ -1,0 +1,487 @@
+"""Fault-injected resilience suite: retry/backoff, abort latching,
+watchdog timeouts, bootstrap retry, and mesh-shrink recovery — all
+deterministic on the simulated CPU mesh.
+
+The reference can only validate its failure contract (status_t,
+sync_stream + ncclCommGetAsyncError, ncclCommAbort) against a live
+cluster; here :mod:`raft_tpu.comms.faults` injects failures below the
+retry/abort machinery so every path runs hardware-free.  Seeded faults
+honor ``RAFT_TPU_FAULT_SEED`` so ``stress.sh faults`` can rotate seeds
+across iterations.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.comms import (
+    HostComms, RetryPolicy, Status, default_mesh, faults, selftest,
+)
+from raft_tpu.comms.faults import InjectedError
+from raft_tpu.core import tracing
+from raft_tpu.core.error import (
+    CommAbortedError, CommError, CommTimeoutError, LogicError, RaftError,
+)
+from raft_tpu.core.handle import Handle, Stream
+from raft_tpu.session import Comms, _sessions
+
+pytestmark = pytest.mark.faults
+
+SEED = int(os.environ.get("RAFT_TPU_FAULT_SEED", "1234"))
+
+
+def fast_policy(**kw):
+    """Policy with recorded (not slept) backoff so tests stay instant."""
+    slept = []
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("base_delay", 0.01)
+    policy = RetryPolicy(sleep=slept.append, **kw)
+    return policy, slept
+
+
+# ---------------------------------------------------------------------- #
+# RetryPolicy mechanics
+# ---------------------------------------------------------------------- #
+def test_backoff_schedule_deterministic():
+    p = RetryPolicy(max_retries=4, base_delay=0.05, multiplier=2.0,
+                    max_delay=0.3)
+    assert p.schedule() == [0.05, 0.1, 0.2, 0.3]
+    assert p.schedule() == p.schedule()
+
+
+def test_retry_policy_does_not_retry_logic_errors():
+    p, slept = fast_policy()
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise LogicError("malformed call")
+
+    with pytest.raises(LogicError):
+        p.call(bad)
+    assert len(calls) == 1 and slept == []
+
+
+def test_watchdog_timeout_raises_comm_timeout():
+    p = RetryPolicy(max_retries=0, timeout=0.05)
+    with pytest.raises(CommTimeoutError):
+        p.call(lambda: time.sleep(3))
+
+
+# ---------------------------------------------------------------------- #
+# acceptance (a): transient verb failure is retried and succeeds
+# ---------------------------------------------------------------------- #
+def test_transient_allreduce_retries_then_succeeds():
+    policy, slept = fast_policy(max_retries=3)
+    comms = HostComms(default_mesh(), retry_policy=policy)
+    size = comms.get_size()
+    before = tracing.get_counter("comms.retry")
+    with faults.inject(comms, faults.FailNth(1, verb="allreduce")) as log:
+        out = comms.allreduce(jnp.ones((size, 1), jnp.float32))
+    assert (np.asarray(out) == size).all()
+    # first execution failed, retry hit the transport again
+    assert [v for v, _ in log.calls] == ["allreduce", "allreduce"]
+    assert len(log.injected) == 1 and log.injected[0].verb == "allreduce"
+    assert slept == [policy.schedule()[0]]
+    assert tracing.get_counter("comms.retry") == before + 1
+    assert not comms.aborted  # transient + recovered: no latch
+
+
+def test_watchdog_timeout_retried_then_succeeds():
+    policy, _ = fast_policy(max_retries=2, timeout=0.25)
+    comms = HostComms(default_mesh())
+    size = comms.get_size()
+    # warm the compile cache policy-free so the deadline only ever
+    # measures the injected delay, never a cold compile
+    comms.bcast(jnp.zeros((size, 1), jnp.float32))
+    comms.retry_policy = policy
+    before = tracing.get_counter("comms.timeout")
+    before_inj = tracing.get_counter("comms.fault_injected")
+    with faults.inject(comms,
+                       faults.Delay(1.0, verb="bcast", times=1)) as log:
+        out = comms.bcast(
+            jnp.zeros((size, 1), jnp.float32).at[0, 0].set(5.0))
+    assert (np.asarray(out) == 5.0).all()
+    assert [v for v, _ in log.calls] == ["bcast", "bcast"]
+    assert tracing.get_counter("comms.timeout") == before + 1
+    # non-raising faults (delays) count as injections too
+    assert tracing.get_counter("comms.fault_injected") == before_inj + 1
+
+
+def test_random_faults_recovered_by_retry_rotating_seed():
+    """With seeded random failures, enough retries always win — run under
+    stress.sh faults, which rotates RAFT_TPU_FAULT_SEED per iteration."""
+    policy, _ = fast_policy(max_retries=8, base_delay=0.0)
+    comms = HostComms(default_mesh(), retry_policy=policy)
+    size = comms.get_size()
+    x = jnp.arange(size, dtype=jnp.float32)[:, None]
+    want = np.asarray(comms.allreduce(x))
+    with faults.inject(comms, faults.RandomFail(0.25, seed=SEED)):
+        for _ in range(10):
+            assert (np.asarray(comms.allreduce(x)) == want).all()
+    assert not comms.aborted
+
+
+def test_random_fail_deterministic_per_seed():
+    def pattern(seed):
+        f = faults.RandomFail(0.5, seed=seed)
+        out = []
+        for i in range(32):
+            try:
+                f.apply(None, "allreduce", ("allreduce",), i + 1)
+                out.append(False)
+            except InjectedError:
+                out.append(True)
+        return out
+
+    assert pattern(SEED) == pattern(SEED)
+    assert pattern(SEED) != pattern(SEED + 1)
+
+
+def test_delay_rank_scoping_matches_static_params():
+    d = faults.Delay(0.0, verb="bcast", rank=3)
+    assert d.matches("bcast", ("bcast", 3))
+    assert not d.matches("bcast", ("bcast", 0))
+    p2p = faults.Delay(0.0, rank=2)
+    assert p2p.matches("p2p", ("p2p", ((0, 1), (2, 3))))
+    assert not p2p.matches("p2p", ("p2p", ((0, 1),)))
+    # Op statics are not ranks: Op.SUM == 0 must not match rank 0
+    from raft_tpu.comms import Op
+
+    assert not faults.Delay(0.0, rank=0).matches("allreduce",
+                                                 ("allreduce", Op.SUM))
+
+
+# ---------------------------------------------------------------------- #
+# acceptance (b): injected abort latches; every verb fails fast
+# ---------------------------------------------------------------------- #
+def test_abort_latches_and_all_verbs_fail_fast():
+    comms = HostComms(default_mesh())
+    size = comms.get_size()
+    x = jnp.ones((size, 1), jnp.float32)
+    with faults.inject(comms, faults.Abort(verb="allreduce")) as log:
+        with pytest.raises(CommAbortedError):
+            comms.allreduce(x)
+    assert comms.aborted
+    # fail-fast: none of these reach the transport (no new executions)
+    n_calls = len(log.calls)
+    for verb in (lambda: comms.allreduce(x),
+                 lambda: comms.bcast(x),
+                 lambda: comms.allgather(x),
+                 lambda: comms.barrier(),
+                 lambda: comms.isend(x[0], rank=0, dest=1),
+                 lambda: comms.irecv(rank=1, source=0),
+                 lambda: comms.waitall()):
+        with pytest.raises(CommAbortedError):
+            verb()
+    assert len(log.calls) == n_calls
+    assert comms.sync_stream() == Status.ABORT
+
+
+def test_abort_latch_survives_retry_policy():
+    """An abort is non-retryable: the policy must not spin on it."""
+    policy, slept = fast_policy(max_retries=5)
+    comms = HostComms(default_mesh(), retry_policy=policy)
+    size = comms.get_size()
+    with faults.inject(comms, faults.Abort(verb="allreduce")) as log:
+        with pytest.raises(CommAbortedError):
+            comms.allreduce(jnp.ones((size, 1)))
+    assert len(log.calls) == 1 and slept == []
+
+
+def test_exhausted_timeouts_surface_as_comm_timeout_error():
+    """Deadline expiries keep their subtype through the verb layer so
+    callers can branch on CommTimeoutError specifically."""
+    policy, _ = fast_policy(max_retries=1, timeout=0.05)
+    comms = HostComms(default_mesh())
+    size = comms.get_size()
+    comms.allreduce(jnp.ones((size, 1)))  # warm the compile cache
+    comms.retry_policy = policy  # deadline applies to warmed executions
+    with faults.inject(comms, faults.Delay(1.0, verb="allreduce")):
+        with pytest.raises(CommTimeoutError):
+            comms.allreduce(jnp.ones((size, 1)))
+    assert comms.aborted
+
+
+def test_exhausted_retries_latch_abort():
+    policy, slept = fast_policy(max_retries=2)
+    comms = HostComms(default_mesh(), retry_policy=policy)
+    size = comms.get_size()
+    with faults.inject(comms,
+                       faults.FailNth(1, verb="allreduce",
+                                      persistent=True)) as log:
+        with pytest.raises(CommError) as ei:
+            comms.allreduce(jnp.ones((size, 1)))
+    assert "after 3 attempts" in str(ei.value)
+    assert len(log.calls) == 3 and len(slept) == 2
+    assert comms.aborted
+    with pytest.raises(CommAbortedError):
+        comms.bcast(jnp.ones((size, 1)))
+
+
+def test_malformed_call_neither_retried_nor_poisoning():
+    """A deterministic caller bug (duplicate ppermute destination ->
+    ValueError in trace) must propagate without burning retries or
+    latching the communicator."""
+    policy, slept = fast_policy(max_retries=4)
+    comms = HostComms(default_mesh(), retry_policy=policy)
+    size = comms.get_size()
+    with pytest.raises((IndexError, TypeError, ValueError)):
+        comms.device_sendrecv(jnp.ones((size, 1)), [(0, 1), (1, 1)])
+    assert slept == []  # no retries on a deterministic error
+    assert not comms.aborted
+    out = comms.allreduce(jnp.ones((size, 1)))  # communicator still live
+    assert (np.asarray(out) == size).all()
+
+
+def test_handle_surfaces_aborted_comms():
+    handle = Handle()
+    comms = HostComms(default_mesh())
+    handle.set_comms(comms)
+    assert handle.get_comms() is comms
+    comms.abort()
+    with pytest.raises(CommAbortedError):
+        handle.get_comms()
+
+
+# ---------------------------------------------------------------------- #
+# acceptance (c): recover() on a shrunk mesh passes the selftest battery
+# ---------------------------------------------------------------------- #
+def test_recover_on_shrunk_mesh_passes_selftests():
+    with Comms(mesh=default_mesh()) as s:
+        old = s.comms
+        extra = Handle()
+        s.register_handle(extra)
+        with faults.inject(s.comms, faults.Abort(verb="allreduce")):
+            with pytest.raises(CommAbortedError):
+                s.comms.allreduce(jnp.ones((8, 1)))
+        # health check reports the aborted communicator but live devices
+        health = s.health_check()
+        assert not health["ok"]
+        assert not any(health["tests"].values())
+        assert all(health["devices"].values())
+        # shrink: rebuild on half the mesh (simulated surviving sub-mesh),
+        # naming survivors by the int ids health_check reports
+        before = tracing.get_counter("comms.recover")
+        survivors = [d.id for d in list(old.mesh.devices.ravel())[:4]]
+        assert all(isinstance(i, int) and health["devices"][i]
+                   for i in survivors)
+        fresh = s.recover(devices=survivors)
+        assert tracing.get_counter("comms.recover") == before + 1
+        assert fresh is not old and fresh.get_size() == 4
+        assert not fresh.aborted
+        # every registered handle got the rebuilt communicator
+        assert s.handle.get_comms() is fresh
+        assert extra.get_comms() is fresh
+        results = selftest.run_all(fresh)
+        assert results and all(results.values()), results
+
+
+def test_recover_multiaxis_mesh_requires_explicit_mesh():
+    """Automatic 1-D rebuild must refuse to flatten a multi-axis mesh;
+    an explicit replacement mesh (with the comms axis) is accepted."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    with Comms(mesh=Mesh(devs, ("ranks", "aux"))) as s:
+        s.comms.abort()
+        with pytest.raises(LogicError, match="pass the replacement mesh"):
+            s.recover()
+        with pytest.raises(LogicError, match="not both"):
+            s.recover(devices=list(devs.ravel()[:2]),
+                      mesh=Mesh(devs[:2], ("ranks", "aux")))
+        fresh = s.recover(mesh=Mesh(devs[:2], ("ranks", "aux")))
+        assert fresh.get_size() == 2
+        assert s.handle.get_comms() is fresh
+        assert s.handle.mesh.axis_names == ("ranks", "aux")
+        size = fresh.get_size()
+        out = fresh.allreduce(jnp.ones((size, 1), jnp.float32))
+        assert (np.asarray(out) == size).all()
+
+
+def test_run_all_fails_closed_on_aborted_comms():
+    comms = HostComms(default_mesh())
+    comms.abort()
+    results = selftest.run_all(comms)
+    assert set(results) == {fn.__name__ for fn in selftest.ALL_TESTS}
+    assert not any(results.values())
+
+
+def test_health_check_leaves_user_p2p_queue_alone():
+    """The battery's p2p tests wait on their own requests only: user
+    work queued-but-not-waited must survive a health probe untouched."""
+    with Comms(mesh=default_mesh()) as s:
+        comms = s.comms
+        pending_send = comms.isend(jnp.ones((2,)), rank=0, dest=1, tag=42)
+        pending_recv = comms.irecv(rank=1, source=0, tag=42)
+        health = s.health_check()
+        assert health["ok"], health
+        # user's requests still queued, unmatched by the battery
+        assert pending_send in comms._requests
+        assert pending_recv in comms._requests
+        comms.waitall()  # and still completable afterwards
+        assert (np.asarray(pending_recv.result) == 1.0).all()
+
+
+# ---------------------------------------------------------------------- #
+# bootstrap retry (session layer)
+# ---------------------------------------------------------------------- #
+def test_bootstrap_retry_honors_timeout(monkeypatch):
+    attempts = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: (attempts.append(1), time.sleep(3)))
+    policy, slept = fast_policy(max_retries=2, timeout=0.1)
+    s = Comms(coordinator_address="127.0.0.1:1", num_processes=1,
+              process_id=0, retry_policy=policy)
+    t0 = time.monotonic()
+    with pytest.raises(CommError) as ei:
+        s.init()
+    elapsed = time.monotonic() - t0
+    assert isinstance(ei.value.__cause__, CommTimeoutError)
+    assert "after 3 attempts" in str(ei.value)
+    assert len(attempts) == 3 and len(slept) == 2
+    assert elapsed < 2.0  # bounded by the watchdog, not the 3 s hang
+    assert not s.initialized and s.sessionId not in _sessions
+
+
+def test_bootstrap_transient_failures_then_success(monkeypatch):
+    attempts = []
+
+    def flaky(**kw):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("coordinator not up yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    boot_policy, slept = fast_policy(max_retries=3)
+    verb_policy = RetryPolicy(max_retries=1, retry_timeouts=False)
+    s = Comms(coordinator_address="127.0.0.1:1", num_processes=1,
+              process_id=0, retry_policy=verb_policy,
+              bootstrap_retry_policy=boot_policy)
+    s.init()
+    try:
+        assert s.initialized and len(attempts) == 3
+        assert slept == boot_policy.schedule()[:2]
+        # bootstrap and verbs run under their own policies
+        assert s.comms.retry_policy is verb_policy
+    finally:
+        s.destroy()
+
+
+def test_init_failure_after_bootstrap_releases_connection(monkeypatch):
+    """If init() fails after a successful bootstrap, the owned
+    distributed connection must be shut down — the context-manager
+    __exit__ never runs when __enter__ raises."""
+    import raft_tpu.session as sessmod
+
+    shutdown_calls = []
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: shutdown_calls.append(1))
+    monkeypatch.setattr(sessmod, "default_mesh",
+                        lambda: (_ for _ in ()).throw(
+                            RuntimeError("mesh construction exploded")))
+    s = Comms(coordinator_address="127.0.0.1:1", num_processes=1,
+              process_id=0)
+    with pytest.raises(RuntimeError, match="mesh construction"):
+        s.init()
+    assert shutdown_calls == [1]
+    assert not s.initialized and not s._owns_distributed
+    assert s.sessionId not in _sessions
+
+
+def test_recover_rejects_foreign_device_objects():
+    class FakeDevice:
+        id = 999
+
+    with Comms(mesh=default_mesh()) as s:
+        with pytest.raises(LogicError, match="not in the session mesh"):
+            s.recover(devices=[FakeDevice()])
+
+
+def test_failed_waitall_consumes_requests():
+    """A stale unmatched request must not poison later waitall calls."""
+    comms = HostComms(default_mesh())
+    comms.isend(jnp.ones((1,)), rank=0, dest=1, tag=99)  # never matched
+    with pytest.raises(LogicError):
+        comms.waitall()
+    assert comms._requests == []
+    comms.isend(jnp.full((1,), 3.0), rank=0, dest=1, tag=5)
+    r = comms.irecv(rank=1, source=0, tag=5)
+    comms.waitall()  # unaffected by the earlier failure
+    assert float(r.result[0]) == 3.0
+
+
+def test_bootstrap_respects_preexisting_distributed(monkeypatch):
+    """A distributed runtime the user brought up themselves is used but
+    never owned: no re-initialize, and destroy() must not shut it down."""
+    import raft_tpu.session as sessmod
+
+    monkeypatch.setattr(sessmod, "_distributed_is_initialized", lambda: True)
+    init_calls, shutdown_calls = [], []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: init_calls.append(1))
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: shutdown_calls.append(1))
+    s = Comms(coordinator_address="127.0.0.1:1", num_processes=1,
+              process_id=0).init()
+    assert init_calls == [] and not s._owns_distributed
+    s.destroy()
+    assert shutdown_calls == []
+
+
+# ---------------------------------------------------------------------- #
+# satellites: Stream.sync poisoning, get_type, destroy idempotence
+# ---------------------------------------------------------------------- #
+class _Poison:
+    def block_until_ready(self):
+        raise RuntimeError("simulated async dispatch failure")
+
+
+def test_stream_sync_clears_pending_on_failure():
+    st = Stream("s")
+    st.record(_Poison())
+    with pytest.raises(RaftError):
+        st.sync()
+    assert st._pending == []
+    st.sync()  # poisoned work does not replay
+    st.record(jnp.ones((2,)))
+    st.sync()
+    assert st._pending == []
+
+
+def test_get_type_unsupported_dtype_is_logic_error():
+    from raft_tpu.comms import get_type
+
+    with pytest.raises(LogicError) as ei:
+        get_type(jnp.float16)
+    assert "float16" in str(ei.value)
+    with pytest.raises(LogicError):
+        get_type(np.dtype("complex64"))
+
+
+def test_destroy_idempotent_and_registry_cleared_on_teardown_error():
+    s = Comms(mesh=default_mesh()).init()
+    sid = s.sessionId
+    assert sid in _sessions
+    s.destroy()
+    assert sid not in _sessions and not s.initialized
+    s.destroy()  # second destroy: no-op, no raise
+
+    # teardown failure must still deregister (no shadowing of a later
+    # session re-using the lookup path)
+    s2 = Comms(mesh=default_mesh()).init()
+
+    def boom():
+        raise RuntimeError("teardown exploded")
+
+    s2._teardown = boom
+    with pytest.raises(RuntimeError):
+        s2.destroy()
+    assert s2.sessionId not in _sessions and not s2.initialized
+    s2.destroy()  # idempotent even after a failed teardown
