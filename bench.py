@@ -1300,6 +1300,22 @@ def child_main():
         _emit(name, state[name])
     if skipped:
         state["skipped"] = skipped
+    # attach the observability artifact (ISSUE 2): the same snapshot
+    # Session.metrics_snapshot() / tools/metrics_report.py produce, so
+    # bench JSON carries per-primitive timings, jit compile-cache
+    # attribution, comms bytes/latency, and memory peaks alongside the
+    # rung numbers.  Emitted as a PARTIAL too — the parent assembles
+    # its report from streamed state, not the child's FINAL line.  The
+    # human-readable report is dropped (it duplicates profiler_tree).
+    try:
+        from raft_tpu.session import metrics_snapshot
+
+        snap = metrics_snapshot()
+        snap.pop("profiler_report", None)
+        state["metrics_snapshot"] = snap
+    except Exception as e:  # never let observability sink the bench
+        state["metrics_snapshot"] = {"error": repr(e)[:200]}
+    _emit("metrics_snapshot", state["metrics_snapshot"])
     final = (assemble(None, state) if cpu else assemble(state, None))
     print("FINAL " + json.dumps(final), flush=True)
 

@@ -9,7 +9,15 @@ Checks, per Python source file:
   reference's .clang-format 100-column limit);
 - no `from raft_tpu.… import *` (include hygiene: the reference's
   include_checker.py bans quote-style drift; the analog here is
-  wildcard imports, which hide the dependency surface).
+  wildcard imports, which hide the dependency surface);
+- no ad-hoc wall-clock timing inside ``raft_tpu/``
+  (``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()``):
+  primitive timing must go through the profiler/metrics API
+  (docs/OBSERVABILITY.md) so every number lands in the registry and
+  the snapshot artifacts.  The metrics/profiler modules themselves are
+  allowlisted (they ARE the timing implementation); ``time.sleep`` is
+  not timing and stays legal.  bench.py / tools / tests are outside
+  the library and free to time however they like.
 
 Exit code 0 when clean; prints one line per violation otherwise.
 """
@@ -20,8 +28,16 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MAX_LEN = 100
-ROOTS = ("raft_tpu", "tests", "docs", "ci")
+ROOTS = ("raft_tpu", "tests", "docs", "ci", "tools")
 EXTRA = ("bench.py", "__graft_entry__.py")
+
+# ad-hoc timing ban (raft_tpu/ only)
+TIMING_ATTRS = ("time", "perf_counter", "perf_counter_ns", "monotonic",
+                "monotonic_ns", "process_time")
+TIMING_ALLOWLIST = (
+    os.path.join("raft_tpu", "core", "metrics.py"),
+    os.path.join("raft_tpu", "core", "profiler.py"),
+)
 
 
 def check_file(path):
@@ -42,11 +58,39 @@ def check_file(path):
             problems.append(f"{rel}:{i}: tab indentation")
         if len(line) > MAX_LEN:
             problems.append(f"{rel}:{i}: line too long ({len(line)})")
+    in_lib = (rel.startswith("raft_tpu" + os.sep)
+              and rel not in TIMING_ALLOWLIST)
+    # aliases the time module is bound to ("import time", "import time
+    # as t") — attribute-call matching must follow them or the ban is
+    # trivially evaded
+    time_aliases = {"time"}
     for node in ast.walk(tree):
         if (isinstance(node, ast.ImportFrom) and node.module
                 and node.module.startswith("raft_tpu")
                 and any(a.name == "*" for a in node.names)):
             problems.append(f"{rel}:{node.lineno}: wildcard raft_tpu import")
+        if not in_lib:
+            continue
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            # importing the timing function itself IS the evasion
+            for a in node.names:
+                if a.name in TIMING_ATTRS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: ad-hoc from-import of "
+                        f"time.{a.name} — use the profiler/metrics API "
+                        "(docs/OBSERVABILITY.md)")
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in TIMING_ATTRS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in time_aliases):
+            problems.append(
+                f"{rel}:{node.lineno}: ad-hoc time.{node.func.attr}() — "
+                "use the profiler/metrics API (docs/OBSERVABILITY.md)")
     return problems
 
 

@@ -39,6 +39,7 @@ __version__ = "0.1.0"
 
 from raft_tpu import config  # noqa: F401
 from raft_tpu.core.error import (  # noqa: F401
+    AllocationError,
     CommAbortedError,
     CommError,
     CommTimeoutError,

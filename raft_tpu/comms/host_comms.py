@@ -54,6 +54,7 @@ def shard_map(fn, **kw):
     kw[_CHECK_ARG] = kw.pop("check_rep")
     return _shard_map(fn, **kw)
 
+from raft_tpu.core import metrics as _metrics
 from raft_tpu.core import tracing
 from raft_tpu.core.error import (
     CALLER_BUG_ERRORS,
@@ -112,6 +113,10 @@ class HostComms:
         self._requests: List[_Request] = []
         self._aborted = False
         self._progs: Dict[tuple, object] = {}
+        # resolved metric series per verb (generation-invalidated so a
+        # registry reset recreates them): verbs are a hot eager path —
+        # the family lookup + label check must not run per call
+        self._series_cache: Dict[tuple, tuple] = {}
         # optional RetryPolicy (raft_tpu.comms.resilience) applied around
         # every eager verb execution; None = fail on first error, the
         # reference's behavior
@@ -147,13 +152,29 @@ class HostComms:
 
         The execution itself lives in :meth:`_execute`, which is also the
         seam :mod:`raft_tpu.comms.faults` patches — injected faults are
-        seen (and retried) exactly like real runtime errors."""
-        self._ensure_alive(key[0])
+        seen (and retried) exactly like real runtime errors.
+
+        Observability (docs/OBSERVABILITY.md): each eager verb reports
+        its end-to-end latency — retries and watchdog waits included,
+        the caller-observed number —
+        (``raft_tpu_comms_verb_seconds{verb=}``) and, on success, the
+        payload bytes moved (``raft_tpu_comms_bytes_total{verb=}``),
+        on top of PR 1's resilience event counters."""
+        verb = key[0]
+        self._ensure_alive(verb)
+        timer = self._series("timer", "raft_tpu_comms_verb_seconds",
+                             verb, "eager verb latency (incl. retries)")
         try:
-            if self.retry_policy is None:
-                return self._execute(key, fn, *args)
-            return self.retry_policy.call(
-                self._execute, key, fn, *args, verb=key[0])
+            with timer.time():
+                if self.retry_policy is None:
+                    out = self._execute(key, fn, *args)
+                else:
+                    out = self.retry_policy.call(
+                        self._execute, key, fn, *args, verb=verb)
+            self._series("counter", "raft_tpu_comms_bytes_total", verb,
+                         "payload bytes moved by eager verbs").inc(
+                sum(int(getattr(a, "nbytes", 0)) for a in args))
+            return out
         except CALLER_BUG_ERRORS:
             raise
         except CommAbortedError:
@@ -174,19 +195,46 @@ class HostComms:
                         % (self.retry_policy.max_retries + 1),
                    e)) from e
 
+    def _series(self, kind: str, name: str, verb: str, help: str):
+        """Resolve (and memoize per registry generation) one labeled
+        series for this communicator's hot verb path."""
+        reg = _metrics.default_registry()
+        gen = reg.generation
+        cached = self._series_cache.get((name, verb))
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        series = getattr(reg, kind)(
+            name, help=help, labels=("verb",)).labels(verb=verb)
+        self._series_cache[(name, verb)] = (gen, series)
+        return series
+
     def _execute(self, key: tuple, fn, *args):
         """shard_map-execute ``fn(mesh_comms-visible blocks)`` with
         rank-major in/out over the mesh axis.  Programs are cached by
         ``key`` (verb + static parameters) so repeated eager calls reuse
         the compiled executable — jax.jit's own cache keys on function
         identity, which a fresh lambda per call would always miss."""
+        verb = key[0]
         prog = self._progs.get(key)
         if prog is None:
+            self._series("counter",
+                         "raft_tpu_comms_prog_cache_misses_total", verb,
+                         "eager-verb program cache misses").inc()
             spec = P(self.axis)
             prog = jax.jit(shard_map(
                 fn, mesh=self.mesh, in_specs=spec, out_specs=spec,
                 check_rep=False))
             self._progs[key] = prog
+            # the jit is lazy, so the first execution carries the
+            # compile: attribute it to compile_seconds (compile +
+            # one execute; the AOT split profiled_jit does is not safe
+            # across the multi-process shard_map path)
+            with self._series("timer", "raft_tpu_comms_compile_seconds",
+                              verb, "first-call (compile + execute) "
+                                    "time per verb program").time():
+                return self._host_view(prog(*args))
+        self._series("counter", "raft_tpu_comms_prog_cache_hits_total",
+                     verb, "eager-verb program cache hits").inc()
         return self._host_view(prog(*args))
 
     def _ensure_alive(self, verb: str) -> None:

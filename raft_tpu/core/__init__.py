@@ -6,6 +6,7 @@ pow2_utils.cuh, integer_utils.h, common/nvtx.hpp).
 """
 
 from raft_tpu.core.error import (
+    AllocationError,
     CommAbortedError,
     CommError,
     CommTimeoutError,
@@ -15,6 +16,8 @@ from raft_tpu.core.error import (
     fail,
 )
 from raft_tpu.core.handle import Handle
+from raft_tpu.core.metrics import default_registry
+from raft_tpu.core.profiler import default_profiler, profiled, profiled_jit
 from raft_tpu.core.tracing import annotate, range_pop, range_push
 from raft_tpu.core.utils import (
     Pow2,
@@ -28,6 +31,7 @@ from raft_tpu.core.utils import (
 __all__ = [
     "RaftError",
     "LogicError",
+    "AllocationError",
     "CommError",
     "CommAbortedError",
     "CommTimeoutError",
@@ -37,6 +41,10 @@ __all__ = [
     "annotate",
     "range_push",
     "range_pop",
+    "default_registry",
+    "default_profiler",
+    "profiled",
+    "profiled_jit",
     "Pow2",
     "ceildiv",
     "align_to",

@@ -34,6 +34,30 @@ class LogicError(RaftError):
     """Invariant violation (analog of raft::logic_error, error.hpp:94)."""
 
 
+class AllocationError(RaftError):
+    """A buffer allocation failed (the analog of the reference's
+    ``rmm::bad_alloc`` surfacing through ``RAFT_TRY``).  Carries the
+    context an OOM post-mortem needs: how much was asked for and how
+    much this library already holds live.
+
+    Attributes
+    ----------
+    requested_bytes:
+        Size of the allocation that failed.
+    live_bytes:
+        raft_tpu-accounted live buffer bytes at failure time (see
+        :mod:`raft_tpu.mr.buffer` accounting; XLA's own heap is not
+        included).
+    """
+
+    def __init__(self, message: str, requested_bytes: int, live_bytes: int):
+        self.requested_bytes = int(requested_bytes)
+        self.live_bytes = int(live_bytes)
+        super().__init__(
+            "%s (requested %d bytes; %d raft_tpu buffer bytes live)"
+            % (message, self.requested_bytes, self.live_bytes))
+
+
 class CommError(RaftError):
     """Communicator failure (analog of the reference's NCCL/UCX error
     surfacing: ``RAFT_NCCL_TRY`` / the ERROR arm of ``status_t``,

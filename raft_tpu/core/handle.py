@@ -97,13 +97,21 @@ class Handle:
         device: Optional[jax.Device] = None,
         n_streams: int = 0,
         mesh: Optional[jax.sharding.Mesh] = None,
+        profiler=None,
     ):
+        from raft_tpu.core.profiler import default_profiler
+
         self.device = device if device is not None else jax.devices()[0]
         self._stream = Stream("main")
         self._stream_pool = [Stream(f"pool{i}") for i in range(n_streams)]
         self._comms = None
         self._subcomms: Dict[str, Any] = {}
         self.mesh = mesh
+        # scoped profiler: primitives threaded through this handle (and
+        # session snapshots) share it; defaults to the process profiler
+        # so handle-less primitive calls land in the same report
+        self.profiler = (profiler if profiler is not None
+                         else default_profiler())
 
     # ------------------------------------------------------------------ #
     # streams (reference handle.hpp:148-227)
@@ -215,12 +223,28 @@ def takes_handle(fn):
     every array output on the handle's main stream — after which
     ``sync_stream`` / ``stream_syncer`` cover the call exactly as they
     do for the hand-threaded primitives (pairwise/knn/spectral/...).
+
+    It is also the observability seam for those ~60 primitives: the
+    call runs inside a ``<layer>.<name>`` profiler span feeding the
+    ``raft_tpu_<layer>_<name>_seconds`` timer (docs/OBSERVABILITY.md),
+    with layer/name derived from the function's module path.
     """
     import functools
 
+    from raft_tpu.core.profiler import default_profiler
+
+    # "raft_tpu.linalg.gemm" -> layer "linalg"
+    mod_parts = (fn.__module__ or "").split(".")
+    layer = mod_parts[1] if len(mod_parts) > 1 else "core"
+    span_name = "%s.%s" % (layer, fn.__name__)
+
     @functools.wraps(fn)
     def wrapper(*args, handle=None, **kwargs):
-        out = fn(*args, **kwargs)
+        prof = (handle.profiler if handle is not None
+                and getattr(handle, "profiler", None) is not None
+                else default_profiler())
+        with prof.span(span_name, layer=layer):
+            out = fn(*args, **kwargs)
         if handle is not None:
             record_on_handle(
                 handle,

@@ -1,0 +1,437 @@
+"""Scoped profiler: nested timed spans + instrumented-jit attribution.
+
+The tracing module (:mod:`raft_tpu.core.tracing`) puts names on the XLA
+profiler timeline; this module keeps the *numbers* in-process:
+
+- **Spans** (:meth:`Profiler.span`): nested wall-clock scopes kept as a
+  call tree (per-thread nesting, merged across threads by path) and
+  mirrored into registry timers so snapshots carry per-primitive
+  latency histograms.  Spans also enter :func:`tracing.annotate`, so
+  profiler scopes and XLA trace ranges share one name space.
+- **profiled** decorator: one-line primitive instrumentation — wraps a
+  function in a span and a ``raft_tpu_<layer>_<name>_seconds`` timer.
+  NOTE on async dispatch: JAX returns before the device finishes, so a
+  primitive's timer measures host-side dispatch (trace + enqueue)
+  unless the caller syncs inside the span; bench code that wants
+  device-complete numbers blocks via ``handle.sync_stream()`` or
+  ``block_until_ready`` as it always has.
+- **profiled_jit**: the instrumented ``jax.jit`` entry point.  It keys
+  an explicit executable cache on (fn, input avals, static args) and
+  separates *compile* from *execute*: a cache miss lowers and compiles
+  ahead-of-time, timing just the compile
+  (``raft_tpu_jit_compile_seconds{fn=...}``), then every call runs the
+  cached executable inside the fn's span.  Hits/misses are counted per
+  fn (``raft_tpu_jit_cache_{hits,misses}_total``) and per (fn, shape)
+  key (:func:`compile_cache_stats`), which is how the bench tells
+  steady-state throughput from retrace regressions.
+
+The default profiler reports into :func:`metrics.default_registry`; a
+``Handle`` carries a profiler reference (``handle.profiler``) so
+primitives threaded through a handle reach the same instance the
+session snapshots.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from raft_tpu.core import metrics as _metrics
+from raft_tpu.core import tracing
+
+__all__ = ["Profiler", "default_profiler", "profiled", "profiled_jit",
+           "compile_cache_stats", "reset_compile_cache_stats"]
+
+
+class _SpanNode:
+    __slots__ = ("name", "count", "total_s", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total_s = 0.0
+        self.children: Dict[str, "_SpanNode"] = {}
+
+
+class _SpanScope:
+    """One span activation (each ``with`` gets its own scope object, so
+    the same span name is re-entrant and thread-safe)."""
+
+    def __init__(self, prof: "Profiler", name: str, timer):
+        self._prof = prof
+        self._name = name
+        self._timer = timer
+        self._ann = None
+
+    def __enter__(self):
+        self._prev_active = getattr(_tls_active, "prof", None)
+        _tls_active.prof = self._prof
+        self._prof._path_stack().append(self._name)
+        self._ann = tracing.annotate(self._name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        self._ann.__exit__(exc_type, exc, tb)
+        stack = self._prof._path_stack()
+        path = tuple(stack)
+        stack.pop()
+        _tls_active.prof = self._prev_active
+        self._prof._record(path, dt)
+        if self._timer is not None:
+            self._timer.observe(dt)
+
+
+class _NullScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+_NULL = _NullScope()
+
+# innermost profiler with an open span on this thread: inner
+# instrumentation that has no handle in reach (profiled_jit's
+# "jit.<fn>" spans) attributes to its caller's profiler, so a
+# handle-scoped profiler's tree keeps its compile/execute children
+_tls_active = threading.local()
+
+
+def _current_profiler() -> "Profiler":
+    return getattr(_tls_active, "prof", None) or _default_profiler
+
+
+class Profiler:
+    """Aggregating span profiler.
+
+    Nesting is tracked per thread (a watchdog thread's spans do not
+    graft onto the main thread's open scope); the aggregate tree merges
+    all threads by span path, so ``report()`` is one tree regardless of
+    who timed what.
+    """
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._root = _SpanNode("")
+        self._tls = threading.local()
+        # resolved span timers, invalidated by registry generation:
+        # spans wrap every instrumented primitive, so the name
+        # validation + family lookup must not run per call
+        self._timer_cache = {}
+
+    @property
+    def registry(self) -> _metrics.MetricsRegistry:
+        return (self._registry if self._registry is not None
+                else _metrics.default_registry())
+
+    def _path_stack(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _record(self, path: Tuple[str, ...], dt: float) -> None:
+        with self._lock:
+            node = self._root
+            for name in path:
+                nxt = node.children.get(name)
+                if nxt is None:
+                    nxt = node.children[name] = _SpanNode(name)
+                node = nxt
+            node.count += 1
+            node.total_s += dt
+
+    # ------------------------------------------------------------------ #
+    def span(self, name: str, layer: Optional[str] = None):
+        """Open a nested timed scope.  When ``layer`` is given, the
+        span additionally feeds a
+        ``raft_tpu_<layer>_<name>_seconds`` registry timer (a leading
+        ``"<layer>."`` on the span name is not repeated in the metric;
+        remaining dots become underscores)."""
+        if not _metrics.is_enabled():
+            return _NULL
+        timer = None
+        if layer is not None:
+            reg = self.registry
+            gen = reg.generation
+            cached = self._timer_cache.get((name, layer))
+            if cached is not None and cached[0] == gen:
+                timer = cached[1]
+            else:
+                mname = (name[len(layer) + 1:]
+                         if name.startswith(layer + ".") else name)
+                timer = reg.timer(
+                    _metrics.metric_name(
+                        layer, mname.replace(".", "_") + "_seconds"),
+                    help="span '%s' duration (host-side dispatch)" % name)
+                self._timer_cache[(name, layer)] = (gen, timer)
+        return _SpanScope(self, name, timer)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._root = _SpanNode("")
+
+    def tree(self) -> Dict:
+        """The span tree as plain dicts (for JSON artifacts)."""
+
+        def conv(node: _SpanNode) -> Dict:
+            out = {"count": node.count, "total_s": node.total_s}
+            if node.children:
+                out["children"] = {n: conv(c)
+                                   for n, c in sorted(node.children.items())}
+            return out
+
+        with self._lock:
+            return {n: conv(c)
+                    for n, c in sorted(self._root.children.items())}
+
+    def report(self) -> str:
+        """Human-readable span tree: count, total, mean per scope, with
+        children indented under their parent."""
+        lines = ["profiler report (wall seconds, host-side dispatch "
+                 "unless the span syncs)"]
+
+        def walk(node: _SpanNode, depth: int) -> None:
+            mean = node.total_s / node.count if node.count else 0.0
+            lines.append("%s%-*s  n=%-6d total=%.6fs  mean=%.6fs"
+                         % ("  " * depth, max(1, 40 - 2 * depth),
+                            node.name, node.count, node.total_s, mean))
+            for _, child in sorted(node.children.items()):
+                walk(child, depth + 1)
+
+        with self._lock:
+            top = sorted(self._root.children.items())
+        if not top:
+            lines.append("  (no spans recorded)")
+        for _, child in top:
+            walk(child, 1)
+        return "\n".join(lines)
+
+
+_default_profiler = Profiler()
+
+
+def default_profiler() -> Profiler:
+    """The process-wide profiler (shared registry with the metrics
+    default; what ``Handle.profiler`` points at unless overridden)."""
+    return _default_profiler
+
+
+def profiled(layer: str, name: Optional[str] = None):
+    """Decorator: run the function inside a ``<layer>.<name>`` span
+    feeding ``raft_tpu_<layer>_<name>_seconds``.  The span name is the
+    function name unless given.  A ``handle=`` keyword carrying a
+    scoped profiler routes the span there (same contract as
+    ``takes_handle``); otherwise the process default is used."""
+
+    def deco(fn):
+        span_name = "%s.%s" % (layer, name or fn.__name__)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            prof = (getattr(kwargs.get("handle"), "profiler", None)
+                    or _current_profiler())
+            with prof.span(span_name, layer=layer):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# ---------------------------------------------------------------------- #
+# instrumented jit
+# ---------------------------------------------------------------------- #
+_jit_lock = threading.Lock()
+# (fn_name, key) -> {"hits": int, "misses": int, "compile_s": float}
+_jit_stats: Dict[Tuple[str, Tuple], Dict[str, float]] = {}
+
+
+def _static_key(v):
+    """Statics key by the object itself (jax.jit's contract: statics
+    are hashable and compared by __eq__) — the object living inside the
+    cache key keeps it alive, so an id()-based repr can never alias a
+    recycled address onto a stale executable.  Unhashable values fall
+    back to repr (plain jax.jit would reject them outright)."""
+    try:
+        hash(v)
+    except TypeError:
+        return ("__unhashable_repr__", repr(v))
+    return v
+
+
+def _leaf_key(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        # sharding is part of the executable's calling convention: an
+        # AOT-compiled program replayed for same-shape arrays on a
+        # *different device* raises instead of recompiling, so the key
+        # must distinguish placements the way jax.jit's own cache does
+        # (numpy/host inputs have no sharding and key as None)
+        sharding = getattr(x, "sharding", None)
+        return (tuple(x.shape), str(x.dtype),
+                None if sharding is None else str(sharding))
+    # dynamic Python scalars key like jax.jit's avals (type, not value):
+    # keying on the value would report a fresh compile-cache miss — and
+    # compile a fresh executable — for every distinct tol/seed/... even
+    # though the lowered program takes the scalar as a runtime argument
+    if isinstance(x, (bool, int, float, complex)):
+        return ("scalar", type(x).__name__)
+    return ("py", repr(x))
+
+
+def compile_cache_stats() -> Dict[str, Dict[str, Dict[str, float]]]:
+    """Per-(fn, shape-key) compile-cache accounting:
+    ``{fn_name: {key_repr: {hits, misses, compile_s}}}``."""
+    with _jit_lock:
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for (fn_name, key), st in _jit_stats.items():
+            out.setdefault(fn_name, {})[repr(key)] = dict(st)
+        return out
+
+
+def reset_compile_cache_stats() -> None:
+    """Zero the per-(fn, shape) accounting (test isolation).  Compiled
+    executables stay cached on their wrappers — this resets the
+    *statistics*, matching what tests and stats windows need; the next
+    call at a known shape counts as a hit again."""
+    with _jit_lock:
+        _jit_stats.clear()
+
+
+def profiled_jit(fn=None, *, name: Optional[str] = None,
+                 static_argnames: Tuple[str, ...] = ()):
+    """``jax.jit`` with compile-cache observability.
+
+    Keys an explicit executable cache on (function, input avals, static
+    arguments).  A **miss** lowers + compiles ahead-of-time and records
+    the compile seconds and a miss count; a **hit** runs the cached
+    executable directly and records a hit.  Execution always runs in a
+    ``jit.<name>`` span.  Metrics (all labeled ``fn=<name>``):
+
+    - ``raft_tpu_jit_cache_misses_total`` / ``raft_tpu_jit_cache_hits_total``
+    - ``raft_tpu_jit_compile_seconds`` (timer)
+
+    Static arguments may be passed positionally or by keyword — the
+    wrapper normalizes through the signature.  If ahead-of-time
+    lowering fails for a key (an argument kind AOT cannot express), the
+    wrapper falls back to the plain jitted call for that key and
+    attributes that first call's full time to compile — degraded
+    attribution, never a behavior change.  Functions with ``*args`` /
+    ``**kwargs`` are not AOT-split; they get hit/miss counting with the
+    lazy path only.
+    """
+    if fn is None:
+        return functools.partial(profiled_jit, name=name,
+                                 static_argnames=static_argnames)
+
+    import jax
+
+    fn_name = name or getattr(fn, "__name__", "jit_fn")
+    statics = tuple(static_argnames)
+    jitted = jax.jit(fn, static_argnames=statics) if statics else jax.jit(fn)
+    sig = inspect.signature(fn)
+    # *args/**kwargs/positional-only signatures can't be normalized to
+    # by-name calls; they get hit/miss counting on the lazy path only
+    has_varargs = any(
+        p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                   inspect.Parameter.VAR_KEYWORD,
+                   inspect.Parameter.POSITIONAL_ONLY)
+        for p in sig.parameters.values())
+    # per-wrapper executable cache: key -> ("aot", compiled) | ("lazy",)
+    execs: Dict[Tuple, Tuple] = {}
+
+    def _metric(kind, mname, **kw):
+        return getattr(_metrics.default_registry(), kind)(
+            mname, labels=("fn",), **kw).labels(fn=fn_name)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        # two transparent bypasses, both routed through the plain jit
+        # (exactly what an uninstrumented jax.jit would do):
+        # - jax.disable_jit(): the AOT Compiled object refuses to run
+        #   eagerly, while jitted() honors the flag for step/print
+        #   debugging;
+        # - called under an outer trace (arguments are Tracers): the
+        #   AOT executable can't take tracers and "cache hit" is
+        #   meaningless at trace time.
+        if (getattr(jax.config, "jax_disable_jit", False)
+                or any(isinstance(x, jax.core.Tracer)
+                       for x in jax.tree_util.tree_leaves((args, kwargs)))):
+            return jitted(*args, **kwargs)
+        if has_varargs:
+            static_kw = dyn_kw = None
+            key_src = (args, kwargs)
+        else:
+            # normalize to by-name calls: statics may be interleaved
+            # positionally (e.g. f(X, k, tol) with static k), so a
+            # positional re-call would misalign the dynamic args
+            bound = sig.bind(*args, **kwargs)
+            # defaults participate in the key: f(x) and f(x, k=default)
+            # are the same program and must share one executable
+            bound.apply_defaults()
+            static_kw = {k: v for k, v in bound.arguments.items()
+                         if k in statics}
+            dyn_kw = {k: v for k, v in bound.arguments.items()
+                      if k not in statics}
+            key_src = dyn_kw
+        leaves, treedef = jax.tree_util.tree_flatten(key_src)
+        key = (treedef, tuple(_leaf_key(x) for x in leaves),
+               None if static_kw is None else
+               tuple(sorted(((k, _static_key(v))
+                             for k, v in static_kw.items()),
+                            key=lambda kv: kv[0])))
+        with _jit_lock:
+            entry = execs.get(key)
+            st = _jit_stats.setdefault(
+                (fn_name, key), {"hits": 0, "misses": 0, "compile_s": 0.0})
+        if entry is None:
+            _metric("counter", "raft_tpu_jit_cache_misses_total",
+                    help="instrumented-jit compile-cache misses").inc()
+            t0 = time.perf_counter()
+            entry = ("lazy",)
+            if not has_varargs:
+                try:
+                    compiled = jitted.lower(
+                        **static_kw, **dyn_kw).compile()
+                    entry = ("aot", compiled)
+                except Exception:
+                    pass
+            out = None
+            if entry[0] == "lazy":
+                # no AOT split for this key: run the (compiling) first
+                # call once and attribute its full time to compile
+                with _current_profiler().span("jit.%s" % fn_name,
+                                              layer="jit"):
+                    out = (jitted(*args, **kwargs) if has_varargs
+                           else jitted(**static_kw, **dyn_kw))
+            dt = time.perf_counter() - t0
+            _metric("timer", "raft_tpu_jit_compile_seconds",
+                    help="instrumented-jit compile time").observe(dt)
+            with _jit_lock:
+                execs[key] = entry
+                st["misses"] += 1
+                st["compile_s"] += dt
+            if entry[0] == "lazy":
+                return out
+        else:
+            _metric("counter", "raft_tpu_jit_cache_hits_total",
+                    help="instrumented-jit compile-cache hits").inc()
+            with _jit_lock:
+                st["hits"] += 1
+        with _current_profiler().span("jit.%s" % fn_name, layer="jit"):
+            if entry[0] == "aot":
+                return entry[1](**dyn_kw)
+            if has_varargs:
+                return jitted(*args, **kwargs)
+            return jitted(**static_kw, **dyn_kw)
+
+    wrapper.__wrapped_jit__ = jitted
+    return wrapper

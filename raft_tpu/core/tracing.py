@@ -25,9 +25,20 @@ from typing import Dict, Iterator, List
 import jax
 
 _enabled = os.environ.get("RAFT_TPU_TRACING", "1") != "0"
-_range_stack: List[object] = []
+# imperative ranges nest per thread: a watchdog thread's push/pop must
+# not close the main thread's open ranges (PR 1 regression — the comms
+# resilience watchdog popped main-thread ranges off a process-global
+# list)
+_ranges = threading.local()
 _counters: Dict[str, int] = {}
 _counter_lock = threading.Lock()
+
+
+def _range_stack() -> List[object]:
+    stack = getattr(_ranges, "stack", None)
+    if stack is None:
+        stack = _ranges.stack = []
+    return stack
 
 
 def set_enabled(on: bool) -> None:
@@ -56,13 +67,20 @@ def annotate(fmt: str, *args) -> Iterator[None]:
 
 
 def range_push(fmt: str, *args) -> None:
-    """Imperative push (analog of nvtx::push_range, common/nvtx.hpp:40)."""
+    """Imperative push (analog of nvtx::push_range, common/nvtx.hpp:40).
+
+    Enters both the host-timeline range (``TraceAnnotation``) and
+    ``jax.named_scope`` — the same pair :func:`annotate` uses — so
+    imperative and scoped ranges produce consistent HLO names for any
+    tracing that happens between push and pop."""
     if not _enabled:
         return
     name = fmt % args if args else fmt
-    cm = jax.profiler.TraceAnnotation(name)
-    cm.__enter__()
-    _range_stack.append(cm)
+    ann = jax.profiler.TraceAnnotation(name)
+    scope = jax.named_scope(name)
+    ann.__enter__()
+    scope.__enter__()
+    _range_stack().append((ann, scope))
 
 
 def range_pop() -> None:
@@ -72,10 +90,12 @@ def range_pop() -> None:
     closed even if tracing was disabled between push and pop, or the
     profiler range leaks and later pops close the wrong ranges.
     """
-    if not _range_stack:
+    stack = _range_stack()
+    if not stack:
         return
-    cm = _range_stack.pop()
-    cm.__exit__(None, None, None)
+    ann, scope = stack.pop()
+    scope.__exit__(None, None, None)
+    ann.__exit__(None, None, None)
 
 
 # ---------------------------------------------------------------------- #

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import is_tpu_backend
 
 IDX_SENTINEL = jnp.iinfo(jnp.int32).max
@@ -43,6 +44,7 @@ def _default_reduce(best, cand):
     return jnp.where(take, cv, bv), jnp.where(take, ci, bi)
 
 
+@profiled("distance")
 def fused_l2_nn_min_reduce(
     x: jnp.ndarray,
     y: jnp.ndarray,
@@ -119,6 +121,7 @@ def fused_l2_nn_min_reduce(
     return out
 
 
+@profiled("distance")
 def fused_l2_nn(
     x: jnp.ndarray,
     y: jnp.ndarray,

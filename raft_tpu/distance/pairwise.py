@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects, fail
 from raft_tpu.core.handle import record_on_handle
+from raft_tpu.core.profiler import profiled
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.ops.pairwise_tile import pairwise_tile
 
@@ -185,6 +186,7 @@ def _tiled(x, y, combine, reduce_kind="add", epilog=None, init=0.0, **kw):
                          epilog=epilog, init=init, **kw)
 
 
+@profiled("distance")
 def pairwise_distance(
     x: jnp.ndarray,
     y: jnp.ndarray,

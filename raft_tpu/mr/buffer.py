@@ -1,5 +1,13 @@
 """Owning buffers + pool allocator (see package docstring for the
-design mapping to reference mr/allocator.hpp:35 / buffer_base.hpp:39)."""
+design mapping to reference mr/allocator.hpp:35 / buffer_base.hpp:39).
+
+Memory accounting (docs/OBSERVABILITY.md): every owning buffer reports
+into the default metrics registry — ``raft_tpu_mr_live_bytes{space=}``
+(gauge; its ``high_water`` is the peak), ``raft_tpu_mr_alloc_total`` /
+``raft_tpu_mr_free_total`` / ``raft_tpu_mr_alloc_bytes_total``
+(counters), and pool hit/miss counters.  Allocation failures raise
+:class:`~raft_tpu.core.error.AllocationError` carrying the requested
+size and the live-byte count instead of the raw backend error."""
 
 from __future__ import annotations
 
@@ -9,7 +17,61 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.error import expects
+from raft_tpu.core import metrics as _metrics
+from raft_tpu.core.error import AllocationError, expects
+
+
+def _gauge_live(space: str):
+    return _metrics.default_registry().gauge(
+        "raft_tpu_mr_live_bytes",
+        help="bytes held by live raft_tpu buffers (high_water = peak)",
+        labels=("space",)).labels(space=space)
+
+
+def _account_alloc(space: str, nbytes: int):
+    """Record an allocation; returns (bytes_accounted, registry
+    generation) — bytes_accounted is None when recording is disabled
+    (None, not 0: a genuine zero-size allocation still records its
+    alloc/free counter pair) — so the owning buffer schedules a
+    matching free for exactly what was recorded: the pair must balance
+    even if RAFT_TPU_METRICS is toggled mid-lifetime, and must be
+    *dropped* if the registry was reset in between (the recreated
+    gauge never saw the alloc; applying the free would drive it
+    negative)."""
+    reg = _metrics.default_registry()
+    if not _metrics.is_enabled():
+        return None, reg.generation
+    # under the registry lock so the generation returned is exactly the
+    # one the gauge update landed in; _add_raw, not inc: both halves of
+    # the pair must bypass the enable gate identically — a
+    # set_enabled(False) racing in after the check above would
+    # otherwise swallow the inc while the buffer still schedules the
+    # matching free, driving the gauge negative
+    with reg.locked():
+        _gauge_live(space)._add_raw(nbytes)
+        reg.counter("raft_tpu_mr_alloc_total", help="buffer allocations",
+                    labels=("space",)).labels(space=space).inc()
+        reg.counter("raft_tpu_mr_alloc_bytes_total",
+                    help="cumulative bytes allocated",
+                    labels=("space",)).labels(space=space).inc(nbytes)
+        return nbytes, reg.generation
+
+
+def _account_free(space: str, nbytes: int, generation: int) -> None:
+    reg = _metrics.default_registry()
+    # generation check atomic with the adjustment (a reset racing
+    # between them would recreate the gauge and then see the
+    # subtraction from an alloc it never recorded); the gauge half
+    # bypasses the enable gate: this free balances an alloc that WAS
+    # recorded, and dropping it would inflate live bytes forever; the
+    # free counter stays gated (a rate metric)
+    with reg.locked():
+        if generation != reg.generation:
+            return  # the recorded alloc died with a registry reset
+        _gauge_live(space)._add_raw(-nbytes)
+        reg.counter(
+            "raft_tpu_mr_free_total", help="buffer frees",
+            labels=("space",)).labels(space=space).inc()
 
 
 def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:
@@ -37,17 +99,29 @@ class DeviceBuffer:
     eager pipelines need when cycling large scratch arrays.
     """
 
+    _space = "device"
+
     def __init__(self, shape: Tuple[int, ...], dtype=jnp.float32,
                  device: Optional[jax.Device] = None,
                  _array: Optional[jax.Array] = None):
         self.shape = tuple(shape)
         self.dtype = jnp.dtype(dtype)
         self.device = device if device is not None else jax.devices()[0]
+        self._accounted, self._accounted_gen = None, 0
         if _array is not None:
             self._array: Optional[jax.Array] = _array
         else:
-            self._array = jax.device_put(
-                jnp.zeros(self.shape, self.dtype), self.device)
+            try:
+                self._array = jax.device_put(
+                    jnp.zeros(self.shape, self.dtype), self.device)
+            except Exception as e:
+                raise AllocationError(
+                    "DeviceBuffer allocation failed on %s: %s"
+                    % (self.device, e),
+                    requested_bytes=self.size_bytes(),
+                    live_bytes=int(_gauge_live("device").value)) from e
+        self._accounted, self._accounted_gen = _account_alloc(
+            self._space, self.size_bytes())
 
     @classmethod
     def from_array(cls, array) -> "DeviceBuffer":
@@ -75,6 +149,13 @@ class DeviceBuffer:
         if self._array is not None and not self._array.is_deleted():
             self._array.delete()
         self._array = None
+        self._release_accounting()
+
+    def _release_accounting(self) -> None:
+        if self._accounted is not None:
+            _account_free(self._space, self._accounted,
+                          self._accounted_gen)
+            self._accounted = None
 
     def __enter__(self) -> "DeviceBuffer":
         return self
@@ -82,17 +163,44 @@ class DeviceBuffer:
     def __exit__(self, *exc) -> None:
         self.deallocate()
 
+    def __del__(self):
+        # GC is a legal lifetime end: the accounting must follow it or
+        # the live gauge drifts upward on every buffer dropped without
+        # an explicit deallocate().  Accounting ONLY — never
+        # deallocate(): an adopted (from_array) or escaped (.data)
+        # array may still be referenced by the caller, and force-
+        # deleting it here would destroy data the caller holds; the
+        # backing memory's own lifetime is the array reference's, which
+        # GC is already handling.  Guarded for interpreter shutdown,
+        # where the metrics module may already be torn down.
+        try:
+            if getattr(self, "_accounted", None) is not None:
+                self._release_accounting()
+        except Exception:
+            pass
+
 
 class HostBuffer(DeviceBuffer):
     """Host-side owning buffer (reference ``host_buffer``).  Backed by
     numpy (always host-resident); same explicit-lifetime interface."""
 
+    _space = "host"
+
     def __init__(self, shape: Tuple[int, ...], dtype=jnp.float32):
         self.shape = tuple(shape)
         self.dtype = jnp.dtype(dtype)
         self.device = None
-        self._np: Optional[np.ndarray] = np.zeros(shape, self.dtype)
+        self._accounted, self._accounted_gen = None, 0
+        try:
+            self._np: Optional[np.ndarray] = np.zeros(shape, self.dtype)
+        except Exception as e:
+            raise AllocationError(
+                "HostBuffer allocation failed: %s" % e,
+                requested_bytes=self.size_bytes(),
+                live_bytes=int(_gauge_live("host").value)) from e
         self._array = None
+        self._accounted, self._accounted_gen = _account_alloc(
+            self._space, self.size_bytes())
 
     @classmethod
     def from_array(cls, array) -> "HostBuffer":
@@ -112,6 +220,7 @@ class HostBuffer(DeviceBuffer):
 
     def deallocate(self) -> None:
         self._np = None
+        self._release_accounting()
 
 
 class PoolAllocator:
@@ -141,11 +250,16 @@ class PoolAllocator:
         return (tuple(shape), jnp.dtype(dtype).name)
 
     def allocate(self, shape, dtype=jnp.float32) -> DeviceBuffer:
+        reg = _metrics.default_registry()
         bucket = self._free.get(self._key(shape, dtype))
         if bucket:
             self.n_hits += 1
+            reg.counter("raft_tpu_mr_pool_hits_total",
+                        help="pool allocations served from freelist").inc()
             return bucket.pop()
         self.n_misses += 1
+        reg.counter("raft_tpu_mr_pool_misses_total",
+                    help="pool allocations needing fresh memory").inc()
         return DeviceBuffer(shape, dtype, self.device)
 
     def deallocate(self, buf: DeviceBuffer) -> None:

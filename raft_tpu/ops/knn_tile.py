@@ -69,6 +69,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu import config
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import ceildiv, is_tpu_backend
 
 _INF = float("inf")
@@ -388,6 +389,7 @@ def _knn_twophase_kernel(q_ref, x_ref, qn_ref, xn_ref, od_ref, oi_ref, *,
     oi_ref[:] = si
 
 
+@profiled("ops")
 def fused_knn_twophase(
     index: jnp.ndarray,
     queries: jnp.ndarray,
@@ -465,6 +467,7 @@ def fused_knn_twophase(
     return out_d, jnp.clip(out_i, 0, n - 1)
 
 
+@profiled("ops")
 def fused_knn_tile(
     index: jnp.ndarray,
     queries: jnp.ndarray,

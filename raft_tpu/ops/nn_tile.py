@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.ops.knn_tile import pad_with_norms, tile_geometry
 
@@ -97,6 +98,7 @@ def _nn_kernel(x_ref, y_ref, xn_ref, yn_ref, ov_ref, oi_ref,
         oi_ref[:] = bi_ref[:]
 
 
+@profiled("ops")
 def fused_nn_tile(
     x: jnp.ndarray,
     y: jnp.ndarray,

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import ceildiv, is_tpu_backend
 
 
@@ -61,6 +62,7 @@ def _kernel(x_ref, yt_ref, o_ref, acc_ref, *, combine, reduce_kind, epilog,
         o_ref[:] = out.astype(o_ref.dtype)
 
 
+@profiled("ops")
 def pairwise_tile(
     x: jnp.ndarray,
     y: jnp.ndarray,

@@ -33,6 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu import config
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.ops.knn_tile import tile_geometry, topk_update
 
@@ -67,6 +68,7 @@ def _select_kernel(k_ref, od_ref, oi_ref, bd_ref, bi_ref, *, kpad, bw,
         oi_ref[:] = bi_ref[:]
 
 
+@profiled("ops")
 def select_tile(
     keys: jnp.ndarray,
     k: int,

@@ -34,6 +34,8 @@ import numpy as np
 
 from raft_tpu.comms import HostComms, default_mesh, selftest
 from raft_tpu.comms.resilience import RetryPolicy
+from raft_tpu.core import metrics as _metrics
+from raft_tpu.core import profiler as _profiler
 from raft_tpu.core import tracing
 from raft_tpu.core.error import CommError, expects
 from raft_tpu.core.handle import Handle
@@ -347,6 +349,41 @@ class Comms:
                   f"{len(devices)} surviving devices")
         return self.comms
 
+    # -- observability (docs/OBSERVABILITY.md) ------------------------- #
+    def metrics_snapshot(self) -> Dict:
+        """One self-contained observability artifact for this process:
+
+        - ``metrics``: the default registry snapshot — per-primitive
+          timer histograms (``raft_tpu_<layer>_*_seconds``), comms
+          bytes/latency per verb, memory gauges with peaks;
+        - ``compile_cache``: per-(fn, shape) jit hit/miss/compile-
+          seconds attribution (:func:`profiler.compile_cache_stats`);
+        - ``profiler_tree`` / ``profiler_report``: the nested span tree
+          (dict form and the human-readable rendering);
+        - ``event_counters``: PR 1's resilience counters
+          (:func:`tracing.counters`).
+
+        Works on an uninitialized session too — the metrics are
+        process-global; the session is just the natural owner of "give
+        me the run's numbers" (the reference's analog would be asking
+        the Dask comms session for cluster state).  Session-free
+        callers (bench, tools) use the module-level
+        :func:`metrics_snapshot`.
+        """
+        return metrics_snapshot()
+
+    def dump_metrics(self, path: str) -> Dict:
+        """Write :meth:`metrics_snapshot` as JSON to ``path`` (the
+        artifact ``tools/metrics_report.py`` and the bench attach);
+        returns the snapshot that was written."""
+        import json
+
+        snap = self.metrics_snapshot()
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return snap
+
     def worker_info(self, workers=None) -> Dict:
         """Rank/device map per "worker" (reference Comms.worker_info,
         comms.py:154, which maps each Dask worker to its NCCL rank and
@@ -378,6 +415,23 @@ class Comms:
 
     def __exit__(self, *exc) -> None:
         self.destroy()
+
+
+# the ISSUE-2 observability surface names the session object "Session";
+# `Comms` keeps the reference's name — same class
+Session = Comms
+
+
+def metrics_snapshot() -> Dict:
+    """Process-global observability snapshot (see
+    :meth:`Comms.metrics_snapshot` for the field inventory)."""
+    return {
+        "metrics": _metrics.default_registry().snapshot(),
+        "compile_cache": _profiler.compile_cache_stats(),
+        "profiler_tree": _profiler.default_profiler().tree(),
+        "profiler_report": _profiler.default_profiler().report(),
+        "event_counters": tracing.counters(),
+    }
 
 
 def get_raft_comm_state(session_id: str) -> Dict:

@@ -31,13 +31,13 @@ O(bm·bk + bn·bk + bm·bn), independent of n_cols.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects, fail
+from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import (
     _c_canberra,
@@ -286,9 +286,10 @@ def block_pairwise(xa: jnp.ndarray, xb: jnp.ndarray,
     return dense_pairwise(xa, xb, metric, metric_arg)
 
 
-@functools.partial(jax.jit, static_argnames=("metric", "metric_arg",
-                                             "batch_size_a", "batch_size_b",
-                                             "batch_size_k"))
+@profiled("sparse", "pairwise_distance")
+@profiled_jit(name="sparse_pairwise_distance",
+              static_argnames=("metric", "metric_arg", "batch_size_a",
+                               "batch_size_b", "batch_size_k"))
 def pairwise_distance(a: CSR, b: CSR,
                       metric: DistanceType = D.L2Expanded,
                       metric_arg: float = 2.0,

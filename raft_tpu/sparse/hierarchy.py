@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.sparse import convert
 from raft_tpu.sparse.formats import COO, CSR
@@ -215,6 +216,7 @@ def get_distance_graph(X: jnp.ndarray, c: int,
     raise ValueError(f"unknown linkage '{linkage}'")
 
 
+@profiled("sparse")
 def single_linkage(X, n_clusters: int,
                    metric: DistanceType = D.L2SqrtExpanded,
                    linkage: str = "knn", c: int = 15,

@@ -14,13 +14,13 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-import functools
 
 import jax
 import jax.numpy as jnp
 
 from raft_tpu import config
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.sparse.formats import COO, CSR
 from raft_tpu.sparse import convert, op as sparse_op
 
@@ -245,6 +245,7 @@ def gather_via_sortscan(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return out[:m]
 
 
+@profiled("sparse")
 def csr_spmv(csr: CSR, x: jnp.ndarray,
              impl: Optional[str] = None) -> jnp.ndarray:
     """y = A @ x (replaces cusparseSpMV; the Lanczos hot loop rides
@@ -303,6 +304,7 @@ def csr_spmv(csr: CSR, x: jnp.ndarray,
                                indices_are_sorted=True)[:-1]
 
 
+@profiled("sparse")
 def csr_spmm(csr: CSR, x: jnp.ndarray) -> jnp.ndarray:
     """Y = A @ X for a dense block X (n_cols, b): vmapped SpMV."""
     return jax.vmap(lambda col: csr_spmv(csr, col), in_axes=1, out_axes=1)(x)
@@ -311,6 +313,7 @@ def csr_spmm(csr: CSR, x: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------- #
 # weakly connected components (sparse/csr.hpp:50-167)
 # --------------------------------------------------------------------- #
+@profiled("sparse")
 def weak_cc(csr: CSR, max_iters: int = 0) -> jnp.ndarray:
     """Weakly-connected component labels (1-based, matching the reference's
     convention; labels are minima of 1-based vertex ids per component).
@@ -325,7 +328,7 @@ def weak_cc(csr: CSR, max_iters: int = 0) -> jnp.ndarray:
     return _weak_cc_run(csr, max_iters=max_iters)
 
 
-@functools.partial(jax.jit, static_argnames=("max_iters",))
+@profiled_jit(name="weak_cc", static_argnames=("max_iters",))
 def _weak_cc_run(csr: CSR, max_iters: int) -> jnp.ndarray:
     # one cached executable per shape (eager while_loop closures would
     # retrace every call — r5 retrace audit)

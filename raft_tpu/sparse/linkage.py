@@ -20,6 +20,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.profiler import profiled
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
 from raft_tpu.sparse.formats import COO
 
@@ -48,6 +49,7 @@ def cross_color_nn(X: jnp.ndarray, colors: jnp.ndarray,
                                   tile_mask_fn=color_mask)
 
 
+@profiled("sparse")
 def connect_components(X: jnp.ndarray, colors: jnp.ndarray,
                        sqrt: bool = True) -> COO:
     """Emit symmetric edges joining each component to its nearest other

@@ -20,12 +20,12 @@ list, and extracted/deduplicated at the end.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.sparse.formats import CSR
 
 
@@ -62,6 +62,7 @@ def _pointer_jump(parent: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(p[p] == p, p, jnp.arange(V, dtype=parent.dtype))
 
 
+@profiled("sparse")
 def mst(csr: CSR,
         colors: Optional[jnp.ndarray] = None,
         max_iterations: int = 0):
@@ -97,7 +98,7 @@ def mst(csr: CSR,
     return _mst_run(csr, colors0, cap=cap)
 
 
-@functools.partial(jax.jit, static_argnames=("cap",))
+@profiled_jit(name="mst", static_argnames=("cap",))
 def _mst_run(csr: CSR, colors0: jnp.ndarray, cap: int):
     """The whole Borůvka solve as one cached executable (the linkage
     pipeline calls mst repeatedly at a fixed shape; an eager while_loop

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects, fail
 from raft_tpu.core.handle import record_on_handle
+from raft_tpu.core.profiler import profiled
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
@@ -175,6 +176,7 @@ def _search_one_partition(
     return select_k(dist, k, select_min=True)
 
 
+@profiled("spatial")
 def brute_force_knn(
     inputs: Union[jnp.ndarray, List[jnp.ndarray]],
     queries: jnp.ndarray,

@@ -11,7 +11,6 @@ computation overlaps tile t's selection.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -20,10 +19,12 @@ from jax import lax
 
 from raft_tpu import config
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.core.utils import as_pytree_fn, ceildiv
 from raft_tpu.spatial.select_k import _resolve_impl, top_k_rows
 
 
+@profiled("spatial")
 def tiled_knn(
     index: jnp.ndarray,
     queries: jnp.ndarray,
@@ -81,8 +82,8 @@ def tiled_knn(
                           merge=merge, select_impl=_resolve_impl(None))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "tile_n", "merge",
-                                             "select_impl"))
+@profiled_jit(name="tiled_knn",
+              static_argnames=("k", "tile_n", "merge", "select_impl"))
 def _tiled_knn_run(index, queries, tile_dist, k, tile_n, merge,
                    select_impl):
     n = index.shape[0]

@@ -11,6 +11,8 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
+from raft_tpu.core.profiler import profiled
+
 from raft_tpu.linalg.lanczos import (
     compute_largest_eigenvectors,
     compute_smallest_eigenvectors,
@@ -35,6 +37,7 @@ class LanczosSolver:
     def __init__(self, config: EigenSolverConfig):
         self.config = config
 
+    @profiled("spectral", "lanczos_smallest")
     def solve_smallest_eigenvectors(self, op, n: int
                                     ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
         c = self.config
@@ -43,6 +46,7 @@ class LanczosSolver:
             mv, n, c.n_eig_vecs, maxiter=c.max_iter,
             restart_iter=c.restart_iter, tol=c.tol, seed=c.seed)
 
+    @profiled("spectral", "lanczos_largest")
     def solve_largest_eigenvectors(self, op, n: int
                                    ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
         c = self.config

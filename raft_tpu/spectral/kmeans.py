@@ -13,13 +13,13 @@ sampling — the whole solve jit-compiles to a single XLA program.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.debug import check_finite
+from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.core.error import expects
 
 
@@ -65,7 +65,7 @@ def init_plus_plus(X: jnp.ndarray, k: int, key: jax.Array) -> jnp.ndarray:
     return C
 
 
-@functools.partial(jax.jit, static_argnames=("k", "max_iter", "n_init"))
+@profiled_jit(name="kmeans", static_argnames=("k", "max_iter", "n_init"))
 def _kmeans_jit(X, k, tol, max_iter, seed, n_init=1):
     n, d = X.shape
     xn = jnp.sum(X * X, axis=1)
@@ -146,6 +146,7 @@ def _kmeans_jit(X, k, tol, max_iter, seed, n_init=1):
     return C, labels, res, iters
 
 
+@profiled("spectral")
 def kmeans(X: jnp.ndarray, k: int, tol: float = 1e-4,
            max_iter: int = 300, seed: int = 1234567,
            n_init: int = 1) -> KmeansResult:

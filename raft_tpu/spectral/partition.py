@@ -12,6 +12,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax.numpy as jnp
 
 from raft_tpu.core.handle import record_on_handle
+from raft_tpu.core.profiler import profiled
 from raft_tpu.sparse.formats import CSR
 from raft_tpu.spectral._driver import solve_embed_cluster
 from raft_tpu.spectral.cluster_solvers import KmeansSolver
@@ -28,6 +29,7 @@ class PartitionResult(NamedTuple):
     iters_cluster: jnp.ndarray
 
 
+@profiled("spectral")
 def partition(csr: CSR,
               eigen_solver: Optional[LanczosSolver] = None,
               cluster_solver: Optional[KmeansSolver] = None,
@@ -49,6 +51,7 @@ def partition(csr: CSR,
     return res
 
 
+@profiled("spectral")
 def analyze_partition(csr: CSR, n_clusters: int, clusters: jnp.ndarray
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(edge_cut, cost) quality metrics (reference analyzePartition,

@@ -15,4 +15,10 @@ if [[ "${1:-}" == "--faults" ]]; then
     shift
     exec python -m pytest tests/ -q -m faults "$@"
 fi
+# --metrics: only the metrics/profiler/observability suite (also part
+# of the default invocation)
+if [[ "${1:-}" == "--metrics" ]]; then
+    shift
+    exec python -m pytest tests/test_metrics_profiler.py -q "$@"
+fi
 exec python -m pytest tests/ -q "$@"

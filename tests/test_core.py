@@ -151,7 +151,7 @@ class TestTracingPopWhileDisabled:
         tracing.set_enabled(False)
         try:
             tracing.range_pop()
-            assert len(tracing._range_stack) == 0
+            assert len(tracing._range_stack()) == 0
         finally:
             tracing.set_enabled(True)
 

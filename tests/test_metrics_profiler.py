@@ -1,0 +1,1005 @@
+"""Metrics registry + profiler subsystem tests (docs/OBSERVABILITY.md).
+
+Covers: registry semantics (labels, histogram quantiles, snapshot
+isolation, thread-safety under a hammer thread), Prometheus round-trip,
+compile-cache hit/miss attribution across shapes, profiler report
+nesting, memory gauge tracking, the AllocationError contract, the two
+tracing fixes (thread-local range stack; range_push entering
+jax.named_scope), comms verb bytes/latency, the session snapshot
+surface, and the style-check timing ban.
+
+Global-state convention: the default registry/profiler are
+process-global and shared with every other test in the session, so
+integration tests assert *deltas*, never absolutes; pure registry
+semantics run on private ``MetricsRegistry`` instances.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import metrics, profiler, tracing
+from raft_tpu.core.error import AllocationError, LogicError
+
+
+# ---------------------------------------------------------------------- #
+# registry semantics
+# ---------------------------------------------------------------------- #
+class TestRegistry:
+    def test_counter_inc_and_value(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("raft_tpu_test_ops_total")
+        c.inc()
+        c.inc(41)
+        assert c.value == 42
+
+    def test_counter_rejects_negative(self):
+        reg = metrics.MetricsRegistry()
+        with pytest.raises(ValueError, match="negative"):
+            reg.counter("raft_tpu_test_neg_total").inc(-1)
+
+    def test_labeled_series_are_independent(self):
+        reg = metrics.MetricsRegistry()
+        fam = reg.counter("raft_tpu_test_bytes_total", labels=("verb",))
+        fam.labels(verb="allreduce").inc(100)
+        fam.labels(verb="bcast").inc(7)
+        assert fam.labels(verb="allreduce").value == 100
+        assert fam.labels(verb="bcast").value == 7
+
+    def test_label_schema_enforced(self):
+        reg = metrics.MetricsRegistry()
+        fam = reg.counter("raft_tpu_test_labeled_total", labels=("verb",))
+        with pytest.raises(ValueError, match="do not match"):
+            fam.labels(wrong="x")
+        # a labeled family cannot be used as its own series
+        with pytest.raises(ValueError, match="labels"):
+            fam.inc()
+
+    def test_kind_conflict_raises(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("raft_tpu_test_conflict")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("raft_tpu_test_conflict")
+
+    def test_get_or_create_returns_same_family(self):
+        reg = metrics.MetricsRegistry()
+        assert (reg.counter("raft_tpu_test_same")
+                is reg.counter("raft_tpu_test_same"))
+
+    def test_gauge_set_inc_dec_high_water(self):
+        reg = metrics.MetricsRegistry()
+        g = reg.gauge("raft_tpu_test_live_bytes")
+        g.set(100)
+        g.inc(50)
+        g.dec(120)
+        assert g.value == 30
+        assert g.high_water == 150
+
+    def test_timer_quantiles_and_extrema(self):
+        reg = metrics.MetricsRegistry()
+        t = reg.timer("raft_tpu_test_lat_seconds")
+        for ms in range(1, 101):  # 1ms..100ms
+            t.observe(ms / 1000.0)
+        snap = reg.snapshot()["raft_tpu_test_lat_seconds"]["series"][0]
+        assert snap["count"] == 100
+        assert snap["min"] == pytest.approx(0.001)
+        assert snap["max"] == pytest.approx(0.100)
+        assert 0.045 <= snap["p50"] <= 0.055
+        assert 0.090 <= snap["p95"] <= 0.100
+        assert snap["total"] == pytest.approx(sum(range(1, 101)) / 1000.0)
+
+    def test_quantile_nearest_rank_low_counts(self):
+        """Review regression: the rank was off by one, so p50 of two
+        samples reported the max instead of the lower sample."""
+        reg = metrics.MetricsRegistry()
+        t = reg.timer("raft_tpu_test_rank_seconds")
+        t.observe(0.001)
+        t.observe(27.0)
+        assert t.quantile(0.5) == pytest.approx(0.001)
+        assert t.quantile(0.95) == pytest.approx(27.0)
+        assert t.quantile(0.0) == pytest.approx(0.001)
+        assert t.quantile(1.0) == pytest.approx(27.0)
+        t2 = reg.timer("raft_tpu_test_rank100_seconds")
+        for ms in range(1, 101):
+            t2.observe(ms / 1000.0)
+        assert t2.quantile(0.95) == pytest.approx(0.095)
+        assert t2.quantile(0.5) == pytest.approx(0.050)
+
+    def test_timer_scope_observes(self):
+        reg = metrics.MetricsRegistry()
+        t = reg.timer("raft_tpu_test_scope_seconds")
+        with t.time():
+            pass
+        assert (reg.snapshot()["raft_tpu_test_scope_seconds"]
+                ["series"][0]["count"] == 1)
+
+    def test_snapshot_isolation(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("raft_tpu_test_iso_total")
+        c.inc(5)
+        snap = reg.snapshot()
+        c.inc(100)
+        assert snap["raft_tpu_test_iso_total"]["series"][0]["value"] == 5
+        # the later snapshot sees the new value
+        assert (reg.snapshot()["raft_tpu_test_iso_total"]["series"][0]
+                ["value"] == 105)
+
+    def test_thread_safety_hammer(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("raft_tpu_test_hammer_total")
+        t = reg.timer("raft_tpu_test_hammer_seconds")
+        n_threads, n_iter = 8, 2000
+
+        def hammer():
+            for _ in range(n_iter):
+                c.inc()
+                t.observe(0.001)
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert c.value == n_threads * n_iter
+        assert (reg.snapshot()["raft_tpu_test_hammer_seconds"]
+                ["series"][0]["count"] == n_threads * n_iter)
+
+    def test_disable_enable(self):
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("raft_tpu_test_disabled_total")
+        metrics.set_enabled(False)
+        try:
+            c.inc(10)
+        finally:
+            metrics.set_enabled(True)
+        assert c.value == 0
+        c.inc(1)
+        assert c.value == 1
+
+    def test_metric_name_helper(self):
+        assert (metrics.metric_name("linalg", "gemm_seconds")
+                == "raft_tpu_linalg_gemm_seconds")
+        with pytest.raises(ValueError):
+            metrics.metric_name("bad layer", "x")
+
+    def test_reset(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("raft_tpu_test_gone_total").inc()
+        reg.reset()
+        assert reg.snapshot() == {}
+
+
+# ---------------------------------------------------------------------- #
+# Prometheus text format
+# ---------------------------------------------------------------------- #
+class TestPrometheus:
+    def _populated(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("raft_tpu_test_bytes_total",
+                    labels=("verb",)).labels(verb="allreduce").inc(4096)
+        g = reg.gauge("raft_tpu_test_live_bytes")
+        g.set(100)
+        g.set(40)
+        t = reg.timer("raft_tpu_test_lat_seconds")
+        for ms in (1, 2, 3, 4, 100):
+            t.observe(ms / 1000.0)
+        return reg
+
+    def test_round_trip(self):
+        reg = self._populated()
+        parsed = metrics.parse_prometheus(reg.to_prometheus())
+        assert (parsed["raft_tpu_test_bytes_total"]
+                [(("verb", "allreduce"),)] == 4096)
+        assert parsed["raft_tpu_test_live_bytes"][()] == 40
+        assert parsed["raft_tpu_test_live_bytes_high_water"][()] == 100
+        assert parsed["raft_tpu_test_lat_seconds_count"][()] == 5
+        assert parsed["raft_tpu_test_lat_seconds_sum"][()] == (
+            pytest.approx(0.110))
+        assert parsed["raft_tpu_test_lat_seconds_max"][()] == (
+            pytest.approx(0.100))
+        # quantile samples carry the quantile label
+        q = parsed["raft_tpu_test_lat_seconds"]
+        assert (("quantile", "0.5"),) in q
+        assert (("quantile", "0.95"),) in q
+
+    def test_label_escaping_round_trips(self):
+        reg = metrics.MetricsRegistry()
+        fam = reg.counter("raft_tpu_test_esc_total", labels=("what",))
+        fam.labels(what='a"b\\c').inc(3)
+        parsed = metrics.parse_prometheus(reg.to_prometheus())
+        assert parsed["raft_tpu_test_esc_total"][
+            (("what", 'a"b\\c'),)] == 3
+
+    def test_brace_in_label_value_round_trips(self):
+        """Review regression: [^}]* label matching choked on '}' inside
+        a quoted label value."""
+        reg = metrics.MetricsRegistry()
+        fam = reg.counter("raft_tpu_test_brace_total", labels=("what",))
+        fam.labels(what="a}b{c").inc(2)
+        parsed = metrics.parse_prometheus(reg.to_prometheus())
+        assert parsed["raft_tpu_test_brace_total"][
+            (("what", "a}b{c"),)] == 2
+
+    def test_backslash_n_sequence_round_trips(self):
+        """Review regression: sequential unescape replaces turned a
+        literal backslash-then-n into a newline; must be one pass."""
+        reg = metrics.MetricsRegistry()
+        fam = reg.counter("raft_tpu_test_esc2_total", labels=("what",))
+        for value in ("a\\nb", "a\nb", "end\\"):
+            fam.labels(what=value).inc(1)
+        parsed = metrics.parse_prometheus(reg.to_prometheus())
+        keys = set(parsed["raft_tpu_test_esc2_total"])
+        assert keys == {(("what", "a\\nb"),), (("what", "a\nb"),),
+                        (("what", "end\\"),)}
+
+
+# ---------------------------------------------------------------------- #
+# instrumented jit: compile-cache attribution
+# ---------------------------------------------------------------------- #
+class TestProfiledJit:
+    def _stats(self, name):
+        return profiler.compile_cache_stats().get(name, {})
+
+    def test_hit_miss_attribution_across_two_shapes(self):
+        calls = []
+
+        @profiler.profiled_jit(name="t_two_shapes",
+                               static_argnames=("k",))
+        def f(x, k):
+            calls.append(1)
+            return x * k
+
+        a = jnp.ones((4, 4), jnp.float32)
+        b = jnp.ones((8, 2), jnp.float32)
+        f(a, k=2)
+        assert sum(s["misses"] for s in
+                   self._stats("t_two_shapes").values()) == 1
+        f(a, k=2)  # same shape: hit, no retrace
+        st = self._stats("t_two_shapes")
+        assert sum(s["misses"] for s in st.values()) == 1
+        assert sum(s["hits"] for s in st.values()) == 1
+        f(b, k=2)  # second shape: second miss
+        st = self._stats("t_two_shapes")
+        assert len(st) == 2
+        assert sum(s["misses"] for s in st.values()) == 2
+        assert sum(s["compile_s"] for s in st.values()) > 0
+        # first and second call at the same shape differ: miss then hit
+        np.testing.assert_allclose(np.asarray(f(a, k=2)), 2.0)
+
+    def test_static_passed_positionally(self):
+        # mirrors _kmeans_jit(X, k, ...): static arg in the middle,
+        # passed positionally — the wrapper must normalize by name
+        @profiler.profiled_jit(name="t_positional_static",
+                               static_argnames=("k",))
+        def f(x, k, t):
+            return x * k + t
+
+        out = f(jnp.ones((3,), jnp.float32), 3, jnp.zeros((3,),
+                                                          jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+        out = f(jnp.ones((3,), jnp.float32), 3,
+                jnp.zeros((3,), jnp.float32))
+        st = self._stats("t_positional_static")
+        assert sum(s["hits"] for s in st.values()) == 1
+
+    def test_distinct_static_values_are_distinct_keys(self):
+        @profiler.profiled_jit(name="t_static_key",
+                               static_argnames=("k",))
+        def f(x, k):
+            return x * k
+
+        x = jnp.ones((2,), jnp.float32)
+        f(x, k=2)
+        f(x, k=3)
+        assert len(self._stats("t_static_key")) == 2
+
+    def test_jit_counters_in_default_registry(self):
+        reg = metrics.default_registry()
+
+        @profiler.profiled_jit(name="t_registry_counters")
+        def f(x):
+            return x + 1
+
+        x = jnp.ones((5,), jnp.float32)
+        miss_fam = reg.counter("raft_tpu_jit_cache_misses_total",
+                               labels=("fn",))
+        hit_fam = reg.counter("raft_tpu_jit_cache_hits_total",
+                              labels=("fn",))
+        f(x)
+        f(x)
+        assert miss_fam.labels(fn="t_registry_counters").value == 1
+        assert hit_fam.labels(fn="t_registry_counters").value == 1
+        tsnap = (reg.get("raft_tpu_jit_compile_seconds")
+                 .labels(fn="t_registry_counters")._snapshot())
+        assert tsnap["count"] == 1 and tsnap["total"] > 0
+
+    def test_pytree_and_dtype_in_key(self):
+        @profiler.profiled_jit(name="t_dtype_key")
+        def f(x):
+            return x.sum()
+
+        f(jnp.ones((4,), jnp.float32))
+        f(jnp.ones((4,), jnp.int32))
+        assert len(self._stats("t_dtype_key")) == 2
+
+    def test_defaulted_and_explicit_args_share_key(self):
+        """Review regression: sig.bind without apply_defaults() gave
+        f(x) and f(x, k=<default>) distinct keys — duplicate compiles
+        of one program and false misses."""
+        @profiler.profiled_jit(name="t_default_key",
+                               static_argnames=("k",))
+        def f(x, k=2, scale=1.0):
+            return x * k * scale
+
+        x = jnp.ones((4,), jnp.float32)
+        f(x)
+        f(x, k=2)
+        f(x, k=2, scale=1.0)
+        st = self._stats("t_default_key")
+        assert len(st) == 1
+        assert sum(s["misses"] for s in st.values()) == 1
+        assert sum(s["hits"] for s in st.values()) == 2
+
+    def test_device_placement_in_key(self):
+        """Review regression: same-shape arrays on different devices
+        must not replay one AOT executable (jax raises on a sharding
+        mismatch); they key separately, like jax.jit's cache."""
+        @profiler.profiled_jit(name="t_device_key")
+        def f(x):
+            return x + 1
+
+        devs = jax.devices()
+        x = jnp.ones((4,), jnp.float32)
+        f(jax.device_put(x, devs[0]))
+        out = f(jax.device_put(x, devs[-1]))  # 8-dev mesh in conftest
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        expected = 1 if len(devs) == 1 else 2
+        assert len(self._stats("t_device_key")) == expected
+
+    def test_disable_jit_falls_back_to_eager(self):
+        """Review regression: the AOT Compiled path raised under
+        jax.disable_jit(); it must route through the plain jit, which
+        honors the flag (eager step/print debugging)."""
+        @profiler.profiled_jit(name="t_disable_jit")
+        def f(x):
+            return x * 3
+
+        x = jnp.ones((4,), jnp.float32)
+        np.testing.assert_allclose(np.asarray(f(x)), 3.0)  # AOT cached
+        with jax.disable_jit():
+            np.testing.assert_allclose(np.asarray(f(x)), 3.0)
+        np.testing.assert_allclose(np.asarray(f(x)), 3.0)  # cache again
+
+    def test_static_objects_kept_alive_and_equality_keyed(self):
+        """Review regression: statics were keyed by repr(v), which for
+        id()-repr objects can alias a recycled address onto a stale
+        executable; they now key (and stay alive) by the object."""
+        @profiler.profiled_jit(name="t_static_alive",
+                               static_argnames=("mode",))
+        def f(x, mode):
+            return x + 1 if mode == "inc" else x - 1
+
+        x = jnp.ones((3,), jnp.float32)
+        np.testing.assert_allclose(np.asarray(f(x, "inc")), 2.0)
+        # equal-but-distinct string objects share the key (hit)
+        np.testing.assert_allclose(np.asarray(f(x, "in" + "c")), 2.0)
+        np.testing.assert_allclose(np.asarray(f(x, "dec")), 0.0)
+        st = self._stats("t_static_alive")
+        assert len(st) == 2
+        assert sum(s["hits"] for s in st.values()) == 1
+
+    def test_dynamic_scalars_key_by_type_not_value(self):
+        """Review regression: keying dynamic Python scalars on their
+        value reported a fresh miss (and compiled a fresh executable)
+        for every distinct tol/seed, where plain jax.jit aval-keys
+        them and hits."""
+        @profiler.profiled_jit(name="t_scalar_key",
+                               static_argnames=("k",))
+        def f(x, k, seed):
+            return x * k + seed
+
+        x = jnp.ones((4,), jnp.float32)
+        for seed in range(5):
+            out = f(x, 2, float(seed))
+            np.testing.assert_allclose(np.asarray(out), 2.0 + seed)
+        st = self._stats("t_scalar_key")
+        assert sum(s["misses"] for s in st.values()) == 1
+        assert sum(s["hits"] for s in st.values()) == 4
+        # a different scalar *type* is a different key
+        f(x, 2, 7)
+        assert len(self._stats("t_scalar_key")) == 2
+
+
+# ---------------------------------------------------------------------- #
+# profiler spans / report
+# ---------------------------------------------------------------------- #
+class TestProfilerReport:
+    def test_nesting_and_counts(self):
+        prof = profiler.Profiler(registry=metrics.MetricsRegistry())
+        with prof.span("outer"):
+            with prof.span("inner"):
+                pass
+            with prof.span("inner"):
+                pass
+        tree = prof.tree()
+        assert tree["outer"]["count"] == 1
+        assert tree["outer"]["children"]["inner"]["count"] == 2
+        report = prof.report()
+        out_line = [ln for ln in report.splitlines()
+                    if "outer" in ln][0]
+        in_line = [ln for ln in report.splitlines()
+                   if "inner" in ln][0]
+        # children render indented under their parent
+        assert (len(in_line) - len(in_line.lstrip())
+                > len(out_line) - len(out_line.lstrip()))
+        assert "n=2" in in_line
+
+    def test_span_feeds_layer_timer(self):
+        reg = metrics.MetricsRegistry()
+        prof = profiler.Profiler(registry=reg)
+        with prof.span("linalg.fake_op", layer="linalg"):
+            pass
+        snap = reg.snapshot()
+        assert ("raft_tpu_linalg_fake_op_seconds" in snap
+                and snap["raft_tpu_linalg_fake_op_seconds"]["series"][0]
+                ["count"] == 1)
+
+    def test_threads_do_not_graft(self):
+        prof = profiler.Profiler(registry=metrics.MetricsRegistry())
+        done = threading.Event()
+
+        def worker():
+            with prof.span("from_thread"):
+                pass
+            done.set()
+
+        with prof.span("main_scope"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert done.is_set()
+        tree = prof.tree()
+        # the thread's span is a root, NOT a child of main_scope
+        assert "from_thread" in tree
+        assert "from_thread" not in (
+            tree["main_scope"].get("children", {}))
+
+    def test_exception_still_recorded(self):
+        prof = profiler.Profiler(registry=metrics.MetricsRegistry())
+        with pytest.raises(RuntimeError):
+            with prof.span("exploding"):
+                raise RuntimeError("boom")
+        assert prof.tree()["exploding"]["count"] == 1
+
+    def test_disabled_spans_are_noop(self):
+        prof = profiler.Profiler(registry=metrics.MetricsRegistry())
+        metrics.set_enabled(False)
+        try:
+            with prof.span("invisible"):
+                pass
+        finally:
+            metrics.set_enabled(True)
+        assert "invisible" not in prof.tree()
+
+    def test_profiled_primitive_honors_handle_profiler(self):
+        """Review regression: @profiled primitives hardwired the
+        process profiler, dropping spans from a Handle carrying a
+        scoped one."""
+        from raft_tpu import Handle
+        from raft_tpu.distance.pairwise import pairwise_distance
+
+        scoped = profiler.Profiler(registry=metrics.MetricsRegistry())
+        h = Handle(profiler=scoped)
+        x = jnp.ones((8, 4), jnp.float32)
+        pairwise_distance(x, x, handle=h)
+        assert "distance.pairwise_distance" in scoped.tree()
+
+    def test_jit_spans_follow_active_scoped_profiler(self):
+        """Review regression: profiled_jit's 'jit.<fn>' spans landed on
+        the process-default profiler even when the caller's span ran on
+        a handle-scoped one, orphaning compile/execute children."""
+        @profiler.profiled_jit(name="t_scoped_routing")
+        def f(x):
+            return x + 1
+
+        scoped = profiler.Profiler(registry=metrics.MetricsRegistry())
+        x = jnp.ones((4,), jnp.float32)
+        with scoped.span("outer_scope"):
+            f(x)
+        tree = scoped.tree()
+        assert ("jit.t_scoped_routing"
+                in tree["outer_scope"].get("children", {}))
+        default_tree = profiler.default_profiler().tree()
+        assert "jit.t_scoped_routing" not in default_tree
+
+    def test_takes_handle_primitives_report(self):
+        from raft_tpu.linalg import gemm
+
+        reg = metrics.default_registry()
+        a = jnp.eye(8, dtype=jnp.float32)
+        before = 0
+        fam = reg.get("raft_tpu_linalg_gemm_seconds")
+        if fam is not None:
+            before = fam._default()._snapshot()["count"]
+        gemm(a, a)
+        after = (reg.get("raft_tpu_linalg_gemm_seconds")
+                 ._default()._snapshot()["count"])
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------- #
+# memory accounting
+# ---------------------------------------------------------------------- #
+class TestMemoryAccounting:
+    def _live(self, space):
+        return metrics.default_registry().gauge(
+            "raft_tpu_mr_live_bytes", labels=("space",)).labels(space=space)
+
+    def test_device_buffer_tracks_alloc_free(self):
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        g = self._live("device")
+        before = g.value
+        buf = DeviceBuffer((64, 64), jnp.float32)
+        nbytes = 64 * 64 * 4
+        assert g.value == before + nbytes
+        assert g.high_water >= before + nbytes
+        buf.deallocate()
+        assert g.value == before
+        buf.deallocate()  # idempotent: no double-free accounting
+        assert g.value == before
+
+    def test_peak_survives_free(self):
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        g = self._live("device")
+        with DeviceBuffer((256, 256), jnp.float32):
+            peak_during = g.high_water
+        assert g.high_water == peak_during  # peak is sticky
+
+    def test_host_buffer_space_label(self):
+        from raft_tpu.mr.buffer import HostBuffer
+
+        g = self._live("host")
+        before = g.value
+        buf = HostBuffer((32, 32), jnp.float32)
+        assert g.value == before + 32 * 32 * 4
+        buf.deallocate()
+        assert g.value == before
+
+    def test_allocation_error_carries_context(self, monkeypatch):
+        from raft_tpu.mr import buffer as mr_buffer
+
+        def explode(*a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        monkeypatch.setattr(mr_buffer.jax, "device_put", explode)
+        with pytest.raises(AllocationError) as ei:
+            mr_buffer.DeviceBuffer((128, 128), jnp.float32)
+        err = ei.value
+        assert err.requested_bytes == 128 * 128 * 4
+        assert err.live_bytes >= 0
+        assert "128" in str(err) and "live" in str(err)
+        assert isinstance(err, Exception)
+        # failed allocation must not leak into the live gauge
+        g = self._live("device")
+        assert g.value >= 0
+
+    def test_gc_reclaims_accounting(self):
+        """Review regression: buffers dropped without deallocate() (GC
+        frees the HBM) must release their live-byte accounting too."""
+        import gc
+
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        g = self._live("device")
+        before = g.value
+        bufs = [DeviceBuffer((32, 32), jnp.float32) for _ in range(3)]
+        assert g.value == before + 3 * 32 * 32 * 4
+        del bufs
+        gc.collect()
+        assert g.value == before
+
+    def test_gc_does_not_delete_adopted_array(self):
+        """Review regression: __del__ must release accounting only —
+        an adopted array the caller still holds must survive the
+        wrapper's GC."""
+        import gc
+
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        x = jnp.ones((8, 8), jnp.float32)
+        buf = DeviceBuffer.from_array(x)
+        del buf
+        gc.collect()
+        np.testing.assert_allclose(np.asarray(x), 1.0)  # still alive
+
+    def test_accounting_balances_across_disable(self):
+        """Review regression: a free must balance its recorded alloc
+        even if RAFT_TPU_METRICS is toggled off in between (and an
+        alloc made while disabled must not be decremented later)."""
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        g = self._live("device")
+        before = g.value
+        buf = DeviceBuffer((64, 64), jnp.float32)  # recorded
+        metrics.set_enabled(False)
+        try:
+            buf.deallocate()  # paired free applies despite the gate
+            assert g.value == before
+            buf2 = DeviceBuffer((32, 32), jnp.float32)  # NOT recorded
+        finally:
+            metrics.set_enabled(True)
+        buf2.deallocate()  # no matching alloc: must not go negative
+        assert g.value == before
+
+    def test_free_after_registry_reset_does_not_go_negative(self):
+        """Review regression: a registry reset between alloc and free
+        recreates the gauge at 0 — the orphaned free must be dropped,
+        not applied (which left live_bytes negative forever)."""
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        reg = metrics.default_registry()
+        buf = DeviceBuffer((64, 64), jnp.float32)
+        reg.reset()
+        buf.deallocate()
+        fam = reg.get("raft_tpu_mr_live_bytes")
+        val = (fam.labels(space="device").value
+               if fam is not None else 0)
+        assert val == 0
+
+    def test_zero_size_buffer_pairs_alloc_and_free_counters(self):
+        """Review regression: a 0-byte buffer recorded its alloc
+        counter but the falsy byte count skipped the free half."""
+        from raft_tpu.mr.buffer import DeviceBuffer
+
+        reg = metrics.default_registry()
+
+        def count(name):
+            fam = reg.get(name)
+            if fam is None:
+                return 0
+            return fam.labels(space="device").value
+
+        a0 = count("raft_tpu_mr_alloc_total")
+        f0 = count("raft_tpu_mr_free_total")
+        DeviceBuffer((0, 8), jnp.float32).deallocate()
+        assert count("raft_tpu_mr_alloc_total") == a0 + 1
+        assert count("raft_tpu_mr_free_total") == f0 + 1
+
+    def test_pool_counters(self):
+        from raft_tpu.mr.buffer import PoolAllocator
+
+        reg = metrics.default_registry()
+        hits = reg.counter("raft_tpu_mr_pool_hits_total")
+        misses = reg.counter("raft_tpu_mr_pool_misses_total")
+        h0, m0 = hits.value, misses.value
+        pool = PoolAllocator()
+        buf = pool.allocate((16, 16))
+        pool.deallocate(buf)
+        pool.allocate((16, 16))
+        assert misses.value == m0 + 1
+        assert hits.value == h0 + 1
+        pool.release()
+
+
+# ---------------------------------------------------------------------- #
+# tracing regressions (ISSUE 2 satellites)
+# ---------------------------------------------------------------------- #
+class TestTracingThreadLocal:
+    def test_thread_pop_does_not_touch_main_stack(self):
+        """Regression: _range_stack was process-global, so a watchdog
+        thread's range_pop popped the main thread's open range."""
+        tracing.range_push("main_range")
+        try:
+            assert len(tracing._range_stack()) == 1
+
+            def worker():
+                # one matched pair, then an unmatched pop — under the
+                # old global stack the extra pop closed main's range
+                tracing.range_push("thread_range")
+                tracing.range_pop()
+                tracing.range_pop()
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            assert len(tracing._range_stack()) == 1
+        finally:
+            tracing.range_pop()
+        assert len(tracing._range_stack()) == 0
+
+
+class TestRangePushNamedScope:
+    """Regression: range_push only opened a TraceAnnotation, so
+    imperative ranges (unlike `annotate`) put no name on the tracing
+    name stack and therefore left no HLO names.  The observable is the
+    name stack JAX stamps onto traced ops (the same stack
+    ``jax.named_scope`` feeds); both range forms must now push it."""
+
+    @staticmethod
+    def _name_stack():
+        from jax._src import source_info_util
+
+        return str(source_info_util.current_name_stack())
+
+    def test_imperative_range_enters_named_scope(self):
+        assert "obsv_scope_regression" not in self._name_stack()
+        tracing.range_push("obsv_scope_regression")
+        try:
+            assert "obsv_scope_regression" in self._name_stack()
+        finally:
+            tracing.range_pop()
+        # and the scope is properly closed after pop
+        assert "obsv_scope_regression" not in self._name_stack()
+
+    def test_scoped_and_imperative_consistent(self):
+        with tracing.annotate("consistency_probe"):
+            a = self._name_stack()
+        tracing.range_push("consistency_probe")
+        try:
+            b = self._name_stack()
+        finally:
+            tracing.range_pop()
+        assert ("consistency_probe" in a) and (a == b)
+
+    def test_named_scope_visible_to_tracing_in_range(self):
+        """An op traced between push and pop carries the range name in
+        its jaxpr source info — the HLO-name consistency the fix is
+        about (scopes entered outside a ``jit`` boundary don't cross
+        it in this JAX version; in-trace usage does, same as
+        ``annotate``)."""
+        from jax._src import source_info_util
+
+        def f(x):
+            tracing.range_push("in_trace_range")
+            try:
+                return x + 1
+            finally:
+                tracing.range_pop()
+
+        jaxpr = jax.make_jaxpr(f)(0.0)
+        stacks = [str(source_info_util.current_name_stack())]
+        stacks += [str(e.source_info.name_stack) for e in jaxpr.eqns]
+        assert any("in_trace_range" in s for s in stacks[1:])
+
+
+# ---------------------------------------------------------------------- #
+# comms verb metrics
+# ---------------------------------------------------------------------- #
+class TestCommsMetrics:
+    def test_bytes_and_latency_per_verb(self):
+        from raft_tpu.comms import HostComms
+        from raft_tpu.comms.types import Op
+
+        reg = metrics.default_registry()
+        comms = HostComms()
+        size = comms.get_size()
+        x = jnp.ones((size, 8), jnp.float32)
+
+        def bytes_now():
+            fam = reg.get("raft_tpu_comms_bytes_total")
+            if fam is None:
+                return 0
+            return fam.labels(verb="allreduce").value
+
+        def lat_count():
+            fam = reg.get("raft_tpu_comms_verb_seconds")
+            if fam is None:
+                return 0
+            return fam.labels(verb="allreduce")._snapshot()["count"]
+
+        b0, n0 = bytes_now(), lat_count()
+        comms.allreduce(x, Op.SUM)
+        comms.allreduce(x, Op.SUM)
+        assert bytes_now() == b0 + 2 * x.nbytes
+        assert lat_count() == n0 + 2
+
+    def test_prog_cache_counters(self):
+        from raft_tpu.comms import HostComms
+
+        reg = metrics.default_registry()
+        comms = HostComms()  # fresh communicator: its prog cache is empty
+        size = comms.get_size()
+        x = jnp.ones((size, 4), jnp.float32)
+
+        def count(name):
+            fam = reg.get(name)
+            if fam is None:
+                return 0
+            return fam.labels(verb="bcast").value
+
+        m0 = count("raft_tpu_comms_prog_cache_misses_total")
+        h0 = count("raft_tpu_comms_prog_cache_hits_total")
+        comms.bcast(x)
+        comms.bcast(x)
+        assert count("raft_tpu_comms_prog_cache_misses_total") == m0 + 1
+        assert count("raft_tpu_comms_prog_cache_hits_total") == h0 + 1
+
+    def test_failed_verb_counts_latency_not_bytes(self):
+        from raft_tpu.comms import HostComms
+
+        reg = metrics.default_registry()
+        comms = HostComms()
+        size = comms.get_size()
+        bad = jnp.ones((size + 1, 2), jnp.float32)  # wrong leading axis
+
+        def bytes_now():
+            fam = reg.get("raft_tpu_comms_bytes_total")
+            if fam is None:
+                return 0
+            return fam.labels(verb="allreduce").value
+
+        b0 = bytes_now()
+        with pytest.raises(LogicError):
+            comms.allreduce(bad)
+        assert bytes_now() == b0
+
+
+# ---------------------------------------------------------------------- #
+# session snapshot surface (the ISSUE acceptance shape)
+# ---------------------------------------------------------------------- #
+class TestSessionSnapshot:
+    def test_bench_shaped_run_snapshot(self, tmp_path):
+        """pairwise + knn (x2: miss then hit) + allreduce + a buffer —
+        the snapshot must carry per-primitive histograms, differing jit
+        miss/hit between first and second same-shape call, comms
+        bytes/latency per verb, and a live-buffer peak."""
+        from raft_tpu.comms import HostComms
+        from raft_tpu.distance.pairwise import pairwise_distance
+        from raft_tpu.mr.buffer import DeviceBuffer
+        from raft_tpu.session import Session
+        from raft_tpu.spatial.knn import brute_force_knn
+
+        rng = np.random.default_rng(7)
+        X = jnp.asarray(rng.standard_normal((128, 16)), jnp.float32)
+        Q = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+
+        st0 = profiler.compile_cache_stats().get("tiled_knn", {})
+        h0 = sum(s["hits"] for s in st0.values())
+        m0 = sum(s["misses"] for s in st0.values())
+
+        pairwise_distance(Q, X)
+        brute_force_knn(X, Q, k=3)   # first call at this shape
+        brute_force_knn(X, Q, k=3)   # second call: cache hit
+        comms = HostComms()
+        comms.allreduce(jnp.ones((comms.get_size(), 4), jnp.float32))
+        with DeviceBuffer((64, 64), jnp.float32):
+            pass
+
+        s = Session()
+        snap = s.metrics_snapshot()
+        m = snap["metrics"]
+
+        # per-primitive timer histograms, counts > 0
+        for name in ("raft_tpu_distance_pairwise_distance_seconds",
+                     "raft_tpu_spatial_brute_force_knn_seconds",
+                     "raft_tpu_spatial_tiled_knn_seconds"):
+            assert m[name]["type"] == "timer"
+            assert m[name]["series"][0]["count"] > 0
+
+        # jit compile/hit counts differ between 1st and 2nd call
+        st = snap["compile_cache"]["tiled_knn"]
+        assert sum(s_["misses"] for s_ in st.values()) >= m0 + 1
+        assert sum(s_["hits"] for s_ in st.values()) >= h0 + 1
+
+        # comms bytes + latency per verb
+        verbs = {s_["labels"]["verb"]
+                 for s_ in m["raft_tpu_comms_verb_seconds"]["series"]}
+        assert "allreduce" in verbs
+        byts = {s_["labels"]["verb"]: s_["value"]
+                for s_ in m["raft_tpu_comms_bytes_total"]["series"]}
+        assert byts["allreduce"] > 0
+
+        # peak live buffer bytes
+        mr = {s_["labels"]["space"]: s_
+              for s_ in m["raft_tpu_mr_live_bytes"]["series"]}
+        assert mr["device"]["high_water"] >= 64 * 64 * 4
+
+        # profiler tree shows the knn nesting
+        tree = snap["profiler_tree"]
+        assert "spatial.brute_force_knn" in tree
+        assert ("spatial.tiled_knn"
+                in tree["spatial.brute_force_knn"]["children"])
+        assert "profiler report" in snap["profiler_report"]
+
+    def test_dump_metrics_round_trips(self, tmp_path):
+        from raft_tpu.session import Session
+
+        path = tmp_path / "snap.json"
+        s = Session()
+        written = s.dump_metrics(str(path))
+        loaded = json.loads(path.read_text())
+        assert set(loaded) == {"metrics", "compile_cache",
+                               "profiler_tree", "profiler_report",
+                               "event_counters"}
+        assert loaded["metrics"].keys() == written["metrics"].keys()
+
+    def test_module_level_snapshot_matches_session(self):
+        from raft_tpu import session as session_mod
+
+        a = session_mod.metrics_snapshot()
+        b = session_mod.Session().metrics_snapshot()
+        assert set(a) == set(b)
+
+
+# ---------------------------------------------------------------------- #
+# style check: ad-hoc timing ban
+# ---------------------------------------------------------------------- #
+class TestTimingBan:
+    def _check(self, tmp_path, monkeypatch, rel, body):
+        import importlib.util
+        import os
+        import sys
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check_under_test",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "ci", "style_check.py"))
+        sc = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sc)
+        monkeypatch.setattr(sc, "REPO", str(tmp_path))
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(body)
+        sys.modules.pop("style_check_under_test", None)
+        return sc.check_file(str(path))
+
+    def test_time_time_rejected_in_library(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "raft_tpu/bad.py",
+            "import time\nt0 = time.time()\n")
+        assert any("ad-hoc time.time()" in p for p in problems)
+
+    def test_perf_counter_rejected(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "raft_tpu/bad2.py",
+            "import time\nt0 = time.perf_counter()\n")
+        assert any("perf_counter" in p for p in problems)
+
+    def test_aliased_import_rejected(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "raft_tpu/bad3.py",
+            "import time as t\nt0 = t.monotonic()\n")
+        assert any("monotonic" in p for p in problems)
+
+    def test_from_import_rejected(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "raft_tpu/bad4.py",
+            "from time import perf_counter\nt0 = perf_counter()\n")
+        assert any("perf_counter" in p for p in problems)
+
+    def test_sleep_allowed(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "raft_tpu/ok.py",
+            "import time\ntime.sleep(0.1)\n")
+        assert problems == []
+
+    def test_metrics_module_allowlisted(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "raft_tpu/core/metrics.py",
+            "import time\nt0 = time.perf_counter()\n")
+        assert problems == []
+
+    def test_outside_library_allowed(self, tmp_path, monkeypatch):
+        problems = self._check(
+            tmp_path, monkeypatch, "tests/timing_ok.py",
+            "import time\nt0 = time.time()\n")
+        assert problems == []
+
+    def test_repo_is_clean(self):
+        """The real tree passes its own timing ban."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "ci", "style_check.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

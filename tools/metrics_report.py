@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Render / produce raft_tpu observability artifacts.
+
+The same snapshot shape everywhere: ``Session.metrics_snapshot()``,
+``bench.py``'s embedded ``metrics_snapshot``, and this CLI all carry
+``{metrics, compile_cache, profiler_tree, event_counters}`` (see
+docs/OBSERVABILITY.md), so one tool reads them all.
+
+Usage:
+    # pretty-print a dumped snapshot (Session.dump_metrics / bench JSON)
+    python tools/metrics_report.py snapshot.json
+    python tools/metrics_report.py bench.json --format prom
+    python tools/metrics_report.py snapshot.json --format json
+
+    # run a tiny instrumented workload (pairwise + knn + allreduce +
+    # buffer churn) and report it — the zero-to-numbers smoke path
+    python tools/metrics_report.py --demo
+    python tools/metrics_report.py --demo --out snapshot.json
+
+Formats: ``report`` (default; human-readable tables + span tree),
+``json`` (the raw snapshot), ``prom`` (Prometheus text format for the
+registry half — only available with --demo or a live process, since a
+dumped snapshot has already flattened the registry).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return "%.3fs" % v
+    if v >= 1e-3:
+        return "%.3fms" % (v * 1e3)
+    return "%.1fus" % (v * 1e6)
+
+
+def render_report(snap: dict) -> str:
+    lines = []
+    metrics = snap.get("metrics", {})
+    timers = {n: f for n, f in metrics.items() if f.get("type") == "timer"}
+    if timers:
+        lines.append("== timers (count / total / mean / p50 / p95 / max) ==")
+        for name, fam in sorted(timers.items()):
+            for s in fam["series"]:
+                lbl = ",".join("%s=%s" % kv for kv in
+                               sorted(s["labels"].items()))
+                lines.append(
+                    "  %-52s %-24s n=%-7d %s  %s  %s  %s  %s"
+                    % (name, lbl, s["count"], _fmt_s(s["total"]),
+                       _fmt_s(s["mean"]), _fmt_s(s["p50"]),
+                       _fmt_s(s["p95"]), _fmt_s(s["max"])))
+    others = {n: f for n, f in metrics.items() if f.get("type") != "timer"}
+    if others:
+        lines.append("== counters / gauges ==")
+        for name, fam in sorted(others.items()):
+            for s in fam["series"]:
+                lbl = ",".join("%s=%s" % kv for kv in
+                               sorted(s["labels"].items()))
+                extra = ("  (peak %g)" % s["high_water"]
+                         if "high_water" in s else "")
+                lines.append("  %-52s %-24s %g%s"
+                             % (name, lbl, s["value"], extra))
+    cc = snap.get("compile_cache", {})
+    if cc:
+        lines.append("== jit compile cache (per fn: shapes / hits / "
+                     "misses / compile) ==")
+        for fn_name, keys in sorted(cc.items()):
+            h = sum(st["hits"] for st in keys.values())
+            m = sum(st["misses"] for st in keys.values())
+            c = sum(st["compile_s"] for st in keys.values())
+            lines.append("  %-40s shapes=%-4d hits=%-6d misses=%-4d "
+                         "compile=%s" % (fn_name, len(keys), h, m,
+                                         _fmt_s(c)))
+    ev = snap.get("event_counters", {})
+    if ev:
+        lines.append("== event counters ==")
+        for name, v in sorted(ev.items()):
+            lines.append("  %-52s %d" % (name, v))
+    report = snap.get("profiler_report")
+    tree = snap.get("profiler_tree", {})
+    if report:
+        lines.append(report)
+    elif tree:
+        lines.append("== profiler span tree ==")
+
+        def walk(name, node, depth):
+            mean = (node["total_s"] / node["count"]) if node["count"] else 0
+            lines.append("  %s%-*s n=%-6d total=%s mean=%s"
+                         % ("  " * depth, max(1, 36 - 2 * depth), name,
+                            node["count"], _fmt_s(node["total_s"]),
+                            _fmt_s(mean)))
+            for cn, c in sorted(node.get("children", {}).items()):
+                walk(cn, c, depth + 1)
+
+        for name, node in sorted(tree.items()):
+            walk(name, node, 0)
+    return "\n".join(lines) if lines else "(empty snapshot)"
+
+
+def run_demo() -> dict:
+    """Tiny instrumented workload touching every metric layer."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.comms import HostComms
+    from raft_tpu.distance.pairwise import pairwise_distance
+    from raft_tpu.mr.buffer import DeviceBuffer
+    from raft_tpu.session import metrics_snapshot
+    from raft_tpu.spatial.knn import brute_force_knn
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((256, 32)), jnp.float32)
+    Q = jnp.asarray(rng.standard_normal((32, 32)), jnp.float32)
+    pairwise_distance(Q, X)
+    for _ in range(2):  # second call = jit cache hit
+        brute_force_knn(X, Q, k=4)
+    comms = HostComms()
+    size = comms.get_size()
+    comms.allreduce(jnp.ones((size, 4), jnp.float32))
+    comms.allreduce(jnp.ones((size, 4), jnp.float32))
+    with DeviceBuffer((1024, 1024)):
+        pass
+    return metrics_snapshot()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", nargs="?",
+                    help="snapshot JSON (Session.dump_metrics or bench "
+                         "output; bench files are unwrapped automatically)")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a small instrumented workload instead of "
+                         "reading a file")
+    ap.add_argument("--format", choices=("report", "json", "prom"),
+                    default="report")
+    ap.add_argument("--out", help="also write the snapshot JSON here")
+    args = ap.parse_args(argv)
+
+    if args.demo == (args.snapshot is not None):
+        ap.error("pass exactly one of: a snapshot file, or --demo")
+
+    if args.demo:
+        snap = run_demo()
+    else:
+        with open(args.snapshot, encoding="utf-8") as f:
+            snap = json.load(f)
+        # bench.py artifact? unwrap to its embedded snapshot
+        for path in (("metrics_snapshot",), ("detail", "metrics_snapshot")):
+            cur = snap
+            for k in path:
+                cur = cur.get(k, {}) if isinstance(cur, dict) else {}
+            if cur:
+                snap = cur
+                break
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.format == "json":
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    elif args.format == "prom":
+        if args.demo:
+            from raft_tpu.core.metrics import default_registry
+
+            print(default_registry().to_prometheus(), end="")
+        else:
+            print("--format prom needs a live registry; use --demo "
+                  "(a dumped snapshot is already flattened)",
+                  file=sys.stderr)
+            return 2
+    else:
+        print(render_report(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
