@@ -878,6 +878,44 @@ def _bench_ivf(n_index, n_query, iters, build, search, params,
     return out
 
 
+def _bench_serve(index_rows, dim, k, duration, concurrency):
+    """Serving-layer rung: closed-loop clients against a warmed
+    KNNService (docs/SERVING.md).  Unlike the raw-primitive rungs this
+    measures the whole request path — queueing, coalescing, padding,
+    split — so its QPS is the number the north star ("serves heavy
+    traffic") is actually about; the raw kNN rungs bound it from above.
+    Client-observed latency percentiles ride along, plus the padding
+    waste the bucket ladder cost."""
+    from tools.loadgen import build_service, run_load
+
+    svc = build_service("knn", index_rows, dim, k,
+                        max_batch_rows=256, max_wait_ms=1.0,
+                        queue_cap=4096)
+    t0 = time.time()
+    svc.warmup()
+    warmup_s = time.time() - t0
+    try:
+        rep = run_load(svc, mode="closed", duration=duration,
+                       concurrency=concurrency, rows=4)
+    finally:
+        svc.close()
+    return {
+        "qps": rep["qps"],
+        "p50_ms": rep["p50_ms"],
+        "p95_ms": rep["p95_ms"],
+        "p99_ms": rep["p99_ms"],
+        "requests_ok": rep["requests_ok"],
+        "rejected": rep["rejected"],
+        "errors": rep["errors"],
+        "mean_batch_rows": round(rep["mean_batch_rows"], 2),
+        "padding_waste": round(rep["padding_waste"], 4),
+        "warmup_s": round(warmup_s, 3),
+        "config": {"index_rows": index_rows, "dim": dim, "k": k,
+                   "concurrency": concurrency, "rows_per_request": 4,
+                   "max_batch_rows": 256},
+    }
+
+
 def _bench_sparse_pairwise(m, n_cols, nnz_row, iters, batch_size_k):
     """Sparse CSR pairwise L2 on the column-tiled engine (the
     load-balanced-SpMV-regime analog, sparse/distance/detail/
@@ -1156,6 +1194,10 @@ def child_main():
             # no-hardware round
             ("sparse_pairwise", 40,
              lambda: _bench_sparse_pairwise(512, 32768, 16, 2, 8192)),
+            # serving-layer evidence (queue→coalesce→padded call→split):
+            # scaled index, whole-request-path QPS + latency percentiles
+            ("serve_knn", 45,
+             lambda: _bench_serve(20_000, 64, 10, 3.0, 8)),
             # affordable on CPU since the r5 single-jit Lanczos (~12 s
             # incl the graph build; was hours-scale retrace before)
             ("spectral_100k", 40, _bench_spectral_100k),
@@ -1247,6 +1289,11 @@ def child_main():
              lambda: _bench_ivf_pq(100_000, 4096, 4)),
             ("ivf_sq_100k", 90,
              lambda: _bench_ivf_sq(100_000, 4096, 4)),
+            # the serving-layer number the north star is about: whole
+            # request path (queue→coalesce→padded call→split) against a
+            # warmed service; est covers the per-bucket warmup compiles
+            ("serve_knn", 90,
+             lambda: _bench_serve(100_000, 64, 10, 5.0, 16)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),

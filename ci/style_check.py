@@ -18,6 +18,13 @@ Checks, per Python source file:
   allowlisted (they ARE the timing implementation); ``time.sleep`` is
   not timing and stays legal.  bench.py / tools / tests are outside
   the library and free to time however they like.
+- no raw ``threading.Thread(`` inside ``raft_tpu/`` outside
+  ``raft_tpu/serve/`` and the resilience/profiler allowlist:
+  daemon-thread hygiene (naming, lifecycle, drain-on-close) lives in
+  one place — the serve worker (docs/SERVING.md) — plus the comms
+  watchdog that predates it.  New background work should go through a
+  :class:`raft_tpu.serve.scheduler.ServeWorker` or the resilience
+  watchdog, not ad-hoc threads that nothing drains at teardown.
 
 Exit code 0 when clean; prints one line per violation otherwise.
 """
@@ -37,6 +44,13 @@ TIMING_ATTRS = ("time", "perf_counter", "perf_counter_ns", "monotonic",
 TIMING_ALLOWLIST = (
     os.path.join("raft_tpu", "core", "metrics.py"),
     os.path.join("raft_tpu", "core", "profiler.py"),
+)
+
+# raw-Thread ban (raft_tpu/ only): serve/ owns worker threads; the
+# resilience watchdog and the timing allowlist predate it
+THREAD_DIR_ALLOWLIST = (os.path.join("raft_tpu", "serve") + os.sep,)
+THREAD_ALLOWLIST = TIMING_ALLOWLIST + (
+    os.path.join("raft_tpu", "comms", "resilience.py"),
 )
 
 
@@ -60,15 +74,43 @@ def check_file(path):
             problems.append(f"{rel}:{i}: line too long ({len(line)})")
     in_lib = (rel.startswith("raft_tpu" + os.sep)
               and rel not in TIMING_ALLOWLIST)
-    # aliases the time module is bound to ("import time", "import time
-    # as t") — attribute-call matching must follow them or the ban is
-    # trivially evaded
+    in_thread_scope = (rel.startswith("raft_tpu" + os.sep)
+                       and not any(rel.startswith(d)
+                                   for d in THREAD_DIR_ALLOWLIST)
+                       and rel not in THREAD_ALLOWLIST)
+    # aliases the time/threading modules are bound to ("import time",
+    # "import time as t") — attribute-call matching must follow them or
+    # the bans are trivially evaded
     time_aliases = {"time"}
+    threading_aliases = {"threading"}
     for node in ast.walk(tree):
         if (isinstance(node, ast.ImportFrom) and node.module
                 and node.module.startswith("raft_tpu")
                 and any(a.name == "*" for a in node.names)):
             problems.append(f"{rel}:{node.lineno}: wildcard raft_tpu import")
+        if in_thread_scope:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        threading_aliases.add(a.asname or "threading")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "threading"
+                    and any(a.name == "Thread" for a in node.names)):
+                problems.append(
+                    f"{rel}:{node.lineno}: from-import of "
+                    "threading.Thread — background work goes through "
+                    "raft_tpu/serve (ServeWorker) or the resilience "
+                    "watchdog (docs/SERVING.md)")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Thread"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in threading_aliases):
+                problems.append(
+                    f"{rel}:{node.lineno}: raw threading.Thread() — "
+                    "background work goes through raft_tpu/serve "
+                    "(ServeWorker) or the resilience watchdog "
+                    "(docs/SERVING.md)")
         if not in_lib:
             continue
         if isinstance(node, ast.Import):

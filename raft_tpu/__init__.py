@@ -33,6 +33,9 @@ Layout (mirrors the reference's module inventory, see SURVEY.md section 2):
 - ``raft_tpu.lap``      — linear assignment problem solver
 - ``raft_tpu.comms``    — comms_t-shaped collective/p2p interface over XLA
                           collectives (ICI/DCN), mesh sub-communicators
+- ``raft_tpu.serve``    — dynamic micro-batching query engine: shape
+                          buckets + warmup, admission control, deadlines,
+                          graceful drain (docs/SERVING.md)
 """
 
 __version__ = "0.1.0"
@@ -44,6 +47,7 @@ from raft_tpu.core.error import (  # noqa: F401
     CommError,
     CommTimeoutError,
     RaftError,
+    ServiceOverloadError,
     expects,
     fail,
 )

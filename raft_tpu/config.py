@@ -48,6 +48,20 @@ spmv_impl
     CSR SpMV (:func:`raft_tpu.sparse.linalg.csr_spmv`): ``segment``
     (gather + sorted segment-sum) | ``cumsum`` (prefix-sum form) |
     ``sortscan`` (gather-free: sort+scan formulation of the x read).
+serve_bucket_rungs
+    Default shape-bucket ladder for :mod:`raft_tpu.serve` services:
+    ``pow2`` (power-of-two rungs up to the service's max batch rows) or
+    a comma-separated ascending row list (``"64,256,1024"``).  Free-form
+    (validated by :func:`raft_tpu.serve.bucketing.resolve_rungs`).
+serve_max_wait_ms
+    Default micro-batching window in milliseconds: how long a queued
+    request may wait for co-batched company before the batch dispatches
+    anyway.  Free-form float; resolved at service construction (the
+    serve layer, not a trace, consumes it — no executable-cache caveat).
+serve_queue_cap
+    Default admission-control cap on queued requests per service;
+    beyond it, ``submit`` raises
+    :class:`~raft_tpu.core.error.ServiceOverloadError`.  Free-form int.
 """
 
 from __future__ import annotations
@@ -60,8 +74,10 @@ from typing import Dict, Iterator, Optional, Tuple
 
 __all__ = ["configure", "override", "get", "describe"]
 
-# knob -> (env alias, default, legal values settable via configure)
-_KNOBS: Dict[str, Tuple[str, Optional[str], Tuple[str, ...]]] = {
+# knob -> (env alias, default, legal values settable via configure);
+# choices None = free-form (the consumer validates — numeric/list knobs
+# cannot be enumerated here)
+_KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "select_impl": ("RAFT_TPU_SELECT_IMPL", "topk",
                     ("topk", "approx", "approx95", "chunked", "pallas")),
     "tile_merge": ("RAFT_TPU_TILE_MERGE", "tile_topk",
@@ -73,7 +89,16 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Tuple[str, ...]]] = {
     "pq_adc": ("RAFT_TPU_PQ_ADC", "gather", ("gather", "onehot")),
     "spmv_impl": ("RAFT_TPU_SPMV_IMPL", "segment",
                   ("segment", "cumsum", "sortscan")),
+    "serve_bucket_rungs": ("RAFT_TPU_SERVE_BUCKET_RUNGS", "pow2", None),
+    "serve_max_wait_ms": ("RAFT_TPU_SERVE_MAX_WAIT_MS", "2", None),
+    "serve_queue_cap": ("RAFT_TPU_SERVE_QUEUE_CAP", "1024", None),
 }
+
+# knobs resolved at *runtime* (service/object construction), never baked
+# into a trace: changing one later affects the next construction and the
+# executable-cache caveat warning does not apply
+_RUNTIME_KNOBS = frozenset(
+    ("serve_bucket_rungs", "serve_max_wait_ms", "serve_queue_cap"))
 
 _values: Dict[str, Optional[str]] = {}
 _tls = threading.local()
@@ -116,13 +141,15 @@ def _check(name: str, value: Optional[str]) -> None:
             f"raft_tpu.config: unknown knob {name!r} "
             f"(have: {', '.join(sorted(_KNOBS))})")
     env, default, choices = _KNOBS[name]
-    if value is not None and value not in choices:
+    if value is not None and choices is not None and value not in choices:
         raise ValueError(
             f"raft_tpu.config: {name}={value!r} not in {choices} "
             "('skip' and other probe-only modes are argument-only)")
 
 
 def _warn_if_consumed(name: str, value: Optional[str]) -> None:
+    if name in _RUNTIME_KNOBS:
+        return
     with _lock:
         seen = _consumed.get(name)
         if seen and value not in seen:

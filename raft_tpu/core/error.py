@@ -58,6 +58,29 @@ class AllocationError(RaftError):
             % (message, self.requested_bytes, self.live_bytes))
 
 
+class ServiceOverloadError(RaftError):
+    """Admission control rejected a request: the serving queue is at its
+    configured depth cap (:mod:`raft_tpu.serve` — the analog of a
+    load-balancer shedding rather than queueing unboundedly; see
+    docs/SERVING.md).  Callers should back off and resubmit, or raise
+    capacity (``serve_queue_cap``).
+
+    Attributes
+    ----------
+    queue_depth:
+        Requests queued at rejection time.
+    queue_cap:
+        The configured admission cap.
+    """
+
+    def __init__(self, message: str, queue_depth: int, queue_cap: int):
+        self.queue_depth = int(queue_depth)
+        self.queue_cap = int(queue_cap)
+        super().__init__(
+            "%s (queue depth %d at cap %d)"
+            % (message, self.queue_depth, self.queue_cap))
+
+
 class CommError(RaftError):
     """Communicator failure (analog of the reference's NCCL/UCX error
     surfacing: ``RAFT_NCCL_TRY`` / the ERROR arm of ``status_t``,

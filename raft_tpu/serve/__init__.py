@@ -1,0 +1,40 @@
+"""Serving layer: dynamic micro-batching query engine (docs/SERVING.md).
+
+The request path in front of the hot primitives: concurrent callers
+submit small query blocks; a per-service worker coalesces them into one
+padded device call per shape bucket, so
+
+- XLA compile-cache cardinality is bounded (and pre-warmed) by the
+  bucket ladder (:mod:`~raft_tpu.serve.bucketing`),
+- device efficiency comes from batch fill rather than per-call
+  dispatch (:mod:`~raft_tpu.serve.batcher`),
+- overload is shed at admission and deadlines expire in-queue
+  (:mod:`~raft_tpu.serve.scheduler`),
+- facades own warmup / drain / close lifecycle and the optional
+  query-vector cache (:mod:`~raft_tpu.serve.service`).
+
+Session integration: ``Comms.serve(...)`` constructs and registers a
+service; ``health_check()`` reports live services and ``destroy()``
+drains them before comms teardown.
+"""
+
+from raft_tpu.serve.batcher import MicroBatcher, ServeFuture  # noqa: F401
+from raft_tpu.serve.bucketing import (  # noqa: F401
+    BucketPolicy,
+    coalesce,
+    pad_rows,
+    resolve_rungs,
+    split_rows,
+)
+from raft_tpu.serve.scheduler import ServeWorker  # noqa: F401
+from raft_tpu.serve.service import (  # noqa: F401
+    KNNService,
+    PairwiseService,
+    Service,
+)
+
+__all__ = [
+    "BucketPolicy", "resolve_rungs", "pad_rows", "coalesce", "split_rows",
+    "MicroBatcher", "ServeFuture", "ServeWorker",
+    "Service", "KNNService", "PairwiseService",
+]

@@ -1,0 +1,246 @@
+"""Thread-safe micro-batching request queue.
+
+One :class:`MicroBatcher` sits between many submitter threads and one
+worker (:class:`raft_tpu.serve.scheduler.ServeWorker`).  Submitters
+enqueue :class:`_Request` objects and immediately get a
+:class:`ServeFuture`; the worker pulls *batches* formed under a simple
+coalescing policy:
+
+- dispatch as soon as ``max_batch_rows`` payload rows are queued, or
+- when the oldest queued request has waited ``max_wait_s`` (the
+  micro-batching window: latency ceiling a lone request pays to give
+  co-batched company a chance to arrive), or
+- immediately while draining (flush — nobody new is coming).
+
+Admission control happens at ``submit``: beyond ``queue_cap`` queued
+requests the submitter gets :class:`ServiceOverloadError` *now* instead
+of a silently unbounded queue (shed, don't buffer — the queue would
+otherwise absorb the whole overload as latency).
+
+The clock is injectable (``clock=time.monotonic`` by default — note the
+function object is the default, the library never calls a wall clock
+ad hoc): deterministic tests drive a fake clock and the non-blocking
+:meth:`MicroBatcher.take`; production workers block in
+:meth:`MicroBatcher.wait_for_batch`.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from raft_tpu.core.error import LogicError, ServiceOverloadError, expects
+
+__all__ = ["ServeFuture", "MicroBatcher"]
+
+
+class ServeFuture:
+    """Completion handle for one submitted request.
+
+    A minimal future (no cancellation, no callbacks): the worker thread
+    resolves it exactly once with a result or an exception; any number
+    of threads may :meth:`result` / :meth:`wait` on it.
+    """
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    # -- worker side --------------------------------------------------- #
+    def _set_result(self, value: Any) -> None:
+        self._result = value
+        self._event.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    # -- caller side --------------------------------------------------- #
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The request's result; raises the request's failure, or
+        :class:`TimeoutError` if it is not resolved within ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve future not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve future not resolved in time")
+        return self._error
+
+
+class _Request:
+    """One queued query block (rows of one submitter's array)."""
+
+    __slots__ = ("payload", "rows", "enqueue_t", "deadline_t", "future")
+
+    def __init__(self, payload, rows: int, enqueue_t: float,
+                 deadline_t: Optional[float]):
+        self.payload = payload
+        self.rows = rows
+        self.enqueue_t = enqueue_t
+        self.deadline_t = deadline_t
+        self.future = ServeFuture()
+
+
+class MicroBatcher:
+    """Coalescing request queue (see module doc for the policy).
+
+    Parameters
+    ----------
+    max_batch_rows:
+        Payload-row dispatch threshold AND per-request row cap (a
+        request must fit one batch whole — results split per request,
+        never mid-request).
+    max_wait_s:
+        Micro-batching window measured from the oldest queued request.
+    queue_cap:
+        Admission cap in *requests* (the reference point operators
+        reason about: one queue slot = one caller waiting).
+    clock:
+        Monotonic-seconds source; injectable for deterministic tests.
+    """
+
+    def __init__(self, max_batch_rows: int, max_wait_s: float,
+                 queue_cap: int,
+                 clock: Callable[[], float] = time.monotonic):
+        expects(max_batch_rows >= 1,
+                "MicroBatcher: max_batch_rows=%d", max_batch_rows)
+        expects(max_wait_s >= 0.0,
+                "MicroBatcher: max_wait_s=%r", max_wait_s)
+        expects(queue_cap >= 1, "MicroBatcher: queue_cap=%d", queue_cap)
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_s = float(max_wait_s)
+        self.queue_cap = int(queue_cap)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._q: "collections.deque[_Request]" = collections.deque()
+        self._rows_queued = 0
+        self._draining = False
+        self._stopped = False
+
+    # ------------------------------------------------------------------ #
+    # submitter side
+    # ------------------------------------------------------------------ #
+    def submit(self, payload, rows: int,
+               deadline_t: Optional[float] = None) -> ServeFuture:
+        """Enqueue one request; returns its future.
+
+        Raises :class:`ServiceOverloadError` at the admission cap and
+        :class:`LogicError` once draining/stopped (a closed service
+        must fail loudly, not buffer into a queue nobody serves).
+        """
+        expects(1 <= rows <= self.max_batch_rows,
+                "submit: %d rows outside [1, max_batch_rows=%d] — a "
+                "request must fit one batch whole", rows,
+                self.max_batch_rows)
+        req = _Request(payload, rows, self._clock(), deadline_t)
+        with self._cond:
+            if self._draining or self._stopped:
+                raise LogicError(
+                    "submit: service is draining/closed and no longer "
+                    "accepts requests")
+            if len(self._q) >= self.queue_cap:
+                raise ServiceOverloadError(
+                    "serve queue over admission cap; shed and retry "
+                    "with backoff", len(self._q), self.queue_cap)
+            self._q.append(req)
+            self._rows_queued += req.rows
+            self._cond.notify_all()
+        return req.future
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def rows_queued(self) -> int:
+        with self._cond:
+            return self._rows_queued
+
+    def empty(self) -> bool:
+        with self._cond:
+            return not self._q
+
+    # ------------------------------------------------------------------ #
+    # worker side
+    # ------------------------------------------------------------------ #
+    def _pop_batch_locked(self) -> List[_Request]:
+        batch: List[_Request] = []
+        rows = 0
+        while self._q and rows + self._q[0].rows <= self.max_batch_rows:
+            req = self._q.popleft()
+            self._rows_queued -= req.rows
+            rows += req.rows
+            batch.append(req)
+        return batch
+
+    def _ready_locked(self, now: float) -> bool:
+        if not self._q:
+            return False
+        if self._draining or self._stopped:
+            return True
+        if self._rows_queued >= self.max_batch_rows:
+            return True
+        return (now - self._q[0].enqueue_t) >= self.max_wait_s
+
+    def take(self) -> Optional[List[_Request]]:
+        """Non-blocking: a batch if the policy says dispatch now, else
+        None.  The deterministic-test entry point (fake clock + manual
+        worker stepping); also used by drain's inline fallback."""
+        with self._cond:
+            if not self._ready_locked(self._clock()):
+                return None
+            return self._pop_batch_locked()
+
+    def wait_for_batch(self) -> Optional[List[_Request]]:
+        """Blocking: the next batch, or None once stopped and empty
+        (the worker loop's exit signal)."""
+        with self._cond:
+            while True:
+                if self._ready_locked(self._clock()):
+                    return self._pop_batch_locked()
+                if self._stopped and not self._q:
+                    return None
+                if self._q:
+                    remaining = (self._q[0].enqueue_t + self.max_wait_s
+                                 - self._clock())
+                    self._cond.wait(timeout=max(1e-3, remaining))
+                else:
+                    self._cond.wait()
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def begin_drain(self) -> None:
+        """Stop admitting; flush queued requests immediately (no point
+        holding the micro-batch window open — nobody new is coming)."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def shutdown(self) -> List[_Request]:
+        """Stop the queue for good; returns any requests still queued
+        (a non-draining close must fail them, never strand their
+        futures).  After shutdown ``wait_for_batch`` returns None."""
+        with self._cond:
+            self._draining = True
+            self._stopped = True
+            leftovers = list(self._q)
+            self._q.clear()
+            self._rows_queued = 0
+            self._cond.notify_all()
+        return leftovers

@@ -1,0 +1,288 @@
+"""Serving worker: batch formation -> padded device call -> split.
+
+One :class:`ServeWorker` owns one daemon thread per service (THE
+allowlisted home for ``threading.Thread`` in ``raft_tpu/`` outside the
+comms watchdog — ``ci/style_check.py`` enforces that daemon-thread
+hygiene lives here).  The loop:
+
+1. pull a batch from the :class:`~raft_tpu.serve.batcher.MicroBatcher`;
+2. expire requests whose deadline passed while queued — their futures
+   fail with :class:`~raft_tpu.core.error.CommTimeoutError` (PR 1's
+   deadline taxonomy: a deadline is a deadline, whether a comms verb or
+   a queue slot blew it) *before* any device work is spent on them;
+3. coalesce the survivors' rows, pad to the
+   :class:`~raft_tpu.serve.bucketing.BucketPolicy` rung, run the
+   service's device function — optionally under a
+   :class:`~raft_tpu.comms.resilience.RetryPolicy` (per-batch watchdog
+   + retry; the device fn is pure, so a retry is idempotent);
+4. split result rows back per request and resolve the futures.  A batch
+   failure fails every rider's future — riders resubmit independently.
+
+Every step feeds the ``raft_tpu_serve_*`` metric families (labeled
+``service=<name>``) so ``metrics_snapshot()`` / ``tools/metrics_report.py``
+surface queue depth, batch fill, wait/exec latency, padding waste and
+per-bucket traffic without any serve-specific plumbing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+
+from raft_tpu.core import metrics as _metrics
+from raft_tpu.core.error import CommTimeoutError, expects
+from raft_tpu.serve.batcher import MicroBatcher, _Request
+from raft_tpu.serve.bucketing import BucketPolicy, coalesce, pad_rows
+
+__all__ = ["ServeWorker"]
+
+
+# -- registry helpers (resolved per use: cheap, and reset-proof — a test
+# that resets the registry mid-life gets fresh families, not writes into
+# orphans) ------------------------------------------------------------- #
+def _counter(name: str, help: str, service: str):
+    return _metrics.default_registry().counter(
+        name, help=help, labels=("service",)).labels(service=service)
+
+
+def _gauge(name: str, help: str, service: str):
+    return _metrics.default_registry().gauge(
+        name, help=help, labels=("service",)).labels(service=service)
+
+
+def _timer(name: str, help: str, service: str):
+    return _metrics.default_registry().timer(
+        name, help=help, labels=("service",)).labels(service=service)
+
+
+def _bucket_counter(service: str, bucket: int):
+    return _metrics.default_registry().counter(
+        "raft_tpu_serve_bucket_calls_total",
+        help="padded device calls per shape bucket",
+        labels=("service", "bucket")).labels(service=service,
+                                             bucket=bucket)
+
+
+class ServeWorker:
+    """Single-consumer dispatch loop over a :class:`MicroBatcher`.
+
+    Parameters
+    ----------
+    name:
+        Service name (the ``service=`` metric label).
+    batcher / policy:
+        The request queue and the shape-bucket ladder.
+    execute:
+        ``execute(padded_batch) -> pytree of arrays`` whose every leaf
+        has the padded batch's rows as its leading dimension (the
+        contract that makes per-request splitting mechanical).
+    retry_policy:
+        Optional :class:`~raft_tpu.comms.resilience.RetryPolicy` around
+        each device call — per-attempt watchdog deadline + backoff
+        retries, exactly PR 1's verb machinery.
+    clock:
+        Shared with the batcher for deadline math.
+    """
+
+    def __init__(self, name: str, batcher: MicroBatcher,
+                 policy: BucketPolicy,
+                 execute: Callable,
+                 retry_policy=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._batcher = batcher
+        self._policy = policy
+        self._execute = execute
+        self._retry_policy = retry_policy
+        self._clock = clock
+        self._thread: Optional[threading.Thread] = None
+        self._state = threading.Condition()
+        self._busy = False
+        self._closed = False
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def start(self) -> "ServeWorker":
+        """Spawn the daemon worker thread (idempotent)."""
+        with self._state:
+            expects(not self._closed, "ServeWorker %s is closed", self.name)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="raft-tpu-serve-%s" % self.name)
+                self._thread.start()
+        return self
+
+    def is_alive(self) -> bool:
+        with self._state:
+            return self._thread is not None and self._thread.is_alive()
+
+    def started(self) -> bool:
+        with self._state:
+            return self._thread is not None
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._batcher.wait_for_batch()
+            if batch is None:
+                return
+            with self._state:
+                self._busy = True
+            try:
+                self.dispatch(batch)
+            finally:
+                with self._state:
+                    self._busy = False
+                    self._state.notify_all()
+
+    def run_once(self) -> bool:
+        """Manual stepping for threadless/deterministic operation: form
+        and dispatch one batch if the policy allows; True if one ran."""
+        batch = self._batcher.take()
+        if not batch:
+            return False
+        self.dispatch(batch)
+        return True
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission and serve out everything queued/in flight.
+
+        With a live worker thread this blocks (up to ``timeout``) until
+        the queue is empty and the worker idle; threadless services are
+        drained inline on the calling thread.  Returns True when fully
+        drained.
+        """
+        self._batcher.begin_drain()
+        if not self.started():
+            while self.run_once():
+                pass
+            return self._batcher.empty()
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._state:
+            while not (self._batcher.empty() and not self._busy):
+                if not (self._thread and self._thread.is_alive()):
+                    break  # dead worker: inline fallback below
+                if deadline is not None and self._clock() >= deadline:
+                    return False
+                self._state.wait(timeout=0.05)
+        # a crashed worker thread must not strand queued requests
+        while self.run_once():
+            pass
+        return self._batcher.empty()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Drain (by default), stop the queue, fail any leftovers, and
+        join the worker thread.  Idempotent."""
+        with self._state:
+            if self._closed:
+                return
+            self._closed = True
+        if drain:
+            self.drain(timeout=timeout)
+        leftovers = self._batcher.shutdown()
+        for req in leftovers:
+            req.future._set_exception(CommTimeoutError(
+                "service %s closed before the request was served"
+                % self.name))
+        if leftovers:
+            _counter("raft_tpu_serve_expired_total",
+                     "requests failed by deadline or close",
+                     self.name).inc(len(leftovers))
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    # ------------------------------------------------------------------ #
+    # dispatch
+    # ------------------------------------------------------------------ #
+    def _expire_locked_out(self, batch: List[_Request],
+                           now: float) -> List[_Request]:
+        live: List[_Request] = []
+        expired = 0
+        for req in batch:
+            if req.deadline_t is not None and now >= req.deadline_t:
+                expired += 1
+                req.future._set_exception(CommTimeoutError(
+                    "request exceeded its deadline after %.3fs in the "
+                    "%s queue" % (now - req.enqueue_t, self.name)))
+            else:
+                live.append(req)
+        if expired:
+            _counter("raft_tpu_serve_expired_total",
+                     "requests failed by deadline or close",
+                     self.name).inc(expired)
+        return live
+
+    def dispatch(self, batch: Sequence[_Request]) -> None:
+        """Run one formed batch to completion (never raises: every
+        failure lands on the riders' futures — a poisoned batch must
+        not kill the loop serving everyone else)."""
+        now = self._clock()
+        _gauge("raft_tpu_serve_queue_depth", "requests queued",
+               self.name).set(self._batcher.depth())
+        live = self._expire_locked_out(list(batch), now)
+        if not live:
+            return
+        wait_t = _timer("raft_tpu_serve_wait_seconds",
+                        "enqueue-to-dispatch queue wait", self.name)
+        for req in live:
+            wait_t.observe(max(0.0, now - req.enqueue_t))
+        payload_rows = sum(r.rows for r in live)
+        bucket = 0
+        try:
+            bucket = self._policy.bucket_for(payload_rows)
+            stacked, spans = coalesce([r.payload for r in live])
+            padded = pad_rows(stacked, bucket)
+            _gauge("raft_tpu_serve_inflight_rows",
+                   "payload rows in the running device call",
+                   self.name).set(payload_rows)
+            exec_t = _timer("raft_tpu_serve_exec_seconds",
+                            "padded device call latency", self.name)
+            if self._retry_policy is not None:
+                with exec_t.time():
+                    out = self._retry_policy.call(
+                        self._execute, padded,
+                        verb="serve.%s" % self.name)
+            else:
+                with exec_t.time():
+                    out = self._execute(padded)
+            leaves = [x for x in jax.tree_util.tree_leaves(out)
+                      if hasattr(x, "shape")]
+            for leaf in leaves:
+                expects(leaf.shape[0] == bucket,
+                        "serve execute contract: leaf leading dim %d != "
+                        "padded batch rows %d", leaf.shape[0], bucket)
+            for req, (start, stop) in zip(live, spans):
+                req.future._set_result(jax.tree_util.tree_map(
+                    lambda leaf: leaf[start:stop], out))
+        except Exception as e:  # noqa: BLE001 — relayed to every rider
+            _counter("raft_tpu_serve_batch_errors_total",
+                     "batches whose device call failed", self.name).inc()
+            for req in live:
+                req.future._set_exception(e)
+            return
+        finally:
+            _gauge("raft_tpu_serve_inflight_rows",
+                   "payload rows in the running device call",
+                   self.name).set(0)
+        # accounting only after a successful dispatch
+        _counter("raft_tpu_serve_batches_total", "dispatched batches",
+                 self.name).inc()
+        _counter("raft_tpu_serve_requests_total", "served requests",
+                 self.name).inc(len(live))
+        _counter("raft_tpu_serve_payload_rows_total",
+                 "real (caller) rows dispatched", self.name).inc(
+                     payload_rows)
+        _counter("raft_tpu_serve_padded_rows_total",
+                 "zero-pad rows dispatched (waste)", self.name).inc(
+                     bucket - payload_rows)
+        _timer("raft_tpu_serve_batch_rows",
+               "payload rows per batch (a row-count histogram riding "
+               "the timer type; seconds formatting does not apply)",
+               self.name).observe(float(payload_rows))
+        _bucket_counter(self.name, bucket).inc()

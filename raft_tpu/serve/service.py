@@ -1,0 +1,380 @@
+"""Service facades: warmup / submit / drain / close over hot primitives.
+
+A service pins the heavy, shape-stable half of a query workload at
+construction (the kNN index partition, the pairwise reference matrix,
+k, the metric) and serves the light, shape-varying half (query rows)
+through the micro-batching engine:
+
+- :class:`KNNService`  — ``submit((n_i, d) queries) -> (dists, ids)``
+  over :func:`raft_tpu.spatial.brute_force_knn`;
+- :class:`PairwiseService` — ``submit((n_i, d) x) -> (n_i, n_y)`` over
+  :func:`raft_tpu.distance.pairwise_distance`.
+
+Both call their device function only at bucket shapes, so the heavy
+programs' executable-cache cardinality is exactly the rung count,
+:meth:`Service.warmup` precompiles every rung through the existing
+:func:`~raft_tpu.core.profiler.profiled_jit` lowering path before
+traffic arrives, and ``compile_cache_stats()`` proves (the serving SLO
+statement) that steady state performs **zero** compiles.  Where the
+jit boundary sits differs deliberately:
+
+- kNN calls :func:`brute_force_knn` *eagerly* per batch; its scan
+  (``tiled_knn``, already ``profiled_jit``) is the cached program.  An
+  outer jit would fuse across the eager call's inner-jit boundaries
+  and change low-bit float results — measured 1e-6 drift — breaking
+  the bit-identical-to-unbatched contract this layer promises.
+- pairwise has no inner jit (it is eager jnp ops), so the service
+  wraps the whole call in ``profiled_jit`` (``serve_pairwise``) to get
+  one AOT-compiled program per bucket; identity holds vs the same
+  jitted program, low bits may differ vs the eager call.
+
+(Glue ops around the cached program — concatenate/pad at arrival-
+pattern-dependent shapes — compile tiny copy programs in JAX's own
+cache; the bucket ladder bounds the *expensive* programs.)
+
+Optional per-service query-vector cache: an LRU
+:class:`~raft_tpu.cache.VecCache` keyed by caller ids
+(``query_cache_size > 0``) lets repeat queries be submitted *by key*
+(:meth:`Service.submit_keys`) without re-shipping the vector; hit/miss
+counters land in the registry.
+
+Results are bit-identical to the unbatched primitive: pad rows are
+zeros, every fronted primitive is row-independent, and the per-request
+slices are exact.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import config
+from raft_tpu.cache import VecCache
+from raft_tpu.core.error import LogicError, ServiceOverloadError, expects
+from raft_tpu.core.profiler import profiled_jit
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.serve.batcher import MicroBatcher, ServeFuture
+from raft_tpu.serve.bucketing import BucketPolicy, resolve_rungs
+from raft_tpu.serve.scheduler import ServeWorker, _counter, _gauge
+from raft_tpu.spatial.knn import brute_force_knn
+
+__all__ = ["Service", "KNNService", "PairwiseService"]
+
+_service_seq = itertools.count()
+
+
+def _knob_float(name: str) -> float:
+    raw = config.get(name)
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise ValueError("raft_tpu.config: %s=%r is not a number"
+                         % (name, raw)) from None
+
+
+def _knob_int(name: str) -> int:
+    raw = config.get(name)
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise ValueError("raft_tpu.config: %s=%r is not an integer"
+                         % (name, raw)) from None
+
+
+# -- device functions -------------------------------------------------- #
+# module-level + profiled_jit: one executable cache per (fn, shapes,
+# statics) across ALL services, with per-bucket hit/miss/compile-seconds
+# visible through compile_cache_stats() under this name.  (The kNN
+# service deliberately has no such wrapper — see the module doc — its
+# cached program is tiled_knn's existing profiled_jit.)
+@profiled_jit(name="serve_pairwise", static_argnames=("metric",))
+def _pairwise_device(y, queries, metric):
+    return pairwise_distance(queries, y, metric)
+
+
+class Service:
+    """Micro-batching façade over one device function.
+
+    Parameters
+    ----------
+    execute:
+        ``execute(padded_queries) -> pytree`` with batch-rows-leading
+        leaves (subclasses bind the pinned operands).
+    dim / dtype:
+        Query row shape contract; enforced at ``submit``.
+    max_batch_rows:
+        Top bucket rung = device-call row cap = per-request row cap.
+    bucket_rungs / max_wait_ms / queue_cap:
+        Shape ladder, micro-batch window, admission cap; each defaults
+        to its ``serve_*`` knob in :mod:`raft_tpu.config`.
+    retry_policy:
+        Optional per-batch :class:`~raft_tpu.comms.resilience.RetryPolicy`
+        (watchdog deadline + retries around the device call).
+    query_cache_size:
+        > 0 enables the :class:`VecCache` query-vector cache
+        (:meth:`cache_put` / :meth:`submit_keys`).
+    start:
+        Spawn the worker thread now (False = threadless: tests drive
+        :attr:`worker` ``.run_once()`` under an injected ``clock``).
+    """
+
+    def __init__(self, name: str, execute: Callable, dim: int,
+                 dtype=jnp.float32, *,
+                 max_batch_rows: int = 1024,
+                 bucket_rungs=None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 retry_policy=None,
+                 query_cache_size: int = 0,
+                 start: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
+        expects(dim >= 1, "Service: dim=%d", dim)
+        self.name = name
+        self.dim = int(dim)
+        self.dtype = jnp.dtype(dtype)
+        self._execute = execute
+        self._clock = clock
+        if bucket_rungs is None:
+            bucket_rungs = config.get("serve_bucket_rungs")
+        if max_wait_ms is None:
+            max_wait_ms = _knob_float("serve_max_wait_ms")
+        if queue_cap is None:
+            queue_cap = _knob_int("serve_queue_cap")
+        self.policy = BucketPolicy(
+            resolve_rungs(bucket_rungs, int(max_batch_rows)))
+        self.batcher = MicroBatcher(
+            max_batch_rows=self.policy.max_rows,
+            max_wait_s=float(max_wait_ms) / 1e3,
+            queue_cap=int(queue_cap), clock=clock)
+        self.worker = ServeWorker(name, self.batcher, self.policy,
+                                  execute, retry_policy=retry_policy,
+                                  clock=clock)
+        self._warmed: Tuple[int, ...] = ()
+        self._closed = False
+        self._cache_lock = threading.Lock()
+        self._cache: Optional[VecCache] = None
+        self._cache_state = None
+        if query_cache_size > 0:
+            self._cache = VecCache(self.dim, int(query_cache_size),
+                                   dtype=self.dtype)
+            self._cache_state = self._cache.init()
+        if start:
+            self.worker.start()
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def warmup(self) -> "Service":
+        """AOT-precompile every bucket rung through the device function
+        (zeros payloads; results discarded after ``block_until_ready``).
+        After warmup, steady-state traffic at any admissible shape runs
+        entirely on cache hits — assert it via ``compile_cache_stats()``.
+        """
+        for rung in self.policy.rungs:
+            out = self._execute(jnp.zeros((rung, self.dim), self.dtype))
+            jax.block_until_ready(out)
+        self._warmed = self.policy.rungs
+        return self
+
+    @property
+    def warmed_rungs(self) -> Tuple[int, ...]:
+        return self._warmed
+
+    def is_open(self) -> bool:
+        return not self._closed
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission, serve out the queue; True when empty."""
+        return self.worker.drain(timeout=timeout)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Drain (by default) and stop the worker.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.worker.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "Service":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    # submission
+    # ------------------------------------------------------------------ #
+    def _check_payload(self, queries) -> jnp.ndarray:
+        q = jnp.asarray(queries)
+        if q.ndim == 1:
+            q = q[None, :]
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "%s.submit: expected (rows, %d) queries, got %r",
+                self.name, self.dim, tuple(q.shape))
+        return q.astype(self.dtype)
+
+    def submit(self, queries, timeout: Optional[float] = None
+               ) -> ServeFuture:
+        """Enqueue a query block; returns a future resolving to this
+        service's result slice for exactly those rows.
+
+        ``timeout`` is the request's end-to-end deadline in seconds: if
+        it expires while the request is still queued, the future fails
+        with :class:`~raft_tpu.core.error.CommTimeoutError` instead of
+        occupying a batch (deadline-aware shedding).
+        """
+        expects(not self._closed, "%s.submit: service is closed",
+                self.name)
+        q = self._check_payload(queries)
+        deadline_t = None if timeout is None else self._clock() + timeout
+        try:
+            fut = self.batcher.submit(q, int(q.shape[0]), deadline_t)
+        except ServiceOverloadError:
+            _counter("raft_tpu_serve_rejected_total",
+                     "requests shed by admission control",
+                     self.name).inc()
+            raise
+        _counter("raft_tpu_serve_submitted_total",
+                 "admitted requests", self.name).inc()
+        _gauge("raft_tpu_serve_queue_depth", "requests queued",
+               self.name).set(self.batcher.depth())
+        return fut
+
+    def submit_many(self, blocks: Sequence,
+                    timeout: Optional[float] = None) -> List[ServeFuture]:
+        """Submit several query blocks; one future each, same deadline."""
+        return [self.submit(b, timeout=timeout) for b in blocks]
+
+    # ------------------------------------------------------------------ #
+    # query-vector cache (the dormant cache/VecCache, wired in)
+    # ------------------------------------------------------------------ #
+    def _require_cache(self) -> VecCache:
+        expects(self._cache is not None,
+                "%s: no query cache (construct with query_cache_size>0)",
+                self.name)
+        return self._cache
+
+    def cache_put(self, keys, vectors) -> None:
+        """Store query vectors under caller ids for later
+        :meth:`submit_keys` (functional :class:`VecCache` state swapped
+        under a lock — concurrent submitters stay consistent)."""
+        cache = self._require_cache()
+        k = jnp.asarray(keys, jnp.int32).ravel()
+        v = self._check_payload(vectors)
+        expects(k.shape[0] == v.shape[0],
+                "%s.cache_put: %d keys for %d vectors", self.name,
+                k.shape[0], v.shape[0])
+        expects(k.shape[0] == 0 or bool((k >= 0).all()),
+                "%s.cache_put: negative keys (the cache reserves -1 "
+                "for empty ways)", self.name)
+        with self._cache_lock:
+            self._cache_state = cache.store_vecs(self._cache_state, k, v)
+
+    def cache_lookup(self, keys) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Fetch cached vectors for ``keys``; returns ``(vectors,
+        found)`` and feeds the hit/miss counters."""
+        cache = self._require_cache()
+        k = jnp.asarray(keys, jnp.int32).ravel()
+        with self._cache_lock:
+            vecs, found, self._cache_state = cache.get_vecs(
+                self._cache_state, k)
+        hits = int(found.sum())
+        if hits:
+            _counter("raft_tpu_serve_query_cache_hits_total",
+                     "query-vector cache hits", self.name).inc(hits)
+        if hits < k.shape[0]:
+            _counter("raft_tpu_serve_query_cache_misses_total",
+                     "query-vector cache misses", self.name).inc(
+                         k.shape[0] - hits)
+        return vecs, found
+
+    def submit_keys(self, keys, timeout: Optional[float] = None
+                    ) -> ServeFuture:
+        """Submit queries *by cached id* — the repeat-query fast path
+        (e.g. a stored user embedding queried on every page view).
+        Every key must be cached; missing keys raise
+        :class:`LogicError` naming them."""
+        k = jnp.asarray(keys, jnp.int32).ravel()
+        vecs, found = self.cache_lookup(k)
+        if not bool(found.all()):
+            missing = [int(x) for x in k[~found]][:16]
+            raise LogicError(
+                "%s.submit_keys: keys not in the query cache: %r%s"
+                % (self.name, missing,
+                   "..." if (~found).sum() > 16 else ""))
+        return self.submit(vecs, timeout=timeout)
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        """Small live-state dict (health_check embeds it)."""
+        return {
+            "open": self.is_open(),
+            "worker_started": self.worker.started(),
+            "worker_alive": self.worker.is_alive(),
+            "queue_depth": self.batcher.depth(),
+            "rows_queued": self.batcher.rows_queued(),
+            "rungs": list(self.policy.rungs),
+            "warmed": bool(self._warmed),
+        }
+
+
+class KNNService(Service):
+    """Micro-batched :func:`brute_force_knn` over one pinned index
+    partition.
+
+    ``submit((n_i, d))`` futures resolve to ``(distances, indices)`` of
+    shape ``(n_i, k)`` — bit-identical to the unbatched
+    ``brute_force_knn(index, queries, k)`` call (pad rows are zeros and
+    every row's result depends only on its own query row).
+    """
+
+    def __init__(self, index, k: int,
+                 metric: DistanceType = DistanceType.L2Expanded,
+                 tile_n: int = 8192, precision: str = "highest",
+                 name: Optional[str] = None, **opts):
+        index = jnp.asarray(index)
+        expects(index.ndim == 2, "KNNService: (n, d) index required")
+        expects(1 <= k <= index.shape[0],
+                "KNNService: k=%d out of range for n_index=%d",
+                k, index.shape[0])
+        self.index = index
+        self.k = int(k)
+        self.metric = metric
+
+        def execute(padded):
+            # eager on purpose: bit-identical to the unbatched call
+            # (module doc); the scan inside is the per-bucket cached
+            # program
+            return brute_force_knn(self.index, padded, self.k,
+                                   metric=self.metric, tile_n=tile_n,
+                                   precision=precision)
+
+        super().__init__(
+            name or "knn%d" % next(_service_seq), execute,
+            dim=index.shape[1], dtype=index.dtype, **opts)
+
+
+class PairwiseService(Service):
+    """Micro-batched :func:`pairwise_distance` against one pinned
+    reference matrix; futures resolve to the ``(n_i, n_y)`` block."""
+
+    def __init__(self, y,
+                 metric: DistanceType = DistanceType.L2Expanded,
+                 name: Optional[str] = None, **opts):
+        y = jnp.asarray(y)
+        expects(y.ndim == 2, "PairwiseService: (n, d) reference required")
+        self.y = y
+        self.metric = metric
+
+        def execute(padded):
+            return _pairwise_device(self.y, padded, metric=self.metric)
+
+        super().__init__(
+            name or "pairwise%d" % next(_service_seq), execute,
+            dim=y.shape[1], dtype=y.dtype, **opts)

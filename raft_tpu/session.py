@@ -37,7 +37,7 @@ from raft_tpu.comms.resilience import RetryPolicy
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core import profiler as _profiler
 from raft_tpu.core import tracing
-from raft_tpu.core.error import CommError, expects
+from raft_tpu.core.error import CommError, expects, fail
 from raft_tpu.core.handle import Handle
 
 # module-level session registry (the reference keeps worker-local state
@@ -137,6 +137,7 @@ class Comms:
         self.comms: Optional[HostComms] = None
         self.handle: Optional[Handle] = None
         self._handles: List[Handle] = []
+        self._services: Dict[str, object] = {}
         self._owns_distributed = False
 
     # -- lifecycle (reference init/destroy, comms.py:171,228) ---------- #
@@ -226,6 +227,12 @@ class Comms:
         """Tear down and deregister (reference destroy, comms.py:228 —
         which shuts down NCCL/UCX; here the coordination service).
 
+        Serve workers registered via :meth:`serve` are drained and
+        closed FIRST: an in-flight micro-batch still running on the
+        worker thread must complete (or fail onto its futures) before
+        the communicator/handles it may reference are torn down —
+        otherwise the batch races a destroyed handle.
+
         Idempotent: a second ``destroy`` (or one on a never-initialized
         session) is a no-op.  The ``_sessions`` registry entry is removed
         in a ``finally`` so a teardown failure can never leave a dead
@@ -242,13 +249,28 @@ class Comms:
                 _sessions.pop(self.sessionId, None)
             return
         try:
+            self._close_services()
             self._teardown()
         finally:
             self.comms = None
             self.handle = None
             self._handles = []
+            self._services = {}
             self.initialized = False
             _sessions.pop(self.sessionId, None)
+
+    def _close_services(self) -> None:
+        """Drain-then-close every registered serve worker (destroy
+        ordering contract above).  The drain is bounded: a device call
+        wedged inside XLA must not hang ``destroy`` forever — after the
+        timeout, ``close`` fails the leftovers onto their futures and
+        teardown proceeds.  A service whose close raises must not block
+        the teardown of the rest."""
+        for svc in list(self._services.values()):
+            try:
+                svc.close(drain=True, timeout=10.0)
+            except Exception:
+                pass
 
     def _teardown(self) -> None:
         """Release cluster-level resources (separate from bookkeeping so
@@ -273,6 +295,13 @@ class Comms:
         still report which devices *could* carry a rebuilt communicator —
         the input :meth:`recover` needs.
 
+        When serve workers are registered (:meth:`serve`), the verdict
+        additionally carries ``"services"``: each live service's
+        ``stats()`` dict; a service that is open but whose worker
+        thread has died fails the overall ``ok`` (it is silently
+        dropping every queued request), while an intentionally closed
+        service is reported but does not fail health.
+
         Cost note: the battery is not free — ``test_commsplit`` builds
         throwaway sub-communicators whose programs recompile on every
         probe.  For a recurring high-frequency probe, call a cheap
@@ -286,7 +315,18 @@ class Comms:
             devices = {int(d.id): _probe_device(d)
                        for d in self.comms.mesh.devices.ravel()}
         ok = all(tests.values()) and all(devices.values())
-        return {"ok": ok, "tests": tests, "devices": devices}
+        out = {"ok": ok, "tests": tests, "devices": devices}
+        if self._services:
+            services = {name: svc.stats()
+                        for name, svc in self._services.items()}
+            out["services"] = services
+            # fail health only for a service that SHOULD be serving: a
+            # started worker that died while the service is still open
+            # (threadless test-mode services and closed services pass)
+            out["ok"] = ok and all(
+                s["worker_alive"] or not s["worker_started"]
+                or not s["open"] for s in services.values())
+        return out
 
     def recover(self, devices: Optional[Sequence] = None,
                 mesh=None) -> HostComms:
@@ -348,6 +388,52 @@ class Comms:
             print(f"Recovered comms session {self.sessionId} on "
                   f"{len(devices)} surviving devices")
         return self.comms
+
+    # -- serving (docs/SERVING.md) ------------------------------------- #
+    def serve(self, kind: str = "knn", *, name: Optional[str] = None,
+              **kwargs):
+        """Construct and register a micro-batching service on this
+        session (:mod:`raft_tpu.serve`).
+
+        ``kind``: ``"knn"`` (:class:`~raft_tpu.serve.KNNService`;
+        kwargs: ``index``, ``k``, ``metric``, ...) or ``"pairwise"``
+        (:class:`~raft_tpu.serve.PairwiseService`; kwargs: ``y``,
+        ``metric``, ...), plus the shared service options
+        (``max_batch_rows``, ``bucket_rungs``, ``max_wait_ms``,
+        ``queue_cap``, ``retry_policy``, ``query_cache_size``).  The
+        session defaults ``retry_policy`` to its own verb policy so
+        per-batch watchdog/retry semantics match the communicator's.
+
+        Registration is what buys the lifecycle guarantees:
+        :meth:`health_check` reports the service and :meth:`destroy`
+        drains it before comms teardown.  The returned service is
+        started; call ``warmup()`` before taking traffic to
+        precompile every shape bucket.
+        """
+        expects(self.initialized, "serve: session not initialized")
+        from raft_tpu.serve import KNNService, PairwiseService
+
+        kinds = {"knn": KNNService, "pairwise": PairwiseService}
+        expects(kind in kinds, "serve: unknown service kind %r "
+                "(have: %s)", kind, ", ".join(sorted(kinds)))
+        expects(name is None or name not in self._services,
+                "serve: a service named %r is already registered", name)
+        kwargs.setdefault("retry_policy", self.retry_policy)
+        svc = kinds[kind](name=name, **kwargs)
+        if svc.name in self._services:
+            # auto-generated name collided: stop the just-started
+            # worker before raising or it leaks, unregistered and
+            # undrainable
+            svc.close(drain=False)
+            fail("serve: a service named %r is already registered",
+                 svc.name)
+        self._services[svc.name] = svc
+        return svc
+
+    @property
+    def services(self) -> Dict[str, object]:
+        """Registered serve services by name (read-only view)."""
+        return dict(self._services)
 
     # -- observability (docs/OBSERVABILITY.md) ------------------------- #
     def metrics_snapshot(self) -> Dict:

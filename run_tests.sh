@@ -21,4 +21,10 @@ if [[ "${1:-}" == "--metrics" ]]; then
     shift
     exec python -m pytest tests/test_metrics_profiler.py -q "$@"
 fi
+# --serve: only the serving-layer suite (also part of the default
+# invocation; see stress.sh serve for the concurrency-shaking loop)
+if [[ "${1:-}" == "--serve" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m serve "$@"
+fi
 exec python -m pytest tests/ -q "$@"

@@ -9,6 +9,13 @@
 # shaking nondeterminism out of the retry/abort/recovery paths — the
 # injection harness is fully seeded, so any failure reproduces with the
 # printed seed.
+#
+# `./stress.sh serve [N]` loops the serving-layer suite N times
+# (default 10) with a rotating data/submit-order seed
+# (RAFT_TPU_SERVE_SEED) — the concurrent-submitter tests are the only
+# genuinely nondeterministic scheduling in the library, so the loop is
+# what shakes out batching/drain races; a failure reproduces with the
+# printed seed.
 set -euo pipefail
 cd "$(dirname "$0")"
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
@@ -18,6 +25,14 @@ if [[ "${1:-}" == "faults" ]]; then
     for i in $(seq 1 "$n"); do
         echo "== faults stress $i/$n (RAFT_TPU_FAULT_SEED=$i) =="
         RAFT_TPU_FAULT_SEED="$i" python -m pytest tests/ -q -m faults
+    done
+    exit 0
+fi
+if [[ "${1:-}" == "serve" ]]; then
+    n="${2:-10}"
+    for i in $(seq 1 "$n"); do
+        echo "== serve stress $i/$n (RAFT_TPU_SERVE_SEED=$i) =="
+        RAFT_TPU_SERVE_SEED="$i" python -m pytest tests/ -q -m serve
     done
     exit 0
 fi

@@ -8,7 +8,10 @@ GetPjrtApi, version negotiation, and error plumbing.  Client creation
 accelerator in CI.
 """
 
+import json
 import os
+import subprocess
+import sys
 
 import pytest
 
@@ -17,6 +20,8 @@ from raft_tpu.core.pjrt import (
     pjrt_native_available,
     probe_api_version,
 )
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -48,7 +53,30 @@ def test_probe_real_plugin_reports_api_version():
     path = default_plugin_path()
     if path is None or not os.path.exists(path):
         pytest.skip("no PJRT plugin installed")
-    info = probe_api_version(path)
+    # Probe in a killable child: a REAL plugin's Plugin_Initialize can
+    # hang inside vendor init on a host with no matching accelerator
+    # (observed: libtpu.so blocking forever — holding
+    # /tmp/libtpu_lockfile — in a TPU-less container), and a native
+    # call can't be interrupted in-process.  A hang must skip this
+    # test, not stall the whole suite until the CI timeout.
+    code = ("import json\n"
+            "from raft_tpu.core.pjrt import probe_api_version\n"
+            "print('PROBE ' + json.dumps(probe_api_version(%r)))\n"
+            % path)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=60, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        pytest.skip("PJRT plugin probe hung in vendor init "
+                    "(no matching accelerator attached?)")
+    if proc.returncode != 0:
+        # same failure semantics as the in-process call
+        raise RuntimeError(
+            "probe failed: %s" % proc.stderr.strip()[-500:])
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("PROBE ")][-1]
+    info = json.loads(line[len("PROBE "):])
     major, minor = info["api_version"]
     assert major == 0 and minor >= 40, info
 

@@ -1,0 +1,687 @@
+"""Serving layer (raft_tpu.serve): bucketing math, micro-batching,
+admission control, deadlines, warmup/compile-cache, drain/close
+lifecycle, VecCache wiring, session integration.
+
+Deterministic halves run a FakeClock through the injectable-clock seam
+and step the worker manually (no threads); the concurrency halves use
+real worker threads with tiny batching windows.  ``./stress.sh serve N``
+loops this file with a rotating RAFT_TPU_SERVE_SEED to shake scheduling
+nondeterminism out of the threaded tests.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import config
+from raft_tpu.core.error import (
+    CommTimeoutError,
+    LogicError,
+    ServiceOverloadError,
+)
+from raft_tpu.core.metrics import default_registry
+from raft_tpu.core.profiler import (
+    compile_cache_stats,
+    reset_compile_cache_stats,
+)
+from raft_tpu.comms.resilience import RetryPolicy
+from raft_tpu.serve import (
+    BucketPolicy,
+    KNNService,
+    MicroBatcher,
+    PairwiseService,
+    Service,
+    coalesce,
+    pad_rows,
+    resolve_rungs,
+    split_rows,
+)
+from raft_tpu.spatial.knn import brute_force_knn
+
+pytestmark = pytest.mark.serve
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def index(rng):
+    return jnp.asarray(rng.standard_normal((300, 16)), jnp.float32)
+
+
+def _total_misses():
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
+
+
+# ---------------------------------------------------------------------- #
+# bucketing
+# ---------------------------------------------------------------------- #
+class TestBucketing:
+    def test_pow2_rungs_end_at_max(self):
+        assert resolve_rungs("pow2", 64) == (8, 16, 32, 64)
+        assert resolve_rungs(None, 100) == (8, 16, 32, 64, 100)
+        assert resolve_rungs("pow2", 4) == (4,)
+
+    def test_explicit_rungs_sorted_dedup_and_capped(self):
+        assert resolve_rungs("16,4,16", 32) == (4, 16, 32)
+        assert resolve_rungs([32, 8], 32) == (8, 32)
+        with pytest.raises(LogicError):
+            resolve_rungs([64], 32)
+        with pytest.raises(LogicError):
+            resolve_rungs([0, 8], 32)
+        with pytest.raises(ValueError):
+            resolve_rungs("8,banana", 32)
+
+    def test_bucket_for_boundaries(self):
+        p = BucketPolicy((8, 16, 64))
+        assert p.bucket_for(1) == 8
+        assert p.bucket_for(8) == 8
+        assert p.bucket_for(9) == 16
+        assert p.bucket_for(17) == 64
+        assert p.bucket_for(64) == 64
+        assert p.padding_waste(9) == 7
+        with pytest.raises(LogicError):
+            p.bucket_for(65)
+        with pytest.raises(LogicError):
+            p.bucket_for(0)
+
+    def test_policy_rejects_bad_ladders(self):
+        with pytest.raises(LogicError):
+            BucketPolicy((8, 8))
+        with pytest.raises(LogicError):
+            BucketPolicy(())
+
+    def test_pad_rows(self):
+        a = jnp.ones((3, 4))
+        p = pad_rows(a, 8)
+        assert p.shape == (8, 4)
+        assert bool((np.asarray(p[3:]) == 0).all())
+        assert pad_rows(a, 3) is a
+        with pytest.raises(LogicError):
+            pad_rows(a, 2)
+
+    def test_coalesce_split_roundtrip(self, rng):
+        blocks = [jnp.asarray(rng.standard_normal((r, 5)), jnp.float32)
+                  for r in (3, 1, 7)]
+        batch, spans = coalesce(blocks)
+        assert batch.shape == (11, 5)
+        assert spans == [(0, 3), (3, 4), (4, 11)]
+        back = split_rows(batch, spans)
+        for orig, rec in zip(blocks, back):
+            assert bool((np.asarray(orig) == np.asarray(rec)).all())
+
+
+# ---------------------------------------------------------------------- #
+# batcher (deterministic: FakeClock, no threads)
+# ---------------------------------------------------------------------- #
+class TestMicroBatcher:
+    def make(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_batch_rows", 16)
+        kw.setdefault("max_wait_s", 0.010)
+        kw.setdefault("queue_cap", 4)
+        return MicroBatcher(clock=clock, **kw), clock
+
+    def test_window_holds_then_releases(self):
+        b, clock = self.make()
+        b.submit("a", 2)
+        assert b.take() is None            # window still open
+        clock.advance(0.011)
+        batch = b.take()
+        assert [r.payload for r in batch] == ["a"]
+        assert b.empty()
+
+    def test_rows_threshold_dispatches_immediately(self):
+        b, _ = self.make()
+        b.submit("a", 10)
+        assert b.take() is None
+        b.submit("b", 6)                   # 16 rows = max_batch_rows
+        batch = b.take()
+        assert [r.payload for r in batch] == ["a", "b"]
+
+    def test_batch_never_splits_a_request(self):
+        b, clock = self.make()
+        b.submit("a", 10)
+        b.submit("b", 10)                  # 20 rows > 16: b must wait
+        clock.advance(0.011)
+        assert [r.payload for r in b.take()] == ["a"]
+        assert [r.payload for r in b.take()] == ["b"]
+
+    def test_request_rows_capped(self):
+        b, _ = self.make()
+        with pytest.raises(LogicError):
+            b.submit("too-big", 17)
+        with pytest.raises(LogicError):
+            b.submit("empty", 0)
+
+    def test_admission_cap_sheds(self):
+        b, _ = self.make()
+        for i in range(4):
+            b.submit(i, 1)
+        with pytest.raises(ServiceOverloadError) as ei:
+            b.submit("over", 1)
+        assert ei.value.queue_depth == 4
+        assert ei.value.queue_cap == 4
+
+    def test_drain_flushes_and_rejects_new(self):
+        b, _ = self.make()
+        b.submit("a", 1)
+        assert b.take() is None            # window open
+        b.begin_drain()
+        assert [r.payload for r in b.take()] == ["a"]   # flushed
+        with pytest.raises(LogicError):
+            b.submit("late", 1)
+
+    def test_shutdown_returns_leftovers(self):
+        b, _ = self.make()
+        b.submit("a", 1)
+        b.submit("b", 2)
+        left = b.shutdown()
+        assert [r.payload for r in left] == ["a", "b"]
+        assert b.wait_for_batch() is None
+
+
+# ---------------------------------------------------------------------- #
+# service: deterministic (threadless) coalesce/split, deadlines, warmup
+# ---------------------------------------------------------------------- #
+class TestServiceManual:
+    def make_knn(self, index, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_batch_rows", 32)
+        kw.setdefault("max_wait_ms", 10.0)
+        svc = KNNService(index, k=5, start=False, clock=clock, **kw)
+        return svc, clock
+
+    def test_coalesce_split_matches_unbatched(self, index, rng):
+        svc, clock = self.make_knn(index)
+        blocks = [jnp.asarray(rng.standard_normal((r, 16)), jnp.float32)
+                  for r in (3, 1, 9)]
+        futs = svc.submit_many(blocks)
+        assert not any(f.done() for f in futs)
+        clock.advance(0.5)
+        assert svc.worker.run_once()
+        for q, f in zip(blocks, futs):
+            d, i = f.result(timeout=0)
+            d0, i0 = brute_force_knn(index, q, 5)
+            assert bool((np.asarray(d) == np.asarray(d0)).all())
+            assert bool((np.asarray(i) == np.asarray(i0)).all())
+        svc.close()
+
+    def test_deadline_expires_in_queue(self, index, rng):
+        svc, clock = self.make_knn(index)
+        q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+        doomed = svc.submit(q, timeout=0.05)
+        alive = svc.submit(q)
+        clock.advance(0.1)                 # past deadline AND window
+        assert svc.worker.run_once()
+        with pytest.raises(CommTimeoutError):
+            doomed.result(timeout=0)
+        d, i = alive.result(timeout=0)
+        assert d.shape == (2, 5)
+        svc.close()
+
+    def test_warmup_populates_compile_cache(self, rng):
+        # uniquely-shaped index: compiled executables persist across
+        # reset_compile_cache_stats (its documented contract), so the
+        # miss-counting assertion needs cache keys no earlier test in
+        # this process can have compiled
+        index = jnp.asarray(rng.standard_normal((317, 16)), jnp.float32)
+        svc, clock = self.make_knn(index, bucket_rungs="8,32")
+        reset_compile_cache_stats()
+        assert svc.warmed_rungs == ()
+        svc.warmup()
+        assert svc.warmed_rungs == (8, 32)
+        m_warm = _total_misses()
+        assert m_warm >= len(svc.policy.rungs)
+        # steady state: every admissible shape lands on a warmed bucket
+        for r in (1, 8, 9, 30, 32):
+            fut = svc.submit(
+                jnp.asarray(rng.standard_normal((r, 16)), jnp.float32))
+            clock.advance(0.5)
+            assert svc.worker.run_once()
+            fut.result(timeout=0)
+        assert _total_misses() == m_warm
+        svc.close()
+
+    def test_payload_validation(self, index):
+        svc, _ = self.make_knn(index)
+        with pytest.raises(LogicError):
+            svc.submit(jnp.zeros((2, 7)))  # wrong dim
+        with pytest.raises(LogicError):
+            svc.submit(jnp.zeros((40, 16)))  # > max_batch_rows
+        one = svc.submit(jnp.zeros((16,)))   # 1-D promotes to one row
+        svc.close()  # drains: resolves `one`
+        assert one.done() and one.exception() is None
+
+    def test_metrics_flow(self, index, rng):
+        svc, clock = self.make_knn(index, name="mtest")
+        svc.submit(jnp.asarray(rng.standard_normal((3, 16)), jnp.float32))
+        clock.advance(0.5)
+        svc.worker.run_once()
+        reg = default_registry()
+        req = reg.get("raft_tpu_serve_requests_total")
+        assert req is not None
+        vals = {lbl["service"]: s.value for lbl, s in req.series()}
+        assert vals.get("mtest", 0) >= 1
+        pay = reg.get("raft_tpu_serve_payload_rows_total")
+        pad = reg.get("raft_tpu_serve_padded_rows_total")
+        pay_v = {lbl["service"]: s.value for lbl, s in pay.series()}
+        pad_v = {lbl["service"]: s.value for lbl, s in pad.series()}
+        # 3 payload rows padded to the 8-rung: 5 pad rows
+        assert pay_v["mtest"] == 3 and pad_v["mtest"] == 5
+        bucket = reg.get("raft_tpu_serve_bucket_calls_total")
+        bvals = {(lbl["service"], lbl["bucket"]): s.value
+                 for lbl, s in bucket.series()}
+        assert bvals.get(("mtest", "8")) == 1
+        svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# retry / watchdog reuse (PR 1 machinery around the device call)
+# ---------------------------------------------------------------------- #
+class TestRetryPolicyIntegration:
+    def _echo_service(self, **kw):
+        clock = FakeClock()
+        svc = Service("echo", lambda p: p * 2.0, dim=4, start=False,
+                      max_batch_rows=8, max_wait_ms=0.0, clock=clock,
+                      **kw)
+        return svc, clock
+
+    def test_transient_failure_retried(self):
+        calls = {"n": 0}
+
+        def flaky(padded):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return padded * 2.0
+
+        clock = FakeClock()
+        svc = Service("flaky", flaky, dim=4, start=False,
+                      max_batch_rows=8, max_wait_ms=0.0, clock=clock,
+                      retry_policy=RetryPolicy(max_retries=2,
+                                               base_delay=0.0,
+                                               sleep=lambda s: None))
+        fut = svc.submit(jnp.ones((2, 4)))
+        assert svc.worker.run_once()
+        out = fut.result(timeout=0)
+        assert calls["n"] == 2
+        assert bool((np.asarray(out) == 2.0).all())
+        svc.close()
+
+    def test_failure_without_policy_fails_all_riders(self):
+        def boom(padded):
+            raise RuntimeError("device gone")
+
+        clock = FakeClock()
+        svc = Service("boom", boom, dim=4, start=False,
+                      max_batch_rows=8, max_wait_ms=0.0, clock=clock)
+        futs = [svc.submit(jnp.ones((1, 4))) for _ in range(2)]
+        svc.worker.run_once()
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device gone"):
+                f.result(timeout=0)
+        svc.close()
+
+    def test_watchdog_deadline_on_device_call(self):
+        def hang(padded):
+            time.sleep(0.5)
+            return padded
+
+        svc = Service("hang", hang, dim=4, start=False,
+                      max_batch_rows=8, max_wait_ms=0.0,
+                      retry_policy=RetryPolicy(
+                          max_retries=0, timeout=0.05,
+                          retry_timeouts=False))
+        fut = svc.submit(jnp.ones((1, 4)))
+        svc.worker.run_once()
+        with pytest.raises(CommTimeoutError):
+            fut.result(timeout=0)
+        svc.close(drain=False)
+
+
+# ---------------------------------------------------------------------- #
+# threaded: the acceptance scenario + lifecycle + stress
+# ---------------------------------------------------------------------- #
+class TestThreadedService:
+    def test_acceptance_100_concurrent_mixed_shapes(self, index, rng):
+        """ISSUE 4 acceptance: warmed service, 100 concurrent
+        mixed-shape submits -> zero post-warmup compiles, bit-identical
+        results, over-cap load sheds with ServiceOverloadError."""
+        svc = KNNService(index, k=5, max_batch_rows=64,
+                         max_wait_ms=1.0, queue_cap=256)
+        rows = [int(r) for r in rng.integers(1, 33, size=100)]
+        blocks = [jnp.asarray(rng.standard_normal((r, 16)), jnp.float32)
+                  for r in rows]
+        # baselines FIRST: they compile unbatched-shape executables
+        # that must not count against the service's steady state
+        baselines = [brute_force_knn(index, q, 5) for q in blocks]
+        reset_compile_cache_stats()
+        svc.warmup()
+        m_warm = _total_misses()
+
+        futs = [None] * len(blocks)
+        errors = []
+
+        def submitter(i):
+            try:
+                futs[i] = svc.submit(blocks[i])
+            except Exception as e:  # noqa: BLE001 — collected
+                errors.append(e)
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(len(blocks))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errors
+        for (d0, i0), fut in zip(baselines, futs):
+            d, i = fut.result(timeout=30)
+            assert bool((np.asarray(d) == np.asarray(d0)).all())
+            assert bool((np.asarray(i) == np.asarray(i0)).all())
+        assert _total_misses() == m_warm, \
+            "post-warmup traffic must be compile-free"
+
+        # over-cap load sheds: stall admission by flooding a tiny-cap
+        # service whose worker never runs
+        svc.close()
+        stalled = KNNService(index, k=5, max_batch_rows=64,
+                             max_wait_ms=1000.0, queue_cap=8,
+                             start=False)
+        for _ in range(8):
+            stalled.submit(blocks[0])
+        with pytest.raises(ServiceOverloadError):
+            stalled.submit(blocks[0])
+        stalled.close()
+
+    def test_drain_then_close_idempotent(self, index, rng):
+        svc = KNNService(index, k=5, max_batch_rows=64,
+                         max_wait_ms=200.0)  # long window: drain flushes
+        futs = svc.submit_many(
+            [jnp.asarray(rng.standard_normal((3, 16)), jnp.float32)
+             for _ in range(5)])
+        assert svc.drain(timeout=30)
+        for f in futs:
+            assert f.done() and f.exception() is None
+        with pytest.raises(LogicError):
+            svc.submit(jnp.zeros((1, 16)))
+        svc.close()
+        svc.close()                        # idempotent
+        assert not svc.is_open()
+
+    def test_close_without_drain_fails_pending(self, index, rng):
+        svc = KNNService(index, k=5, max_batch_rows=64,
+                         max_wait_ms=60_000.0, start=False)
+        fut = svc.submit(
+            jnp.asarray(rng.standard_normal((2, 16)), jnp.float32))
+        svc.close(drain=False)
+        with pytest.raises(CommTimeoutError):
+            fut.result(timeout=0)
+
+    def test_concurrent_submitter_stress(self, index, rng):
+        svc = KNNService(index, k=3, max_batch_rows=64,
+                         max_wait_ms=0.5, queue_cap=2048)
+        svc.warmup()
+        n_threads, per_thread = 16, 20
+        results = [[] for _ in range(n_threads)]
+        errors = []
+
+        def client(tid):
+            trng = np.random.default_rng(SEED + tid)
+            try:
+                for _ in range(per_thread):
+                    q = jnp.asarray(
+                        trng.standard_normal((int(trng.integers(1, 9)),
+                                              16)), jnp.float32)
+                    results[tid].append(
+                        (q, svc.submit(q).result(timeout=30)))
+            except Exception as e:  # noqa: BLE001 — collected
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors
+        assert sum(len(r) for r in results) == n_threads * per_thread
+        for tid in range(n_threads):
+            for q, (d, i) in results[tid]:
+                d0, i0 = brute_force_knn(index, q, 3)
+                assert bool((np.asarray(d) == np.asarray(d0)).all())
+        svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# pairwise service
+# ---------------------------------------------------------------------- #
+class TestPairwiseService:
+    def test_roundtrip(self, rng):
+        from raft_tpu.distance.pairwise import pairwise_distance
+
+        Y = jnp.asarray(rng.standard_normal((80, 8)), jnp.float32)
+        svc = PairwiseService(Y, max_batch_rows=32, max_wait_ms=1.0)
+        svc.warmup()
+        x = jnp.asarray(rng.standard_normal((5, 8)), jnp.float32)
+        out = svc.submit(x).result(timeout=30)
+        assert out.shape == (5, 80)
+        ref = pairwise_distance(x, Y)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# query-vector cache (VecCache wiring)
+# ---------------------------------------------------------------------- #
+class TestQueryCache:
+    def make(self, index):
+        clock = FakeClock()
+        svc = KNNService(index, k=5, start=False, clock=clock,
+                         max_batch_rows=32, max_wait_ms=10.0,
+                         query_cache_size=64, name="qc%d" % SEED)
+        return svc, clock
+
+    def test_put_lookup_counters(self, index, rng):
+        svc, _ = self.make(index)
+        keys = jnp.asarray([3, 9, 40], jnp.int32)
+        vecs = jnp.asarray(rng.standard_normal((3, 16)), jnp.float32)
+        svc.cache_put(keys, vecs)
+        got, found = svc.cache_lookup(jnp.asarray([3, 9, 40, 7]))
+        assert bool(found[:3].all()) and not bool(found[3])
+        assert bool((np.asarray(got[:3]) == np.asarray(vecs)).all())
+        reg = default_registry()
+        hits = {lbl["service"]: s.value for lbl, s in reg.get(
+            "raft_tpu_serve_query_cache_hits_total").series()}
+        misses = {lbl["service"]: s.value for lbl, s in reg.get(
+            "raft_tpu_serve_query_cache_misses_total").series()}
+        assert hits[svc.name] == 3 and misses[svc.name] == 1
+        svc.close()
+
+    def test_submit_keys_equals_submit_vectors(self, index, rng):
+        svc, clock = self.make(index)
+        keys = jnp.asarray([1, 2, 5], jnp.int32)
+        vecs = jnp.asarray(rng.standard_normal((3, 16)), jnp.float32)
+        svc.cache_put(keys, vecs)
+        fut = svc.submit_keys(keys)
+        clock.advance(0.5)
+        assert svc.worker.run_once()
+        d, i = fut.result(timeout=0)
+        d0, i0 = brute_force_knn(index, vecs, 5)
+        assert bool((np.asarray(d) == np.asarray(d0)).all())
+        assert bool((np.asarray(i) == np.asarray(i0)).all())
+        svc.close()
+
+    def test_missing_key_raises_naming_it(self, index, rng):
+        svc, _ = self.make(index)
+        svc.cache_put(jnp.asarray([1], jnp.int32),
+                      jnp.asarray(rng.standard_normal((1, 16)),
+                                  jnp.float32))
+        with pytest.raises(LogicError, match="77"):
+            svc.submit_keys(jnp.asarray([1, 77], jnp.int32))
+        svc.close()
+
+    def test_cache_requires_opt_in(self, index):
+        clock = FakeClock()
+        svc = KNNService(index, k=5, start=False, clock=clock,
+                         max_batch_rows=32)
+        with pytest.raises(LogicError):
+            svc.submit_keys(jnp.asarray([1], jnp.int32))
+        with pytest.raises(LogicError):
+            svc.cache_put(jnp.asarray([-1], jnp.int32),
+                          jnp.zeros((1, 16)))
+        svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# config knobs
+# ---------------------------------------------------------------------- #
+class TestServeKnobs:
+    @pytest.fixture(autouse=True)
+    def _reset(self):
+        yield
+        config.configure(serve_bucket_rungs=None, serve_max_wait_ms=None,
+                         serve_queue_cap=None)
+
+    def test_defaults_resolve(self):
+        assert config.get("serve_bucket_rungs") == "pow2"
+        assert float(config.get("serve_max_wait_ms")) == 2.0
+        assert int(config.get("serve_queue_cap")) == 1024
+
+    def test_knobs_feed_service_defaults(self, index):
+        config.configure(serve_bucket_rungs="8,16",
+                         serve_max_wait_ms="7.5", serve_queue_cap="2")
+        svc = KNNService(index, k=5, start=False, max_batch_rows=16)
+        assert svc.policy.rungs == (8, 16)
+        assert svc.batcher.max_wait_s == pytest.approx(0.0075)
+        assert svc.batcher.queue_cap == 2
+        svc.submit(jnp.zeros((1, 16)))
+        svc.submit(jnp.zeros((1, 16)))
+        with pytest.raises(ServiceOverloadError):
+            svc.submit(jnp.zeros((1, 16)))
+        svc.close()
+
+    def test_bad_numeric_knob_surfaces(self, index):
+        config.configure(serve_max_wait_ms="fast")
+        with pytest.raises(ValueError, match="serve_max_wait_ms"):
+            KNNService(index, k=5, start=False)
+
+
+# ---------------------------------------------------------------------- #
+# session integration (incl. the destroy-drains-services bugfix)
+# ---------------------------------------------------------------------- #
+class TestSessionServe:
+    def test_serve_requires_initialized(self):
+        from raft_tpu.session import Comms
+
+        s = Comms()
+        with pytest.raises(LogicError):
+            s.serve("knn", index=jnp.zeros((10, 4)), k=2)
+
+    def test_serve_registers_and_destroy_drains(self, index, rng):
+        from raft_tpu.session import Comms
+
+        s = Comms().init()
+        try:
+            svc = s.serve("knn", index=index, k=5, max_batch_rows=64,
+                          max_wait_ms=60_000.0, name="sess-knn")
+            assert "sess-knn" in s.services
+            with pytest.raises(LogicError):
+                s.serve("knn", index=index, k=5, name="sess-knn")
+            futs = svc.submit_many(
+                [jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+                 for _ in range(4)])
+            # the batching window is a minute long: only destroy's
+            # drain-before-teardown can resolve these
+            assert not any(f.done() for f in futs)
+        finally:
+            s.destroy()
+        for f in futs:
+            assert f.done() and f.exception() is None
+        assert not svc.is_open()
+        assert not svc.worker.is_alive()
+        assert s.services == {}
+        s.destroy()                        # idempotent
+
+    def test_health_check_covers_services(self, index):
+        from raft_tpu.session import Comms
+
+        s = Comms().init()
+        try:
+            svc = s.serve("knn", index=index, k=5, max_batch_rows=32,
+                          name="hc-knn")
+            report = s.health_check()
+            assert report["services"]["hc-knn"]["worker_alive"]
+            assert report["services"]["hc-knn"]["open"]
+            assert report["services"]["hc-knn"]["rungs"] == [8, 16, 32]
+            assert report["ok"]
+            svc.close()
+            report2 = s.health_check()
+            assert report2["services"]["hc-knn"]["open"] is False
+            assert report2["ok"]           # closed-on-purpose passes
+        finally:
+            s.destroy()
+
+
+# ---------------------------------------------------------------------- #
+# CI hygiene: the raw-Thread ban
+# ---------------------------------------------------------------------- #
+class TestThreadBan:
+    def _check(self, tmp_path, relpath, src, monkeypatch):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(os.path.dirname(__file__),
+                                        "..", "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(mod, "REPO", str(tmp_path))
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        return mod.check_file(str(path))
+
+    def test_raw_thread_outside_serve_flagged(self, tmp_path,
+                                              monkeypatch):
+        src = ("import threading\n"
+               "t = threading.Thread(target=print)\n")
+        probs = self._check(tmp_path, "raft_tpu/spatial/bad.py", src,
+                            monkeypatch)
+        assert any("threading.Thread" in p for p in probs)
+        probs = self._check(
+            tmp_path, "raft_tpu/spatial/bad2.py",
+            "from threading import Thread\n", monkeypatch)
+        assert any("threading.Thread" in p for p in probs)
+
+    def test_serve_and_resilience_allowlisted(self, tmp_path,
+                                              monkeypatch):
+        src = ("import threading\n"
+               "t = threading.Thread(target=print)\n")
+        assert self._check(tmp_path, "raft_tpu/serve/ok.py", src,
+                           monkeypatch) == []
+        assert self._check(tmp_path, "raft_tpu/comms/resilience.py",
+                           src, monkeypatch) == []

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Load generator for raft_tpu serve services (docs/SERVING.md).
+
+Drives a :class:`raft_tpu.serve.KNNService` / ``PairwiseService`` with
+synthetic traffic and reports client-observed latency percentiles plus
+the padding-waste / batch-fill numbers from the metrics registry — the
+two halves of the serving trade (latency vs device efficiency) in one
+screen.
+
+Two loops:
+
+- **closed** (``--concurrency N``): N client threads each submit a
+  request, wait for its future, submit the next — throughput is
+  latency-bound, the classic saturation probe.
+- **open** (``--qps Q``): one pacing thread fires submits on a fixed
+  schedule regardless of completions — arrival-rate-bound, the loop
+  that actually exposes queueing: at overload it measures shed rate
+  (``ServiceOverloadError`` count) rather than silently slowing down.
+
+Usage:
+    python tools/loadgen.py --mode closed --concurrency 8 --duration 5
+    python tools/loadgen.py --mode open --qps 500 --duration 5 \\
+        --rows 4 --index-rows 50000 --dim 64 --k 10
+    python tools/loadgen.py --service pairwise --mode closed ...
+
+Importable: :func:`run_load` returns the report dict (bench.py's
+``serve`` rung and tests reuse it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _registry_serve_stats(service_name):
+    """Padding-waste / batch-fill numbers for one service, read back
+    from the metrics registry (the numbers the scheduler recorded —
+    loadgen measures the client side, the registry the server side)."""
+    from raft_tpu.core.metrics import default_registry
+
+    reg = default_registry()
+
+    def _value(name):
+        fam = reg.get(name)
+        if fam is None:
+            return 0.0
+        for labels, series in fam.series():
+            if labels.get("service") == service_name:
+                return series.value
+        return 0.0
+
+    payload = _value("raft_tpu_serve_payload_rows_total")
+    padded = _value("raft_tpu_serve_padded_rows_total")
+    batches = _value("raft_tpu_serve_batches_total")
+    total = payload + padded
+    out = {
+        "batches": int(batches),
+        "payload_rows": int(payload),
+        "padded_rows": int(padded),
+        "padding_waste": (padded / total) if total else 0.0,
+        "mean_batch_rows": (payload / batches) if batches else 0.0,
+    }
+    fam = reg.get("raft_tpu_serve_wait_seconds")
+    if fam is not None:
+        for labels, series in fam.series():
+            if labels.get("service") == service_name:
+                out["queue_wait_p50_ms"] = series.quantile(0.50) * 1e3
+                out["queue_wait_p95_ms"] = series.quantile(0.95) * 1e3
+    return out
+
+
+def build_service(kind, index_rows, dim, k, seed=0, **opts):
+    """A ready (not yet warmed) service over a synthetic index."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.serve import KNNService, PairwiseService
+
+    rng = np.random.default_rng(seed)
+    ref = jnp.asarray(rng.standard_normal((index_rows, dim)), jnp.float32)
+    if kind == "knn":
+        return KNNService(ref, k=k, **opts)
+    if kind == "pairwise":
+        return PairwiseService(ref, **opts)
+    raise SystemExit("unknown --service %r" % kind)
+
+
+def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
+             qps=100.0, rows=4, seed=0, deadline=None):
+    """Drive ``service`` for ``duration`` seconds; returns the report.
+
+    Latencies are client-observed submit→result seconds.  Rejected
+    submits (admission control) and expired deadlines are counted, not
+    raised — overload behavior is the *measurement*, not a failure.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.core.error import ServiceOverloadError
+
+    rng = np.random.default_rng(seed)
+    # pre-generated query pool: the generator must not bottleneck on
+    # fresh RNG draws mid-flight
+    pool = [jnp.asarray(rng.standard_normal((rows, service.dim)),
+                        jnp.float32) for _ in range(32)]
+    lock = threading.Lock()
+    latencies = []
+    counts = {"ok": 0, "rejected": 0, "errors": 0}
+    stop_t = time.monotonic() + duration
+
+    def one_request(i):
+        q = pool[i % len(pool)]
+        t0 = time.monotonic()
+        try:
+            fut = service.submit(q, timeout=deadline)
+            fut.result(timeout=max(30.0, duration))
+        except ServiceOverloadError:
+            with lock:
+                counts["rejected"] += 1
+            return
+        except Exception:
+            with lock:
+                counts["errors"] += 1
+            return
+        dt = time.monotonic() - t0
+        with lock:
+            counts["ok"] += 1
+            latencies.append(dt)
+
+    spawned = []  # open-loop per-request threads (joined after the pacer)
+    if mode == "closed":
+        def client(tid):
+            i = tid
+            while time.monotonic() < stop_t:
+                one_request(i)
+                i += concurrency
+
+        threads = [threading.Thread(target=client, args=(t,), daemon=True)
+                   for t in range(concurrency)]
+    elif mode == "open":
+        period = 1.0 / qps
+
+        def pacer():
+            i = 0
+            next_t = time.monotonic()
+            while time.monotonic() < stop_t:
+                t = threading.Thread(target=one_request, args=(i,),
+                                     daemon=True)
+                t.start()
+                spawned.append(t)
+                i += 1
+                next_t += period
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+
+        threads = [threading.Thread(target=pacer, daemon=True)]
+    else:
+        raise SystemExit("unknown --mode %r" % mode)
+
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60.0)
+    for t in spawned:  # in-flight open-loop requests
+        t.join(timeout=60.0)
+    wall = time.monotonic() - t_start
+
+    lat = sorted(latencies)
+    report = {
+        "mode": mode,
+        "duration_s": round(wall, 3),
+        "requests_ok": counts["ok"],
+        "rejected": counts["rejected"],
+        "errors": counts["errors"],
+        "qps": round(counts["ok"] / wall, 2) if wall else 0.0,
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+        "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+    }
+    report.update(_registry_serve_stats(service.name))
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--service", choices=("knn", "pairwise"),
+                    default="knn")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="open-loop arrival rate")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop client threads")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--rows", type=int, default=4,
+                    help="query rows per request")
+    ap.add_argument("--index-rows", type=int, default=20000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--max-batch-rows", type=int, default=1024)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--queue-cap", type=int, default=None)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline seconds")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report dict as JSON")
+    args = ap.parse_args(argv)
+
+    opts = {"max_batch_rows": args.max_batch_rows}
+    if args.max_wait_ms is not None:
+        opts["max_wait_ms"] = args.max_wait_ms
+    if args.queue_cap is not None:
+        opts["queue_cap"] = args.queue_cap
+    service = build_service(args.service, args.index_rows, args.dim,
+                            args.k, seed=args.seed, **opts)
+    t0 = time.monotonic()
+    service.warmup()
+    warmup_s = time.monotonic() - t0
+    try:
+        report = run_load(service, mode=args.mode,
+                          duration=args.duration,
+                          concurrency=args.concurrency, qps=args.qps,
+                          rows=args.rows, seed=args.seed,
+                          deadline=args.deadline)
+    finally:
+        service.close()
+    report["warmup_s"] = round(warmup_s, 3)
+    report["buckets"] = list(service.policy.rungs)
+
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    print("== loadgen: %s %s ==" % (args.service, args.mode))
+    for key in ("duration_s", "requests_ok", "rejected", "errors", "qps",
+                "p50_ms", "p95_ms", "p99_ms", "queue_wait_p50_ms",
+                "queue_wait_p95_ms", "batches", "mean_batch_rows",
+                "padding_waste", "warmup_s", "buckets"):
+        if key in report:
+            val = report[key]
+            if isinstance(val, float):
+                val = "%.3f" % val
+            print("  %-20s %s" % (key, val))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,11 @@ def render_report(snap: dict) -> str:
                          if "high_water" in s else "")
                 lines.append("  %-52s %-24s %g%s"
                              % (name, lbl, s["value"], extra))
+    serve = _serve_summary(metrics)
+    if serve:
+        lines.append("== serving (per service: traffic / batching / "
+                     "waste / latency) ==")
+        lines.extend(serve)
     cc = snap.get("compile_cache", {})
     if cc:
         lines.append("== jit compile cache (per fn: shapes / hits / "
@@ -104,6 +109,58 @@ def render_report(snap: dict) -> str:
     return "\n".join(lines) if lines else "(empty snapshot)"
 
 
+def _serve_summary(metrics: dict) -> list:
+    """Per-service serving digest from the raw ``raft_tpu_serve_*``
+    families: request/batch counts, mean fill, padding-waste ratio
+    (padded / dispatched rows), queue-wait and device-call latency.
+    The generic tables above still show every series; this section does
+    the cross-family arithmetic a dashboard would."""
+
+    def per_service(name):
+        fam = metrics.get(name, {})
+        out = {}
+        for s in fam.get("series", []):
+            svc = s["labels"].get("service")
+            if svc is not None:
+                out[svc] = s
+        return out
+
+    requests = per_service("raft_tpu_serve_requests_total")
+    if not requests:
+        return []
+    batches = per_service("raft_tpu_serve_batches_total")
+    payload = per_service("raft_tpu_serve_payload_rows_total")
+    padded = per_service("raft_tpu_serve_padded_rows_total")
+    rejected = per_service("raft_tpu_serve_rejected_total")
+    expired = per_service("raft_tpu_serve_expired_total")
+    waits = per_service("raft_tpu_serve_wait_seconds")
+    execs = per_service("raft_tpu_serve_exec_seconds")
+    lines = []
+    for svc in sorted(requests):
+        nb = batches.get(svc, {}).get("value", 0)
+        pay = payload.get(svc, {}).get("value", 0)
+        pad = padded.get(svc, {}).get("value", 0)
+        total = pay + pad
+        lines.append(
+            "  %-24s requests=%-8d batches=%-7d mean_fill=%-7.1f "
+            "waste=%.1f%%  rejected=%d expired=%d"
+            % (svc, requests[svc]["value"], nb,
+               (pay / nb) if nb else 0.0,
+               (100.0 * pad / total) if total else 0.0,
+               rejected.get(svc, {}).get("value", 0),
+               expired.get(svc, {}).get("value", 0)))
+        w, e = waits.get(svc), execs.get(svc)
+        if w or e:
+            lines.append(
+                "  %-24s   queue wait p50=%s p95=%s   exec p50=%s "
+                "p95=%s" % ("",
+                            _fmt_s(w["p50"]) if w else "-",
+                            _fmt_s(w["p95"]) if w else "-",
+                            _fmt_s(e["p50"]) if e else "-",
+                            _fmt_s(e["p95"]) if e else "-"))
+    return lines
+
+
 def run_demo() -> dict:
     """Tiny instrumented workload touching every metric layer."""
     import jax.numpy as jnp
@@ -127,6 +184,14 @@ def run_demo() -> dict:
     comms.allreduce(jnp.ones((size, 4), jnp.float32))
     with DeviceBuffer((1024, 1024)):
         pass
+    # serving layer: a warmed micro-batching service over the same index
+    from raft_tpu.serve import KNNService
+
+    svc = KNNService(X, k=4, max_batch_rows=32, max_wait_ms=1.0)
+    svc.warmup()
+    for f in svc.submit_many([Q[:3], Q[3:8], Q[8:12]]):
+        f.result(timeout=30)
+    svc.close()
     return metrics_snapshot()
 
 
