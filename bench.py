@@ -262,6 +262,7 @@ def _tag(payload):
     if isinstance(payload, dict) and _DEVICE_INFO:
         payload.setdefault("device", _DEVICE_INFO.get("device"))
         payload.setdefault("platform", _DEVICE_INFO.get("platform"))
+        payload.setdefault("n_devices", _DEVICE_INFO.get("n_devices"))
     return payload
 
 
@@ -400,11 +401,18 @@ def _rung_init():
     _DEVICE_INFO.update({
         "device": str(dev.device_kind),
         "platform": str(dev.platform),
+        # recorded so ladder comparisons can see a backend-shape
+        # change: the CPU child now forces an 8-device virtual mesh
+        # (for the comms_p2p rung), where earlier rounds ran 1-device —
+        # a cross-round delta on a non-comms rung must be read against
+        # this field before being called a regression
+        "n_devices": len(jax.devices()),
     })
     return {
         "seconds": round(time.time() - t0, 1),
         "device": str(dev.device_kind),
         "platform": str(dev.platform),
+        "n_devices": len(jax.devices()),
         "is_tpu": bool(is_tpu_backend()),
     }
 
@@ -916,6 +924,76 @@ def _bench_serve(index_rows, dim, k, duration, concurrency):
     }
 
 
+def _bench_comms_p2p(rows, dim, iters):
+    """Tagged-p2p staging A/B (docs/ZERO_COPY.md): one full ring
+    (every rank sends a (rows, dim) f32 block to its neighbor) per
+    ``waitall``, device-resident assembly vs the historical host-numpy
+    staging.  The host-staged-bytes counter rides along as the proof
+    the device path moved zero payload bytes through numpy — the perf
+    claim and the zero-copy claim are the same measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.comms.host_comms import HostComms, default_mesh
+    from raft_tpu.core.metrics import default_registry
+
+    comms = HostComms(default_mesh())
+    size = comms.get_size()
+    if size < 2:
+        return {"status": "skipped_single_device"}
+    payloads = [jnp.asarray(_rand((rows, dim), seed=100 + r))
+                for r in range(size)]
+    jax.block_until_ready(payloads)
+
+    def staged_bytes():
+        return default_registry().family_total(
+            "raft_tpu_comms_host_staged_bytes")
+
+    def ring(staging):
+        recvs = []
+        for r in range(size):
+            comms.isend(payloads[r], rank=r, dest=(r + 1) % size, tag=0)
+            recvs.append(comms.irecv(rank=r, source=(r - 1) % size,
+                                     tag=0))
+        comms.waitall(staging=staging)
+        # block per waitall: the rung measures the eager verb's
+        # round-trip (dispatch + collective + result ready), and
+        # overlapping successive collective executions deadlocks the
+        # CPU backend's rendezvous (8 virtual devices share one pool)
+        return jax.block_until_ready([rq.result for rq in recvs])
+
+    out = {"config": {"rows": rows, "dim": dim, "iters": iters,
+                      "ranks": size}}
+    payload_bytes = size * rows * dim * 4
+    # all three arms: "device" (per-pair direct moves, no collective),
+    # "ppermute" (same collective program as "host" but with on-device
+    # assembly — the apples-to-apples staging A/B, and the path taken
+    # on multi-process/multi-axis meshes or under a fault injector),
+    # "host" (numpy-staged baseline)
+    for staging in ("device", "ppermute", "host"):
+        ring(staging)                            # compile warmup
+        b0 = staged_bytes()
+        t0 = time.time()
+        for _ in range(iters):
+            ring(staging)
+        dt = (time.time() - t0) / iters
+        out["%s_seconds_per_waitall" % staging] = round(dt, 6)
+        out["%s_gb_per_sec" % staging] = round(
+            payload_bytes / dt / 1e9, 3)
+        out["%s_host_staged_bytes_per_waitall" % staging] = int(
+            (staged_bytes() - b0) / iters)
+    out["payload_mb_per_waitall"] = round(payload_bytes / 1e6, 2)
+    out["device_speedup"] = round(
+        out["host_seconds_per_waitall"]
+        / out["device_seconds_per_waitall"], 3)
+    # same collective, staging isolated: the zero-copy win net of
+    # dropping the collective program
+    out["ppermute_speedup"] = round(
+        out["host_seconds_per_waitall"]
+        / out["ppermute_seconds_per_waitall"], 3)
+    return out
+
+
 def _bench_sparse_pairwise(m, n_cols, nnz_row, iters, batch_size_k):
     """Sparse CSR pairwise L2 on the column-tiled engine (the
     load-balanced-SpMV-regime analog, sparse/distance/detail/
@@ -1198,6 +1276,10 @@ def child_main():
             # scaled index, whole-request-path QPS + latency percentiles
             ("serve_knn", 45,
              lambda: _bench_serve(20_000, 64, 10, 3.0, 8)),
+            # zero-copy p2p staging A/B on the 8-device virtual mesh:
+            # device-resident assembly vs host-numpy staging, with the
+            # host-staged-bytes counter as the zero-copy proof
+            ("comms_p2p", 40, lambda: _bench_comms_p2p(512, 1024, 8)),
             # affordable on CPU since the r5 single-jit Lanczos (~12 s
             # incl the graph build; was hours-scale retrace before)
             ("spectral_100k", 40, _bench_spectral_100k),
@@ -1272,6 +1354,9 @@ def child_main():
             ("knn_1m_twophase", 120 + 60,
              lambda: _bench_knn_twophase_1m(state)),
             ("pairwise_8k", 50, lambda: _bench_pairwise(8192, 128, 16)),
+            # zero-copy p2p staging A/B over ICI (docs/ZERO_COPY.md)
+            ("comms_p2p", 50,
+             lambda: _bench_comms_p2p(2048, 1024, 8)),
             ("knn_100k_bf16", 60,
              lambda: _bench_knn_bf16(100_000, 4096, 4)),
             ("knn_100k_rerank", 70,
@@ -1383,6 +1468,14 @@ class _Child:
         if cpu:
             env[_CPU_ENV] = "1"
             env["JAX_PLATFORMS"] = "cpu"
+            # 8-device virtual mesh (the tests/conftest.py convention):
+            # the comms_p2p rung A/Bs p2p staging across ranks, which a
+            # 1-device CPU backend cannot exercise
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
         self.t_spawn = time.time()
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child"],

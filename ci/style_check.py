@@ -25,6 +25,15 @@ Checks, per Python source file:
   watchdog that predates it.  New background work should go through a
   :class:`raft_tpu.serve.scheduler.ServeWorker` or the resilience
   watchdog, not ad-hoc threads that nothing drains at teardown.
+- no ``np.asarray(`` / ``np.array(`` inside ``raft_tpu/comms/`` hot
+  paths: a payload bounced through host numpy silently re-introduces
+  the host staging the zero-copy p2p path removed
+  (docs/ZERO_COPY.md) — device arrays must stay device arrays end to
+  end.  ``selftest.py`` / ``faults.py`` are allowlisted (test batteries
+  read results on host by design), and a line carrying a
+  ``comms-host-ok`` marker comment is exempt (device *handles* like
+  mesh construction, and the deliberately-counted ``staging="host"``
+  baseline).
 
 Exit code 0 when clean; prints one line per violation otherwise.
 """
@@ -53,6 +62,19 @@ THREAD_ALLOWLIST = TIMING_ALLOWLIST + (
     os.path.join("raft_tpu", "comms", "resilience.py"),
 )
 
+# host-numpy payload ban (raft_tpu/comms/ only): the zero-copy p2p
+# path's guarantee is that payloads never bounce through host numpy
+# (docs/ZERO_COPY.md); selftest/faults read results on host by design,
+# and a `comms-host-ok` marker comment exempts a line (device handles,
+# the counted staging="host" baseline)
+COMMS_NP_DIR = os.path.join("raft_tpu", "comms") + os.sep
+COMMS_NP_ALLOWLIST = (
+    os.path.join("raft_tpu", "comms", "selftest.py"),
+    os.path.join("raft_tpu", "comms", "faults.py"),
+)
+COMMS_NP_ATTRS = ("asarray", "array")
+COMMS_NP_MARKER = "comms-host-ok"
+
 
 def check_file(path):
     problems = []
@@ -78,11 +100,15 @@ def check_file(path):
                        and not any(rel.startswith(d)
                                    for d in THREAD_DIR_ALLOWLIST)
                        and rel not in THREAD_ALLOWLIST)
+    in_comms_np_scope = (rel.startswith(COMMS_NP_DIR)
+                         and rel not in COMMS_NP_ALLOWLIST)
+    src_lines = src.splitlines()
     # aliases the time/threading modules are bound to ("import time",
     # "import time as t") — attribute-call matching must follow them or
     # the bans are trivially evaded
     time_aliases = {"time"}
     threading_aliases = {"threading"}
+    numpy_aliases = {"numpy"}
     for node in ast.walk(tree):
         if (isinstance(node, ast.ImportFrom) and node.module
                 and node.module.startswith("raft_tpu")
@@ -111,6 +137,34 @@ def check_file(path):
                     "background work goes through raft_tpu/serve "
                     "(ServeWorker) or the resilience watchdog "
                     "(docs/SERVING.md)")
+        if in_comms_np_scope:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        numpy_aliases.add(a.asname or "numpy")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "numpy"
+                    and any(a.name in COMMS_NP_ATTRS
+                            for a in node.names)
+                    and COMMS_NP_MARKER
+                    not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: from-import of numpy "
+                    "array/asarray in comms — payloads stay on device "
+                    "(docs/ZERO_COPY.md); mark device-handle uses "
+                    f"with `{COMMS_NP_MARKER}`")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in COMMS_NP_ATTRS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in numpy_aliases
+                    and COMMS_NP_MARKER
+                    not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: np.{node.func.attr}() on a "
+                    "comms hot path — payloads stay on device "
+                    "(docs/ZERO_COPY.md); mark device-handle uses "
+                    f"with `{COMMS_NP_MARKER}`")
         if not in_lib:
             continue
         if isinstance(node, ast.Import):

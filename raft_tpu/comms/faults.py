@@ -42,11 +42,13 @@ from __future__ import annotations
 import contextlib
 import enum
 import random
+import threading
 import time
 from typing import Iterator, List, NamedTuple, Optional, Tuple
 
 from raft_tpu.core import tracing
-from raft_tpu.core.error import CommAbortedError, CommError
+from raft_tpu.core.error import (CommAbortedError, CommError,
+                                 CommTimeoutError)
 
 
 class InjectedError(CommError):
@@ -132,6 +134,23 @@ class Delay(Fault):
             # counter while the delay is still in flight
             tracing.counter_inc("comms.fault_injected")
             self._sleep(self.seconds)
+            # the watchdog abandoned this attempt while it slept: bail
+            # BEFORE the verb dispatches its program — a late
+            # collective racing the retry's (or the next test's)
+            # collective deadlocks the CPU backend's shared rendezvous.
+            # The check-or-commit runs under the watchdog's handshake
+            # lock (RetryPolicy._attempt) so a delay straddling the
+            # deadline cannot read a stale flag and dispatch anyway.
+            # The error lands in the abandoned runner's discarded
+            # result box, never a caller.
+            cur = threading.current_thread()
+            lock = getattr(cur, "raft_tpu_abandon_lock", None)
+            with lock if lock is not None else contextlib.nullcontext():
+                if getattr(cur, "raft_tpu_abandoned", False):
+                    raise CommTimeoutError(
+                        "delayed attempt abandoned by the watchdog; "
+                        "suppressing its late dispatch")
+                cur.raft_tpu_dispatch_committed = True
             return True
         return False
 
@@ -200,7 +219,7 @@ class FaultInjector:
         self._orig_execute = self._comms._execute
         orig = self._orig_execute
 
-        def patched(key, fn, *args):
+        def patched(key, fn, *args, **kwargs):
             verb = key[0]
             self.calls.append((verb, key))
             for i, fault in enumerate(self._faults):
@@ -219,7 +238,7 @@ class FaultInjector:
                     # (pre-sleep); only the log entry lands here
                     self.injected.append(Injection(verb, n, fault))
                 break  # first matching fault owns this call
-            return orig(key, fn, *args)
+            return orig(key, fn, *args, **kwargs)
 
         self._comms._execute = patched
 

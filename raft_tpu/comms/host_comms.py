@@ -17,9 +17,29 @@ eager API and the in-trace API cannot diverge.
 
 Tagged p2p (UCX's role, std_comms.hpp:204-298): ``isend``/``irecv``
 record host-side descriptors with *dynamic* ranks and tags; ``waitall``
-matches them, groups matched pairs by tag, and executes one ``ppermute``
-per tag over ICI.  Unmatched requests raise — the reference's analog is a
+matches them, groups matched pairs by (shape, dtype) — heterogeneous
+payloads are legal, each group runs its own programs — layers every
+group into disjoint permutations, and executes one ``ppermute`` per
+layer over ICI.  Unmatched requests raise — the reference's analog is a
 UCX progress-loop timeout abort (std_comms.hpp:234-298).
+
+Zero-copy (docs/ZERO_COPY.md): on the default ``p2p_staging="device"``
+path each matched pair is ONE direct device-to-device transfer of the
+send buffer onto the receiver's device — the in-memory analog of the
+reference's GPU-direct UCX send (std_comms.hpp:204: device pointers
+straight into the transport) — and no payload byte ever bounces
+through host numpy.  Where per-pair placement is impossible
+(multi-process, multi-axis mesh, or an attached fault injector that
+must observe the program seam) it degrades to
+``p2p_staging="ppermute"``: the rank-major ppermute input is assembled
+*on device* (per-rank shard placement or ``jnp.stack`` over shared
+:func:`zeros_cached` blanks) and the assembled buffer is **donated**
+to the cached program (``donate_argnums``), so the intermediate is
+recycled into the output — still zero host-staged bytes.
+``p2p_staging="host"`` keeps the historical numpy-staged assembly as a
+measurable comparison baseline; the
+``raft_tpu_comms_host_staged_bytes`` counter records exactly the bytes
+each path bounced through host (the device paths prove 0).
 
 ``sync_stream`` reproduces the reference's status-returning health check
 (std_comms.hpp:443-475: poll stream + ncclCommGetAsyncError, abort on
@@ -35,7 +55,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.8 (replication checking arg renamed check_rep -> check_vma)
     import inspect
@@ -65,8 +85,15 @@ from raft_tpu.core.error import (
 )
 from raft_tpu.comms.mesh_comms import MeshComms
 from raft_tpu.comms.types import Op, Status
+from raft_tpu.mr.buffer import zeros_cached as _zeros_cached
 
 _AXIS = "ranks"
+
+# per-row byte floor for the shard-by-shard p2p assembly: below it the
+# extra per-rank placement dispatches cost more than the resharding
+# they avoid (measured on the 8-device virtual mesh, see
+# _assemble_device / bench.py comms_p2p)
+_SHARDED_MIN_ROW_BYTES = 1 << 21
 
 
 def default_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -77,7 +104,7 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
         expects(n_devices <= len(devs),
                 "requested %d devices, only %d available", n_devices, len(devs))
         devs = devs[:n_devices]
-    return Mesh(np.asarray(devs), (_AXIS,))
+    return Mesh(np.asarray(devs), (_AXIS,))  # comms-host-ok: device handles, not payload
 
 
 class _Request:
@@ -105,10 +132,21 @@ class HostComms:
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, axis: str = _AXIS,
-                 retry_policy=None):
+                 retry_policy=None, p2p_staging: str = "device"):
         self.mesh = mesh if mesh is not None else default_mesh()
         self.axis = axis
         expects(axis in self.mesh.axis_names, "axis %s not in mesh", axis)
+        expects(p2p_staging in ("device", "ppermute", "host"),
+                "p2p_staging must be 'device', 'ppermute' or 'host', "
+                "got %r", p2p_staging)
+        # "device" (default): per-pair direct device-to-device
+        # transfers (degrading to the ppermute form where per-pair
+        # placement is impossible) — zero host-staged bytes.
+        # "ppermute": force the collective form (device-assembled,
+        # donated rank-major buffer).  "host" keeps the numpy-staged
+        # assembly (the measurable pre-zero-copy baseline; bench.py's
+        # comms_p2p rung times all three).
+        self.p2p_staging = p2p_staging
         self._mc = MeshComms(axis, self.mesh.shape[axis])
         self._requests: List[_Request] = []
         self._aborted = False
@@ -136,7 +174,8 @@ class HostComms:
     # ------------------------------------------------------------------ #
     # eager collective execution
     # ------------------------------------------------------------------ #
-    def _run(self, key: tuple, fn, *args):
+    def _run(self, key: tuple, fn, *args, donate: bool = False,
+             payload_bytes: Optional[int] = None):
         """Policy layer for one eager verb: fail fast if the communicator
         is latched aborted (the ``ncclCommAbort`` contract,
         std_comms.hpp:443-475), apply the :attr:`retry_policy` around the
@@ -164,16 +203,30 @@ class HostComms:
         self._ensure_alive(verb)
         timer = self._series("timer", "raft_tpu_comms_verb_seconds",
                              verb, "eager verb latency (incl. retries)")
+        # payload bytes captured BEFORE execution: a donated send
+        # buffer is consumed by the call and its handle deleted.  The
+        # p2p path passes its own count (actual send-row bytes, not
+        # the rank-major staging buffer with its blank rows) so the
+        # counter means the same thing on every staging arm.
+        if payload_bytes is None:
+            payload_bytes = sum(int(getattr(a, "nbytes", 0))
+                                for a in args)
+        # donation composes with retries only if the inputs survive a
+        # failed attempt; an injected fault at the _execute seam raises
+        # before the program consumes anything, but a real mid-program
+        # failure may not — so the fast path donates only when no
+        # retry could replay the (now consumed) buffer
+        donate = donate and self.retry_policy is None
         try:
             with timer.time():
                 if self.retry_policy is None:
-                    out = self._execute(key, fn, *args)
+                    out = self._execute(key, fn, *args, donate=donate)
                 else:
                     out = self.retry_policy.call(
                         self._execute, key, fn, *args, verb=verb)
             self._series("counter", "raft_tpu_comms_bytes_total", verb,
                          "payload bytes moved by eager verbs").inc(
-                sum(int(getattr(a, "nbytes", 0)) for a in args))
+                payload_bytes)
             return out
         except CALLER_BUG_ERRORS:
             raise
@@ -208,13 +261,23 @@ class HostComms:
         self._series_cache[(name, verb)] = (gen, series)
         return series
 
-    def _execute(self, key: tuple, fn, *args):
+    def _execute(self, key: tuple, fn, *args, donate: bool = False):
         """shard_map-execute ``fn(mesh_comms-visible blocks)`` with
         rank-major in/out over the mesh axis.  Programs are cached by
         ``key`` (verb + static parameters) so repeated eager calls reuse
         the compiled executable — jax.jit's own cache keys on function
-        identity, which a fresh lambda per call would always miss."""
+        identity, which a fresh lambda per call would always miss.
+
+        ``donate=True`` compiles the program with ``donate_argnums=0``:
+        the rank-major input buffer is consumed and its storage may be
+        recycled for the output.  Only internally-assembled buffers
+        (the p2p staging buffer waitall builds) are ever donated —
+        collective verbs take CALLER arrays and never donate them
+        (docs/ZERO_COPY.md donation contract).  The flag is part of the
+        cache key: a donating and a non-donating program for the same
+        verb must not alias."""
         verb = key[0]
+        key = key + (("donate",) if donate else ())
         prog = self._progs.get(key)
         if prog is None:
             self._series("counter",
@@ -223,7 +286,8 @@ class HostComms:
             spec = P(self.axis)
             prog = jax.jit(shard_map(
                 fn, mesh=self.mesh, in_specs=spec, out_specs=spec,
-                check_rep=False))
+                check_rep=False),
+                donate_argnums=(0,) if donate else ())
             self._progs[key] = prog
             # the jit is lazy, so the first execution carries the
             # compile: attribute it to compile_seconds (compile +
@@ -341,18 +405,61 @@ class HostComms:
         self._requests.append(req)
         return req
 
-    def waitall(self, requests: Optional[Sequence[_Request]] = None) -> None:
-        """Match queued sends/recvs and execute them.  Matched pairs are
-        partitioned into disjoint permutation layers (unique source AND
-        destination per layer — a ppermute must be a bijection), one
-        ppermute each.  Unmatched requests raise, standing in for the
-        reference's UCX progress-timeout abort (std_comms.hpp:234-298).
+    def waitall(self, requests: Optional[Sequence[_Request]] = None,
+                staging: Optional[str] = None) -> None:
+        """Match queued sends/recvs and execute them.  Unmatched
+        requests raise, standing in for the reference's UCX
+        progress-timeout abort (std_comms.hpp:234-298).
+
+        ``staging`` (default: the communicator's :attr:`p2p_staging`)
+        picks the data path, see the module doc:
+
+        - ``"device"`` — zero host-staged bytes.  On a 1-D
+          single-controller mesh each matched pair is ONE direct
+          device-to-device transfer (``jax.device_put`` of the send
+          buffer onto the receiver's device — the in-memory analog of
+          the reference handing UCX a device pointer,
+          std_comms.hpp:204); mixed shapes/dtypes need no grouping at
+          all.  Where per-pair placement is impossible (multi-process,
+          multi-axis mesh) — or a fault injector holds the ``_execute``
+          seam, which the direct path would bypass — it degrades to the
+          ``"ppermute"`` path below, still device-resident.
+        - ``"ppermute"`` — the collective form: pairs grouped by
+          (shape, dtype) — heterogeneous payloads are legal, each group
+          runs its own programs — partitioned into disjoint permutation
+          layers (unique source AND destination per layer — a ppermute
+          must be a bijection), one ppermute each; the rank-major input
+          is assembled on device over shared zero blanks and DONATED to
+          the compiled program.  Zero host-staged bytes.
+        - ``"host"`` — the historical numpy-staged baseline; counts
+          every staged byte into ``raft_tpu_comms_host_staged_bytes``
+          (which this method always materializes, so a zero on the
+          device paths is a measurement, not a missing series).
+
+        Placement contract (docs/ZERO_COPY.md): each recv result is
+        COMMITTED to the receiving rank's device on every staging arm —
+        where a real per-rank process would find its recv buffer, and
+        why no consolidation copy is paid.  A single-controller caller
+        combining results from *different* ranks in one jitted op must
+        ``jax.device_put`` them to a common device first (JAX raises
+        "incompatible devices" otherwise; the pre-zero-copy behavior of
+        returning default-device copies paid a host bounce for the
+        convenience).
 
         Success or failure, the requests this call waited on are
         *consumed* (dequeued) — the reference's timeout abort likewise
         fails its requests.  A stale unmatched request must not poison
         every later ``waitall()`` on the communicator."""
         self._ensure_alive("waitall")
+        if staging is None:
+            staging = self.p2p_staging
+        expects(staging in ("device", "ppermute", "host"),
+                "waitall: staging must be 'device', 'ppermute' or "
+                "'host', got %r", staging)
+        staged_c = self._series(
+            "counter", "raft_tpu_comms_host_staged_bytes", "p2p",
+            "payload bytes bounced through host numpy on the p2p path "
+            "(0 on the device-resident path, docs/ZERO_COPY.md)")
         reqs = list(requests) if requests is not None else list(self._requests)
         try:
             sends = [r for r in reqs if r.kind == "send"]
@@ -376,38 +483,206 @@ class HostComms:
             expects(not leftover,
                     "waitall: %d unmatched irecv(s)", len(leftover))
 
-            # greedy layering: each layer is a bijection (src/dst unique)
-            layers: List[List[Tuple[_Request, _Request]]] = []
+            devs = self._rank_devices()
+            if (staging == "device" and devs is not None
+                    and not self._execute_is_patched()):
+                self._direct_p2p(pairs, devs)
+                return
+
+            # group by payload (shape, dtype): ppermute operands are
+            # homogeneous, but the *request set* need not be — this is
+            # what drops the old uniform-shape restriction
+            groups: Dict[tuple, List[Tuple[_Request, _Request]]] = {}
             for s, r in pairs:
-                placed = False
-                for layer in layers:
-                    if all(s.rank != ls.rank and s.peer != ls.peer
-                           and s.data.shape == ls.data.shape
-                           and s.data.dtype == ls.data.dtype
-                           for ls, _ in layer):
-                        layer.append((s, r))
-                        placed = True
-                        break
-                if not placed:
-                    layers.append([(s, r)])
+                gkey = (tuple(s.data.shape), jnp.dtype(s.data.dtype).name)
+                groups.setdefault(gkey, []).append((s, r))
 
             size = self.get_size()
-            for layer in layers:
-                perm = [(s.rank, s.peer) for s, _ in layer]
-                shape = layer[0][0].data.shape
-                dtype = layer[0][0].data.dtype
-                buf = np.zeros((size,) + shape, dtype)
-                for s, _ in layer:
-                    buf[s.rank] = np.asarray(s.data)
-                out = self._run(("p2p", tuple(perm)),
-                                lambda b: self._mc.device_sendrecv(b, perm),
-                                jnp.asarray(buf))
-                for s, r in layer:
-                    r.result = out[r.rank]
+            for (shape, dtype_name), gpairs in groups.items():
+                dtype = jnp.dtype(dtype_name)
+                # greedy layering within the group: each layer is a
+                # bijection (src/dst unique)
+                layers: List[List[Tuple[_Request, _Request]]] = []
+                for s, r in gpairs:
+                    placed = False
+                    for layer in layers:
+                        if all(s.rank != ls.rank and s.peer != ls.peer
+                               for ls, _ in layer):
+                            layer.append((s, r))
+                            placed = True
+                            break
+                    if not placed:
+                        layers.append([(s, r)])
+
+                for layer in layers:
+                    perm = [(s.rank, s.peer) for s, _ in layer]
+                    if staging in ("device", "ppermute"):
+                        buf = self._assemble_device(layer, shape, dtype)
+                        donate = True
+                    else:
+                        buf_np = np.zeros((size,) + shape, dtype)
+                        for s, _ in layer:
+                            # comms-host-ok: counted staging baseline
+                            buf_np[s.rank] = np.asarray(s.data)  # comms-host-ok: baseline
+                        staged_c.inc(int(buf_np.nbytes))
+                        buf = jnp.asarray(buf_np)
+                        donate = False
+                    out = self._run(
+                        ("p2p", tuple(perm)),
+                        lambda b, perm=perm: self._mc.device_sendrecv(
+                            b, perm),
+                        buf, donate=donate,
+                        payload_bytes=sum(int(s.data.nbytes)
+                                          for s, _ in layer))
+                    rows = self._result_rows(out)
+                    for s, r in layer:
+                        r.result = (rows[r.rank] if rows is not None
+                                    else out[r.rank])
         finally:
             done = {id(r) for r in reqs}
             self._requests = [r for r in self._requests
                               if id(r) not in done]
+
+    def _rank_devices(self):
+        """Rank-ordered device list when per-rank placement is legal
+        (single-controller, 1-D mesh); None otherwise."""
+        if jax.process_count() != 1 or len(self.mesh.axis_names) != 1:
+            return None
+        return list(self.mesh.devices.ravel())
+
+    def _execute_is_patched(self) -> bool:
+        """True while a :mod:`raft_tpu.comms.faults` injector (or any
+        monkeypatch) holds the ``_execute`` seam.  The direct p2p path
+        never reaches ``_execute``, so taking it would silently walk
+        around an attached fault harness — fall back to the program
+        path instead, where every fault stays observable."""
+        inst = self.__dict__.get("_execute")
+        return (inst is not None
+                and getattr(inst, "__func__", None)
+                is not HostComms._execute)
+
+    def _direct_p2p(self, pairs, devs) -> None:
+        """The per-pair zero-copy fast path: each matched (send, recv)
+        is one device-to-device ``jax.device_put`` of the send buffer
+        onto the receiver's rank device — no staging buffer, no
+        collective, no host bounce (the reference's GPU-direct UCX tag
+        send, std_comms.hpp:204).  Mixed shapes/dtypes are trivially
+        fine: pairs are independent transfers.  The send buffer is NOT
+        consumed (nothing is donated on this path — there is no
+        intermediate to recycle)."""
+        timer = self._series("timer", "raft_tpu_comms_verb_seconds",
+                             "p2p", "eager verb latency (incl. retries)")
+        payload = sum(int(getattr(s.data, "nbytes", 0))
+                      for s, _ in pairs)
+        # same failure taxonomy as _run (PR 1 contract): an
+        # unrecoverable transfer failure — possibly mid-ring, earlier
+        # pairs already moved — latches the abort and surfaces as
+        # CommError, never a raw backend exception
+        try:
+            with timer.time():
+                for s, r in pairs:
+                    if self.retry_policy is None:
+                        r.result = jax.device_put(s.data, devs[r.rank])
+                    else:
+                        r.result = self.retry_policy.call(
+                            jax.device_put, s.data, devs[r.rank],
+                            verb="p2p")
+        except CALLER_BUG_ERRORS:
+            raise
+        except (CommAbortedError, CommTimeoutError):
+            self.abort()
+            raise
+        except Exception as e:
+            self.abort()
+            raise CommError(
+                "p2p direct transfer failed unrecoverably%s; "
+                "communicator aborted: %s"
+                % ("" if self.retry_policy is None
+                   else " after %d attempts"
+                        % (self.retry_policy.max_retries + 1),
+                   e)) from e
+        self._series("counter", "raft_tpu_comms_bytes_total", "p2p",
+                     "payload bytes moved by eager verbs").inc(payload)
+
+    def _assemble_device(self, layer, shape, dtype) -> jnp.ndarray:
+        """Build the rank-major p2p input ON DEVICE — zero host-staged
+        bytes either way:
+
+        - wide rows (>= :data:`_SHARDED_MIN_ROW_BYTES`) on a 1-D
+          single-controller mesh: each send row is placed directly on
+          its rank's device and the global array is assembled
+          shard-by-shard (``make_array_from_single_device_arrays``) —
+          the program consumes it with NO resharding step, the
+          in-memory analog of the reference handing UCX a device
+          pointer.  Non-sending ranks get a shared
+          :func:`zeros_cached` blank.
+        - narrow rows (or multi-process / multi-axis meshes): one
+          ``jnp.stack`` over the rows — per-rank placement costs more
+          dispatches than it saves below the threshold (measured on the
+          8-device virtual mesh; bench.py's ``comms_p2p`` rung).
+
+        Every row passes through an eager ``[None]``-reshape /
+        ``stack`` copy, so the assembled buffer owns FRESH storage —
+        safe to donate without consuming caller arrays
+        (docs/ZERO_COPY.md)."""
+        size = self.get_size()
+        by_rank = {s.rank: s.data for s, _ in layer}
+        devs = self._rank_devices()
+        row_bytes = (int(np.prod(shape, dtype=np.int64))
+                     * jnp.dtype(dtype).itemsize)
+        if devs is None or row_bytes < _SHARDED_MIN_ROW_BYTES:
+            blank = _zeros_cached(shape, dtype)
+            rows = [by_rank.get(rk, blank) for rk in range(size)]
+            # COMMITTED rows (e.g. a prior round's direct-p2p results,
+            # each living on its own device) break the naive stack
+            # twice over: jnp.stack over distinct committed devices
+            # raises "incompatible devices", and even a same-device
+            # committed stack makes the shard_map program refuse to
+            # reshard its input.  Normalize onto one device, then
+            # place rank-major over the mesh — all device-to-device
+            # moves, the host-staged counter stays untouched.
+            placed = {i: frozenset(r.sharding.device_set)
+                      for i, r in enumerate(rows)
+                      if getattr(r, "committed", False)}
+            if len(set(placed.values())) > 1:
+                tgt = min((d for ds in placed.values() for d in ds),
+                          key=lambda d: d.id)
+                for i, ds in placed.items():
+                    if ds != frozenset((tgt,)):
+                        rows[i] = jax.device_put(rows[i], tgt)
+            buf = jnp.stack(rows)
+            if placed:
+                buf = jax.device_put(
+                    buf, NamedSharding(self.mesh, P(self.axis)))
+            return buf
+        shards = []
+        for rk in range(size):
+            data = by_rank.get(rk)
+            row = (data if data is not None
+                   else _zeros_cached(shape, dtype))[None]
+            shards.append(jax.device_put(row, devs[rk]))
+        return jax.make_array_from_single_device_arrays(
+            (size,) + shape, NamedSharding(self.mesh, P(self.axis)),
+            shards)
+
+    def _result_rows(self, out):
+        """Per-rank result rows as shard-local views ({rank: row}), or
+        None when the output is not one-row-per-rank shard-addressable
+        (multi-process, host-view numpy, odd layouts) and the caller
+        must fall back to global indexing.  Indexing a sharded global
+        array row-by-row gathers cross-device per slice — the shard
+        view is the zero-copy read."""
+        shards = getattr(out, "addressable_shards", None)
+        if not shards or len(shards) != out.shape[0]:
+            return None
+        rows = {}
+        for sh in shards:
+            idx = sh.index[0] if sh.index else None
+            if (not isinstance(idx, slice) or idx.start is None
+                    or (idx.stop or 0) - idx.start != 1):
+                return None
+            rows[idx.start] = sh.data[0]
+        return rows if len(rows) == out.shape[0] else None
 
     # device_send/recv parity shims: in the reference these are the
     # stream-ordered NCCL p2p verbs (comms.hpp:508,522); here they share
@@ -455,9 +730,12 @@ class HostComms:
             members = sorted(
                 (r for r in range(size) if colors[r] == color),
                 key=lambda r: (keys[r], r))
-            sub_mesh = Mesh(np.asarray([devs[r] for r in members]), (self.axis,))
+            sub_mesh = Mesh(
+                np.asarray([devs[r] for r in members]),  # comms-host-ok: device handles
+                (self.axis,))
             out[color] = HostComms(sub_mesh, self.axis,
-                                   retry_policy=self.retry_policy)
+                                   retry_policy=self.retry_policy,
+                                   p2p_staging=self.p2p_staging)
         return out
 
     # ------------------------------------------------------------------ #

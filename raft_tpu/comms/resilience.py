@@ -124,8 +124,36 @@ class RetryPolicy:
 
         t = threading.Thread(target=runner, daemon=True,
                              name="raft-tpu-comms-watchdog-worker")
+        # handshake with the fault seam (faults.Delay.apply): the
+        # runner commits to dispatching and the watchdog abandons under
+        # the SAME lock, so a stall whose duration straddles the
+        # deadline resolves to exactly one of {bailed, committed} — no
+        # check-then-act window where the runner reads a stale flag and
+        # dispatches its program late anyway
+        t.raft_tpu_abandon_lock = threading.Lock()
         t.start()
         if not done.wait(self.timeout):
+            with t.raft_tpu_abandon_lock:
+                committed = getattr(t, "raft_tpu_dispatch_committed",
+                                    False)
+                if not committed:
+                    t.raft_tpu_abandoned = True
+            if committed:
+                # the runner won the boundary race: its program is
+                # already dispatching, and overlapping the retry with
+                # it is the rendezvous deadlock this machinery exists
+                # to suppress — grant one extra deadline for the
+                # in-flight dispatch to drain.  If it drains, USE the
+                # outcome: discarding a completed collective and
+                # re-running it is pure duplicate device work, and on
+                # real hardware a rank re-running a collective the
+                # other ranks completed once desyncs the mesh.  An
+                # attempt that outlives the grace too is abandoned
+                # mid-program, the documented residual risk.
+                if done.wait(self.timeout):
+                    if "error" in box:
+                        raise box["error"]
+                    return box["result"]
             raise CommTimeoutError(
                 "verb exceeded its %.3fs watchdog deadline" % self.timeout)
         if "error" in box:

@@ -100,6 +100,12 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
 _RUNTIME_KNOBS = frozenset(
     ("serve_bucket_rungs", "serve_max_wait_ms", "serve_queue_cap"))
 
+# sentinel for "no layer claimed this knob" during resolution — distinct
+# from None, which a caller may store in an override frame to mean
+# "revert to env/default inside this scope" (configure() expresses the
+# same revert by popping its entry)
+_UNSET = object()
+
 _values: Dict[str, Optional[str]] = {}
 _tls = threading.local()
 # knob -> set of values already handed to some trace (consumed); used
@@ -112,6 +118,26 @@ def _frames():
     return getattr(_tls, "frames", ())
 
 
+def _resolve(name: str) -> Optional[str]:
+    """One knob through the full layer order (module doc): innermost
+    override frame → configure() value → env → default.  _UNSET means
+    no layer claimed it; a literal None in a frame is the scoped
+    "revert to env/default" (configure(knob=None) pops its entry; a
+    scoped frame cannot pop, so the revert is interpreted here).  The
+    single copy of this dance — get() and describe() must never skew."""
+    env, default, _ = _KNOBS[name]
+    val = _UNSET
+    for frame in reversed(_frames()):
+        if name in frame:
+            val = frame[name]
+            break
+    if val is _UNSET and name in _values:
+        val = _values[name]
+    if val is _UNSET or val is None:
+        val = os.environ.get(env, default)
+    return val
+
+
 def get(name: str) -> Optional[str]:
     """Resolve a knob (module-doc order) and mark it consumed.
 
@@ -119,17 +145,7 @@ def get(name: str) -> Optional[str]:
     call sites keep their own whitelists so an env-var typo fails with
     the site's error message, exactly as before.
     """
-    env, default, _ = _KNOBS[name]
-    val = None
-    for frame in reversed(_frames()):
-        if name in frame:
-            val = frame[name]
-            break
-    else:
-        if name in _values:
-            val = _values[name]
-        else:
-            val = os.environ.get(env, default)
+    val = _resolve(name)
     with _lock:
         _consumed.setdefault(name, set()).add(val)
     return val
@@ -150,6 +166,15 @@ def _check(name: str, value: Optional[str]) -> None:
 def _warn_if_consumed(name: str, value: Optional[str]) -> None:
     if name in _RUNTIME_KNOBS:
         return
+    if value is None:
+        # knob=None is the REVERT spelling (configure pops, override
+        # stores a scoped None that get() resolves through): the value
+        # consumers will now observe is env/default, so that is what
+        # the staleness comparison must use — warning on the literal
+        # None claimed "changed to None" for reverts that change
+        # nothing
+        env, default, _ = _KNOBS[name]
+        value = os.environ.get(env, default)
     with _lock:
         seen = _consumed.get(name)
         if seen and value not in seen:
@@ -175,7 +200,11 @@ def configure(**knobs: Optional[str]) -> None:
 
 @contextmanager
 def override(**knobs: Optional[str]) -> Iterator[None]:
-    """Scoped knob values (thread-local; nestable, innermost wins)."""
+    """Scoped knob values (thread-local; nestable, innermost wins).
+
+    ``override(knob=None)`` reverts the knob to its env/default inside
+    the scope — the scoped spelling of ``configure(knob=None)`` — it
+    does NOT pin a literal None over outer layers."""
     for name, value in knobs.items():
         _check(name, value)
         _warn_if_consumed(name, value)
@@ -190,14 +219,4 @@ def override(**knobs: Optional[str]) -> Iterator[None]:
 
 def describe() -> Dict[str, Optional[str]]:
     """Current effective value of every knob (no consumption mark)."""
-    out = {}
-    for name, (env, default, _) in _KNOBS.items():
-        val = None
-        for frame in reversed(_frames()):
-            if name in frame:
-                val = frame[name]
-                break
-        else:
-            val = _values.get(name, os.environ.get(env, default))
-        out[name] = val
-    return out
+    return {name: _resolve(name) for name in _KNOBS}

@@ -365,6 +365,16 @@ class MetricsRegistry:
         with self._lock:
             return self._families.get(name)
 
+    def family_total(self, name: str) -> float:
+        """Sum of a family's series values, 0.0 when the family was
+        never materialized — the one spelling of the "total of a
+        counter across labels" read (bench.py / tools/loadgen.py /
+        tests all share it, so absent-family handling cannot skew)."""
+        fam = self.get(name)
+        if fam is None:
+            return 0.0
+        return float(sum(s.value for _, s in fam.series()))
+
     def reset(self) -> None:
         """Drop every family (test isolation / stats-window rollover).
         Bumps :attr:`generation` so cached series and in-flight paired

@@ -37,6 +37,7 @@ import functools
 import inspect
 import threading
 import time
+import warnings
 from typing import Dict, Optional, Tuple
 
 from raft_tpu.core import metrics as _metrics
@@ -255,6 +256,32 @@ _jit_lock = threading.Lock()
 _jit_stats: Dict[Tuple[str, Tuple], Dict[str, float]] = {}
 
 
+_DONATION_WARNING_MSG = ".*donated buffers were not usable.*"
+
+
+def _ensure_donation_warning_filter():
+    """Silence XLA's "donated buffers were not usable" compile warning.
+    Donation in this repo is always DELIBERATE best-effort buffer
+    recycling — when a program's output geometry cannot alias the
+    donated input, XLA simply keeps a copy, which is the documented
+    acceptable outcome (docs/ZERO_COPY.md), not a caller bug worth a
+    per-compile warning.  A module-level filter rather than a
+    per-compile ``warnings.catch_warnings()`` block: that context
+    mutates process-global filter state non-thread-safely, and compiles
+    now happen on serve worker threads.  Re-checked before every
+    donating compile (not installed once): pytest and any user
+    ``catch_warnings`` block restore ``warnings.filters`` wholesale,
+    silently discarding an entry installed earlier — scanning for the
+    filter and re-adding it when missing survives those resets, and an
+    idempotent scan never grows the filter list."""
+    with _jit_lock:
+        for f in warnings.filters:
+            if (f[0] == "ignore" and f[1] is not None
+                    and f[1].pattern == _DONATION_WARNING_MSG):
+                return
+        warnings.filterwarnings("ignore", message=_DONATION_WARNING_MSG)
+
+
 def _static_key(v):
     """Statics key by the object itself (jax.jit's contract: statics
     are hashable and compared by __eq__) — the object living inside the
@@ -307,7 +334,8 @@ def reset_compile_cache_stats() -> None:
 
 
 def profiled_jit(fn=None, *, name: Optional[str] = None,
-                 static_argnames: Tuple[str, ...] = ()):
+                 static_argnames: Tuple[str, ...] = (),
+                 donate_argnames: Tuple[str, ...] = ()):
     """``jax.jit`` with compile-cache observability.
 
     Keys an explicit executable cache on (function, input avals, static
@@ -327,16 +355,29 @@ def profiled_jit(fn=None, *, name: Optional[str] = None,
     attribution, never a behavior change.  Functions with ``*args`` /
     ``**kwargs`` are not AOT-split; they get hit/miss counting with the
     lazy path only.
+
+    ``donate_argnames`` passes through to ``jax.jit`` (preserved by the
+    AOT lower/compile path): the named arrays are CONSUMED by the call
+    — XLA may recycle their buffers for outputs and the caller's
+    reference is deleted.  The zero-copy donation contract (which
+    raft_tpu entry points consume which arrays) is documented in
+    docs/ZERO_COPY.md.
     """
     if fn is None:
         return functools.partial(profiled_jit, name=name,
-                                 static_argnames=static_argnames)
+                                 static_argnames=static_argnames,
+                                 donate_argnames=donate_argnames)
 
     import jax
 
     fn_name = name or getattr(fn, "__name__", "jit_fn")
     statics = tuple(static_argnames)
-    jitted = jax.jit(fn, static_argnames=statics) if statics else jax.jit(fn)
+    jit_kw = {}
+    if statics:
+        jit_kw["static_argnames"] = statics
+    if donate_argnames:
+        jit_kw["donate_argnames"] = tuple(donate_argnames)
+    jitted = jax.jit(fn, **jit_kw)
     sig = inspect.signature(fn)
     # *args/**kwargs/positional-only signatures can't be normalized to
     # by-name calls; they get hit/miss counting on the lazy path only
@@ -393,6 +434,10 @@ def profiled_jit(fn=None, *, name: Optional[str] = None,
             st = _jit_stats.setdefault(
                 (fn_name, key), {"hits": 0, "misses": 0, "compile_s": 0.0})
         if entry is None:
+            if donate_argnames:
+                # the warning only fires at compile time, so the miss
+                # path is the one place the filter must be live
+                _ensure_donation_warning_filter()
             _metric("counter", "raft_tpu_jit_cache_misses_total",
                     help="instrumented-jit compile-cache misses").inc()
             t0 = time.perf_counter()

@@ -15,6 +15,11 @@ story the reference's mr layer provides to eager callers:
 - :class:`PoolAllocator` — freelist reuse of same-(shape, dtype)
   buffers for eager loops holding large scratch arrays (the role of
   RMM's pool_memory_resource for repeated workspace allocations).
+- :class:`ZerosPool` / :func:`zeros_cached` — shared device-resident
+  zero blocks keyed by (shape, dtype) for the eager pad/assembly hot
+  paths (serve bucketing, mnmg index pad, comms p2p staging): jax
+  arrays are immutable, so one cached block replaces a fresh
+  ``jnp.zeros`` per call (docs/ZERO_COPY.md).
 - :func:`device_memory_stats` — bytes in use / limit from the device
   (``cudaMemGetInfo``'s role, cudart_utils.h).
 - the native *host* arena (cpp/include/raft_tpu/arena.hpp, exposed via
@@ -30,12 +35,18 @@ from raft_tpu.mr.buffer import (
     DeviceBuffer,
     HostBuffer,
     PoolAllocator,
+    ZerosPool,
+    default_zeros_pool,
     device_memory_stats,
+    zeros_cached,
 )
 
 __all__ = [
     "DeviceBuffer",
     "HostBuffer",
     "PoolAllocator",
+    "ZerosPool",
+    "default_zeros_pool",
     "device_memory_stats",
+    "zeros_cached",
 ]

@@ -11,6 +11,8 @@ size and the live-byte count instead of the raw backend error."""
 
 from __future__ import annotations
 
+import collections
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -280,3 +282,119 @@ class PoolAllocator:
             for b in bs:
                 b.deallocate()
         self._free.clear()
+
+
+class ZerosPool:
+    """Device-resident zero-block cache keyed by (shape, dtype).
+
+    The zero-copy data path (docs/ZERO_COPY.md) keeps needing the same
+    constant zero blocks on device: serve's pad-to-bucket tail, the
+    mnmg ring-merge index pad, the comms p2p rank-major assembly rows.
+    ``jnp.pad``/``jnp.zeros`` materialize a *fresh* device zeros region
+    per call — pure ``device_put`` churn for a value that never changes.
+    jax arrays are immutable, so ONE cached block per (shape, dtype)
+    can be shared by every concurrent reader forever; consumers compose
+    it with ``jnp.concatenate`` / ``jnp.stack`` instead of re-creating
+    it.  (Contrast :class:`PoolAllocator`, whose buffers are owned
+    exclusively and carry arbitrary stale contents.)
+
+    Bounded LRU — by block count (``max_entries``) AND by total bytes
+    (``max_bytes``): a count-only bound would let 64 wide serve tails
+    pin hundreds of MiB of device memory for the process lifetime.  A
+    single block larger than ``max_bytes`` is returned fresh and never
+    cached (caching it would evict everything else for a shape too big
+    to plausibly recur).  Thread-safe; hit/miss counters land in the
+    registry (``raft_tpu_mr_zeros_pool_{hits,misses}_total``).
+    ``Session.destroy()`` releases the default pool.
+    """
+
+    def __init__(self, max_entries: int = 64,
+                 max_bytes: int = 64 << 20,
+                 device: Optional[jax.Device] = None):
+        expects(max_entries >= 1, "ZerosPool: max_entries=%d", max_entries)
+        expects(max_bytes >= 1, "ZerosPool: max_bytes=%d", max_bytes)
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.device = device
+        self._lock = threading.Lock()
+        self._blocks: "collections.OrderedDict[Tuple, jax.Array]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self.n_hits = 0
+        self.n_misses = 0
+
+    @staticmethod
+    def _key_bytes(key) -> int:
+        shape, dname = key
+        return (int(np.prod(shape, dtype=np.int64))
+                * jnp.dtype(dname).itemsize)
+
+    def _counter(self, name: str):
+        return _metrics.default_registry().counter(
+            name, help="zeros-pool block reuse (docs/ZERO_COPY.md)")
+
+    def get(self, shape, dtype=jnp.float32) -> jax.Array:
+        """The shared zero block for (shape, dtype).  Read-only by
+        convention — callers must only compose it (concatenate/stack/
+        where), never donate it to an executable or adopt-and-delete
+        it; ``.at[].set`` is fine (functional update, fresh result)."""
+        key = (tuple(int(s) for s in shape), jnp.dtype(dtype).name)
+        nbytes = self._key_bytes(key)
+        with self._lock:
+            blk = self._blocks.get(key)
+            if blk is not None and not blk.is_deleted():
+                self._blocks.move_to_end(key)
+                self.n_hits += 1
+                self._counter("raft_tpu_mr_zeros_pool_hits_total").inc()
+                return blk
+            self.n_misses += 1
+            self._counter("raft_tpu_mr_zeros_pool_misses_total").inc()
+        # allocate outside the lock (a device allocation can be slow);
+        # a racing duplicate is harmless — last writer wins the slot
+        blk = jnp.zeros(key[0], dtype)
+        if self.device is not None:
+            blk = jax.device_put(blk, self.device)
+        if nbytes > self.max_bytes:
+            return blk                 # oversize: never cached
+        with self._lock:
+            if key not in self._blocks:
+                self._bytes += nbytes
+            self._blocks[key] = blk
+            self._blocks.move_to_end(key)
+            while self._blocks and (len(self._blocks) > self.max_entries
+                                    or self._bytes > self.max_bytes):
+                old_key, _ = self._blocks.popitem(last=False)
+                self._bytes -= self._key_bytes(old_key)
+        return blk
+
+    def pooled_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def release(self) -> None:
+        """Drop every cached block (GC frees the device memory — the
+        blocks may still be referenced by in-flight consumers, so no
+        eager delete)."""
+        with self._lock:
+            self._blocks.clear()
+            self._bytes = 0
+
+
+_default_zeros_pool = ZerosPool()
+
+
+def default_zeros_pool() -> ZerosPool:
+    """The process-wide shared zeros cache (what :func:`zeros_cached`
+    reads; serve/comms/mnmg pad paths all share it)."""
+    return _default_zeros_pool
+
+
+def zeros_cached(shape, dtype=jnp.float32) -> jax.Array:
+    """Shared device-resident zeros of (shape, dtype) from the default
+    :class:`ZerosPool` — the drop-in replacement for ``jnp.zeros`` on
+    hot eager paths that re-create the same constant block per call."""
+    return _default_zeros_pool.get(shape, dtype)

@@ -398,6 +398,7 @@ def fused_knn_twophase(
     block_n: int = 1024,
     precision: str = "highest",
     interpret: Optional[bool] = None,
+    merge_select_impl: str = "topk",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest index rows: Pallas per-tile select + one XLA merge.
 
@@ -408,6 +409,13 @@ def fused_knn_twophase(
     kernel shrinks from width n to n_tiles*kpad (8x at the 100k bench
     geometry), and the kernel keeps zero cross-tile state.  Measured
     against ``merge``/``sorttile`` by ``tools/knn_kernel_sweep.py``.
+
+    ``merge_select_impl`` pins the phase-2 ``select_k`` implementation
+    and defaults to exact ``"topk"`` — the merge is part of this
+    kernel's EXACTNESS contract, so a process-wide
+    ``config.configure(select_impl="approx95")`` pin must not reach it
+    silently.  Pass a different impl explicitly to trade exactness
+    away on purpose.
     """
     expects(index.ndim == 2 and queries.ndim == 2
             and index.shape[1] == queries.shape[1],
@@ -461,7 +469,8 @@ def fused_knn_twophase(
     from raft_tpu.spatial.select_k import select_k
 
     out_d, out_i = select_k(part_d[:nq], k, select_min=True,
-                            values=part_i[:nq])
+                            values=part_i[:nq],
+                            impl=merge_select_impl)
     # deficit slots (n < kpad per tile never happens since k <= n, but
     # masked-padding lanes carry -1) — clamp in-range like the others
     return out_d, jnp.clip(out_i, 0, n - 1)

@@ -18,6 +18,20 @@ hygiene lives here).  The loop:
 4. split result rows back per request and resolve the futures.  A batch
    failure fails every rider's future — riders resubmit independently.
 
+**Overlapped dispatch** (docs/ZERO_COPY.md, the libhclooc
+host/accelerator-overlap argument): JAX dispatch is asynchronous, so
+the worker splits each batch into a *start* half (expire, coalesce,
+pad, launch the device call) and a *finish* half (block until the
+device result is ready, split, resolve).  The loop starts batch N+1's
+host-side pad/coalesce while batch N's device call is still running
+and blocks only at N's split — the accelerator never idles behind host
+batch formation under sustained load.  A :class:`RetryPolicy` forces
+the synchronous path (a retry must observe the failure before the next
+batch is formed).  With ``donate=True`` the padded input buffer is
+donated to the device function — the service guarantees its execute
+path tolerates consumption (the buffer is serve-internal; the worker
+copies in the one case it could alias a caller's array).
+
 Every step feeds the ``raft_tpu_serve_*`` metric families (labeled
 ``service=<name>``) so ``metrics_snapshot()`` / ``tools/metrics_report.py``
 surface queue depth, batch fill, wait/exec latency, padding waste and
@@ -31,6 +45,7 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import CommTimeoutError, expects
@@ -38,6 +53,22 @@ from raft_tpu.serve.batcher import MicroBatcher, _Request
 from raft_tpu.serve.bucketing import BucketPolicy, coalesce, pad_rows
 
 __all__ = ["ServeWorker"]
+
+
+class _Inflight:
+    """One launched-but-unsplit batch (the pipeline register between
+    the worker's start and finish halves)."""
+
+    __slots__ = ("live", "spans", "bucket", "payload_rows", "out",
+                 "t_launch")
+
+    def __init__(self, live, spans, bucket, payload_rows, out, t_launch):
+        self.live = live
+        self.spans = spans
+        self.bucket = bucket
+        self.payload_rows = payload_rows
+        self.out = out
+        self.t_launch = t_launch
 
 
 # -- registry helpers (resolved per use: cheap, and reset-proof — a test
@@ -82,7 +113,15 @@ class ServeWorker:
     retry_policy:
         Optional :class:`~raft_tpu.comms.resilience.RetryPolicy` around
         each device call — per-attempt watchdog deadline + backoff
-        retries, exactly PR 1's verb machinery.
+        retries, exactly PR 1's verb machinery.  Forces synchronous
+        (non-overlapped) dispatch: a retry must see its attempt fail,
+        so each attempt blocks until device-complete.
+    donate:
+        Donate the padded batch buffer to ``execute`` (the execute path
+        must route it through a donating executable or tolerate eager
+        consumption; services wire this, see docs/ZERO_COPY.md).  The
+        worker guarantees the donated buffer never aliases a caller's
+        submitted array.
     clock:
         Shared with the batcher for deadline math.
     """
@@ -91,12 +130,23 @@ class ServeWorker:
                  policy: BucketPolicy,
                  execute: Callable,
                  retry_policy=None,
+                 donate: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         self.name = name
         self._batcher = batcher
         self._policy = policy
         self._execute = execute
         self._retry_policy = retry_policy
+        # the worker OWNS the donation-eligibility rule: donation is
+        # off whenever a retry could replay the consumed buffer.
+        # Public: Service passes intent and reads the resolved value
+        # back to pick its device-fn variant — one place encodes the
+        # rule.
+        self.donate = bool(donate) and retry_policy is None
+        # payload rows launched but not yet split (worker-thread-only
+        # state; the inflight gauge publishes it — a running sum, since
+        # the pipelined loop can hold two launched batches briefly)
+        self._inflight_rows = 0
         self._clock = clock
         self._thread: Optional[threading.Thread] = None
         self._state = threading.Condition()
@@ -126,18 +176,58 @@ class ServeWorker:
             return self._thread is not None
 
     def _loop(self) -> None:
+        """Pipelined worker loop: dispatch batch N+1 while batch N's
+        device call runs (module doc).  ``pending`` is the one in-flight
+        batch; depth-1 pipelining bounds result latency at one batch
+        while already hiding host-side batch formation behind the
+        device.
+
+        A :class:`RetryPolicy` disables the pipelining outright, not
+        just the launch half: each retried attempt blocks through the
+        device call (plus watchdog and backoff) inside ``_start``, so
+        deferring the previous batch's ``_finish`` behind it would
+        delay results that were already sitting ready by the whole of
+        the next batch's (potentially retried) execution — pure loss,
+        no overlap gained."""
+        pipelined = self._retry_policy is None
+        pending = None
         while True:
-            batch = self._batcher.wait_for_batch()
-            if batch is None:
-                return
+            if pending is None:
+                batch = self._batcher.wait_for_batch()
+                if batch is None:
+                    return
+            else:
+                # opportunistic, non-blocking: if the policy has a
+                # batch ready NOW, start it before finishing the
+                # in-flight one (the overlap); otherwise complete the
+                # in-flight batch — its riders must not wait on an
+                # idle queue
+                batch = self._batcher.take()
+                if not batch:
+                    try:
+                        self._finish(pending)
+                    finally:
+                        pending = None
+                        with self._state:
+                            self._busy = False
+                            self._state.notify_all()
+                    continue
             with self._state:
                 self._busy = True
+            nxt = None
             try:
-                self.dispatch(batch)
+                if pipelined:
+                    nxt = self._start(batch)
+                else:
+                    self.dispatch(batch)
             finally:
-                with self._state:
-                    self._busy = False
-                    self._state.notify_all()
+                if pending is not None:
+                    self._finish(pending)
+                pending = nxt
+                if pending is None:
+                    with self._state:
+                        self._busy = False
+                        self._state.notify_all()
 
     def run_once(self) -> bool:
         """Manual stepping for threadless/deterministic operation: form
@@ -221,42 +311,114 @@ class ServeWorker:
     def dispatch(self, batch: Sequence[_Request]) -> None:
         """Run one formed batch to completion (never raises: every
         failure lands on the riders' futures — a poisoned batch must
-        not kill the loop serving everyone else)."""
+        not kill the loop serving everyone else).  Synchronous
+        start+finish — the manual-stepping (``run_once``) and drain
+        entry point; the worker loop pipelines the two halves."""
+        inflight = self._start(batch)
+        if inflight is not None:
+            self._finish(inflight)
+
+    def _start(self, batch: Sequence[_Request]
+               ) -> Optional["_Inflight"]:
+        """Host half: expire, coalesce, pad, LAUNCH the device call
+        (async dispatch — does not wait for the result).  Returns the
+        in-flight record, or None if nothing survived / the launch
+        failed (riders already resolved).  Never raises."""
         now = self._clock()
         _gauge("raft_tpu_serve_queue_depth", "requests queued",
                self.name).set(self._batcher.depth())
         live = self._expire_locked_out(list(batch), now)
         if not live:
-            return
+            return None
         wait_t = _timer("raft_tpu_serve_wait_seconds",
                         "enqueue-to-dispatch queue wait", self.name)
         for req in live:
             wait_t.observe(max(0.0, now - req.enqueue_t))
         payload_rows = sum(r.rows for r in live)
-        bucket = 0
+        launched = False
         try:
             bucket = self._policy.bucket_for(payload_rows)
             stacked, spans = coalesce([r.payload for r in live])
             padded = pad_rows(stacked, bucket)
+            if (self.donate and len(live) == 1
+                    and padded is live[0].payload):
+                # sole case where the "padded" buffer IS the caller's
+                # submitted array (one request, exactly rung-sized, no
+                # dtype copy): donation would consume the caller's
+                # data — pay one defensive copy instead
+                padded = jnp.copy(padded)
+            # the gauge tracks a running SUM: under the pipelined loop
+            # batch N+1 launches before batch N's _finish, so set/zero
+            # per batch would read 0 while a call is actually in flight
+            self._inflight_rows += payload_rows
+            launched = True
             _gauge("raft_tpu_serve_inflight_rows",
-                   "payload rows in the running device call",
-                   self.name).set(payload_rows)
-            exec_t = _timer("raft_tpu_serve_exec_seconds",
-                            "padded device call latency", self.name)
+                   "payload rows in launched, not-yet-split device "
+                   "calls", self.name).set(self._inflight_rows)
+            t_launch = self._clock()
             if self._retry_policy is not None:
-                with exec_t.time():
-                    out = self._retry_policy.call(
-                        self._execute, padded,
-                        verb="serve.%s" % self.name)
+                # synchronous: each attempt must surface its own
+                # device failure INSIDE the retry loop, so block per
+                # attempt (module doc)
+                def attempt(p):
+                    res = self._execute(p)
+                    jax.block_until_ready(
+                        [x for x in jax.tree_util.tree_leaves(res)
+                         if hasattr(x, "shape")])
+                    return res
+
+                out = self._retry_policy.call(
+                    attempt, padded, verb="serve.%s" % self.name)
             else:
-                with exec_t.time():
-                    out = self._execute(padded)
+                out = self._execute(padded)
+            return _Inflight(live, spans, bucket, payload_rows, out,
+                             t_launch)
+        except Exception as e:  # noqa: BLE001 — relayed to every rider
+            _counter("raft_tpu_serve_batch_errors_total",
+                     "batches whose device call failed", self.name).inc()
+            for req in live:
+                req.future._set_exception(e)
+            if launched:
+                self._inflight_rows -= payload_rows
+            _gauge("raft_tpu_serve_inflight_rows",
+                   "payload rows in launched, not-yet-split device "
+                   "calls", self.name).set(self._inflight_rows)
+            return None
+
+    def _finish(self, inflight: "_Inflight") -> None:
+        """Device half: block until the launched call completes, split
+        rows per request, resolve futures, account.  Never raises."""
+        live, spans, bucket = (inflight.live, inflight.spans,
+                               inflight.bucket)
+        payload_rows, out = inflight.payload_rows, inflight.out
+        try:
             leaves = [x for x in jax.tree_util.tree_leaves(out)
                       if hasattr(x, "shape")]
             for leaf in leaves:
                 expects(leaf.shape[0] == bucket,
                         "serve execute contract: leaf leading dim %d != "
                         "padded batch rows %d", leaf.shape[0], bucket)
+            # THE one block point: everything host-side for the next
+            # batch already happened while this ran on device
+            t_block = self._clock()
+            jax.block_until_ready(leaves)
+            t_ready = self._clock()
+            # launch→observed-ready is an UPPER bound on device
+            # latency: under the overlapped loop the next batch's
+            # host-side formation runs between launch and this block,
+            # so a device call that finished during it is only
+            # observed ready here.  block_seconds (time actually
+            # spent blocked) is the matching lower bound on the
+            # device work remaining at split time.
+            _timer("raft_tpu_serve_exec_seconds",
+                   "padded device call latency, launch to observed "
+                   "result-ready (upper bound under the overlapped "
+                   "loop)", self.name).observe(
+                       max(0.0, t_ready - inflight.t_launch))
+            _timer("raft_tpu_serve_block_seconds",
+                   "time the worker blocked on device results "
+                   "(lower bound on device latency at split time)",
+                   self.name).observe(max(0.0, t_ready - t_block))
             for req, (start, stop) in zip(live, spans):
                 req.future._set_result(jax.tree_util.tree_map(
                     lambda leaf: leaf[start:stop], out))
@@ -267,9 +429,10 @@ class ServeWorker:
                 req.future._set_exception(e)
             return
         finally:
+            self._inflight_rows -= inflight.payload_rows
             _gauge("raft_tpu_serve_inflight_rows",
-                   "payload rows in the running device call",
-                   self.name).set(0)
+                   "payload rows in launched, not-yet-split device "
+                   "calls", self.name).set(self._inflight_rows)
         # accounting only after a successful dispatch
         _counter("raft_tpu_serve_batches_total", "dispatched batches",
                  self.name).inc()

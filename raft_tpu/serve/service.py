@@ -93,9 +93,19 @@ def _knob_int(name: str) -> int:
 # visible through compile_cache_stats() under this name.  (The kNN
 # service deliberately has no such wrapper — see the module doc — its
 # cached program is tiled_knn's existing profiled_jit.)
-@profiled_jit(name="serve_pairwise", static_argnames=("metric",))
-def _pairwise_device(y, queries, metric):
+def _pairwise_impl(y, queries, metric):
     return pairwise_distance(queries, y, metric)
+
+
+_pairwise_device = profiled_jit(
+    name="serve_pairwise", static_argnames=("metric",))(_pairwise_impl)
+# the donating twin (zero-copy serve path, docs/ZERO_COPY.md): the
+# padded batch buffer is CONSUMED by the call and recycled for the
+# output.  A separate wrapper (and stats name), not a flag — a donating
+# and a non-donating executable must never share a cache slot
+_pairwise_device_donated = profiled_jit(
+    name="serve_pairwise_donated", static_argnames=("metric",),
+    donate_argnames=("queries",))(_pairwise_impl)
 
 
 class Service:
@@ -116,6 +126,13 @@ class Service:
     retry_policy:
         Optional per-batch :class:`~raft_tpu.comms.resilience.RetryPolicy`
         (watchdog deadline + retries around the device call).
+    donate:
+        Donate the padded batch buffer to the bucketed executable
+        (docs/ZERO_COPY.md): the buffer is serve-internal, so
+        recycling it costs nothing and saves one output allocation per
+        batch.  Default: on whenever no ``retry_policy`` is set (a
+        retry would replay a consumed buffer); pass ``False`` to opt
+        out.
     query_cache_size:
         > 0 enables the :class:`VecCache` query-vector cache
         (:meth:`cache_put` / :meth:`submit_keys`).
@@ -131,6 +148,7 @@ class Service:
                  max_wait_ms: Optional[float] = None,
                  queue_cap: Optional[int] = None,
                  retry_policy=None,
+                 donate: Optional[bool] = None,
                  query_cache_size: int = 0,
                  start: bool = True,
                  clock: Callable[[], float] = time.monotonic):
@@ -140,6 +158,11 @@ class Service:
         self.dtype = jnp.dtype(dtype)
         self._execute = execute
         self._clock = clock
+        # donation INTENT only (default on): ServeWorker owns the
+        # retry-gating rule; the resolved value is read back from the
+        # worker below, and subclasses use it to pick their device-fn
+        # variant
+        donate_intent = True if donate is None else bool(donate)
         if bucket_rungs is None:
             bucket_rungs = config.get("serve_bucket_rungs")
         if max_wait_ms is None:
@@ -154,7 +177,8 @@ class Service:
             queue_cap=int(queue_cap), clock=clock)
         self.worker = ServeWorker(name, self.batcher, self.policy,
                                   execute, retry_policy=retry_policy,
-                                  clock=clock)
+                                  donate=donate_intent, clock=clock)
+        self.donate = self.worker.donate
         self._warmed: Tuple[int, ...] = ()
         self._closed = False
         self._cache_lock = threading.Lock()
@@ -350,10 +374,14 @@ class KNNService(Service):
         def execute(padded):
             # eager on purpose: bit-identical to the unbatched call
             # (module doc); the scan inside is the per-bucket cached
-            # program
+            # program.  donate_queries routes the padded buffer into
+            # the scan's donating executable twin (identical program,
+            # recycled input — docs/ZERO_COPY.md); self.donate is set
+            # by Service.__init__ before any batch can run
             return brute_force_knn(self.index, padded, self.k,
                                    metric=self.metric, tile_n=tile_n,
-                                   precision=precision)
+                                   precision=precision,
+                                   donate_queries=self.donate)
 
         super().__init__(
             name or "knn%d" % next(_service_seq), execute,
@@ -373,7 +401,9 @@ class PairwiseService(Service):
         self.metric = metric
 
         def execute(padded):
-            return _pairwise_device(self.y, padded, metric=self.metric)
+            fn = (_pairwise_device_donated if self.donate
+                  else _pairwise_device)
+            return fn(self.y, padded, metric=self.metric)
 
         super().__init__(
             name or "pairwise%d" % next(_service_seq), execute,

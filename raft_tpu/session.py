@@ -258,6 +258,15 @@ class Comms:
             self._services = {}
             self.initialized = False
             _sessions.pop(self.sessionId, None)
+            # the shared zeros cache (serve pad tails, comms assembly
+            # blanks) has no owner of its own — session teardown is its
+            # release seam; blocks are re-created on demand if another
+            # live session still needs them
+            try:
+                from raft_tpu.mr.buffer import default_zeros_pool
+                default_zeros_pool().release()
+            except Exception:
+                pass
 
     def _close_services(self) -> None:
         """Drain-then-close every registered serve worker (destroy
@@ -379,8 +388,13 @@ class Comms:
 
             if mesh is None:
                 mesh = Mesh(np.asarray(devices), (axis,))
-            self.comms = HostComms(mesh, axis,
-                                   retry_policy=self.retry_policy)
+            # carry the surviving communicator's configuration across
+            # the rebuild — dropping p2p_staging here would silently
+            # revert a pinned staging mode to the "device" default
+            # (comm_split forwards it for the same reason)
+            self.comms = HostComms(
+                mesh, axis, retry_policy=self.retry_policy,
+                p2p_staging=getattr(self.comms, "p2p_staging", "device"))
             self._mesh = mesh
             for h in self._handles:
                 inject_comms_on_handle(h, self.comms)

@@ -24,6 +24,12 @@ from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.sparse.formats import COO, CSR
 from raft_tpu.sparse import convert, op as sparse_op
 
+# the one legal-impl list for csr_spmv: shared by the call-time check
+# below, the spmv_impl knob whitelist (config._KNOBS mirrors it), and
+# SparseMatrix's construction-time validation — a typo'd pin must fail
+# where it is written, not deep inside a jitted Lanczos solve
+SPMV_IMPLS = ("segment", "cumsum", "sortscan")
+
 
 # --------------------------------------------------------------------- #
 # degree (sparse/linalg/degree.hpp)
@@ -276,8 +282,7 @@ def csr_spmv(csr: CSR, x: jnp.ndarray,
     """
     if impl is None:
         impl = config.get("spmv_impl")
-    expects(impl in ("segment", "cumsum", "sortscan"),
-            "csr_spmv: unknown impl %s", impl)
+    expects(impl in SPMV_IMPLS, "csr_spmv: unknown impl %s", impl)
     if impl == "cumsum":
         # validity needs only the entry position vs nnz (the tail is
         # padding by the container invariant) — NOT row_ids(), whose

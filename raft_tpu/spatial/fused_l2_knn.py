@@ -51,6 +51,7 @@ def fused_l2_knn(
     tile_n: int = 8192,
     precision: str = "highest",
     impl: Optional[str] = None,
+    donate_queries: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest index rows per query under squared L2.
 
@@ -68,6 +69,11 @@ def fused_l2_knn(
     impl:
         "xla", "pallas", or None = pick per backend (see module doc).
         Env override: RAFT_TPU_FUSED_KNN_IMPL.
+    donate_queries:
+        Consume the queries buffer (the xla scan path donates it to
+        its executable and recycles the storage — the caller must own
+        the buffer and not reuse it; docs/ZERO_COPY.md).  Ignored on
+        the pallas path, which has no donating kernel build.
 
     Returns
     -------
@@ -106,9 +112,12 @@ def fused_l2_knn(
     # whole tiled scan every call (r5 retrace audit); the precision
     # variant is lru-memoized and the query norms ride along as a
     # Partial operand, so repeat calls at a shape are pure cache hits
+    # qn reads queries BEFORE the (possibly donating) scan call; the
+    # runtime keeps the buffer alive for this already-dispatched read
     qn = jnp.sum(queries * queries, axis=1)
     tile_dist = jax.tree_util.Partial(_l2_tile_dist(precision), qn)
-    return tiled_knn(index, queries, k, tile_dist, tile_n=tile_n)
+    return tiled_knn(index, queries, k, tile_dist, tile_n=tile_n,
+                     donate_queries=donate_queries)
 
 
 @functools.lru_cache(maxsize=None)

@@ -121,6 +121,7 @@ def _search_one_partition(
     tile_n: int,
     precision: str = "highest",
     rerank_ratio: int = 1,
+    donate_queries: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search a single index partition; returns (distances, int32 indices).
 
@@ -143,7 +144,8 @@ def _search_one_partition(
             return _exact_rerank_l2(part, queries, i1, k)
         # fast path, reference :297-313; squared distances
         return fused_l2_knn(part, queries, k, tile_n=tile_n,
-                            precision=precision)
+                            precision=precision,
+                            donate_queries=donate_queries)
     if metric == D.Haversine:
         expects(queries.shape[1] == 2,
                 "Haversine distance requires 2 dimensions (latitude / longitude).")
@@ -187,6 +189,7 @@ def brute_force_knn(
     tile_n: int = 8192,
     precision: str = "highest",
     rerank_ratio: int = 1,
+    donate_queries: bool = False,
     handle=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN of ``queries`` against one or more index partitions.
@@ -218,7 +221,20 @@ def brute_force_knn(
         bf16 scan keeps ``k * rerank_ratio`` candidates per partition,
         then an exact f32 re-rank reduces them to k (the bf16 speed at
         ~recall-1.0 accuracy; candidates the bf16 rounding dropped from
-        stage 1 are the only possible misses).
+        stage 1 are the only possible misses).  NOTE: with
+        ``rerank_ratio > 1`` stage 1 always runs single-pass bf16
+        (``precision="default"``) REGARDLESS of this call's
+        ``precision`` argument — bf16 speed is the mode's entire point,
+        and ``precision`` governs only the single-stage path; the f32
+        re-rank restores exactness for every candidate that survived
+        stage 1.
+    donate_queries:
+        Consume the queries buffer — the single-partition L2 scan path
+        donates it to its executable and recycles the storage; the
+        caller must own the buffer and not reuse it after the call
+        (the serve layer's padded batch is the intended consumer,
+        docs/ZERO_COPY.md).  A no-op on paths without a donating
+        executable (multi-partition, rerank, non-L2 metrics).
     handle:
         Optional :class:`raft_tpu.core.handle.Handle`.  Each partition's
         search is recorded on the next pool stream (the reference forks
@@ -253,10 +269,16 @@ def brute_force_knn(
     expects(rerank_ratio == 1 or metric in _L2_FAMILY,
             "brute_force_knn: rerank_ratio applies to the L2 family only")
     select_min = metric not in _IP_FAMILY
+    # donation is legal only when exactly ONE consumer reads the
+    # queries buffer: a multi-partition search (or the rerank mode's
+    # two-stage read) would replay a consumed buffer
+    donate_queries = (donate_queries and len(parts) == 1
+                      and rerank_ratio == 1)
     results = []
     for i, p in enumerate(parts):
         r = _search_one_partition(p, queries, k, metric, metric_arg, tile_n,
-                                  precision, rerank_ratio=rerank_ratio)
+                                  precision, rerank_ratio=rerank_ratio,
+                                  donate_queries=donate_queries)
         if handle is not None:
             handle.get_next_usable_stream(i).record(*r)
         results.append(r)

@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 from raft_tpu.comms.host_comms import shard_map
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import ceildiv
+from raft_tpu.mr.buffer import zeros_cached
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.spatial.knn import _IP_FAMILY, _search_one_partition
 from raft_tpu.spatial.select_k import select_k
@@ -144,7 +145,16 @@ def mnmg_knn(
 
     rows = ceildiv(n, size)
     n_pad = rows * size
-    index_p = jnp.pad(index, ((0, n_pad - n), (0, 0)))
+    if n_pad > n:
+        # pad tail from the shared zeros cache (docs/ZERO_COPY.md):
+        # repeated mnmg searches at a geometry re-pad the same (pad, d)
+        # tail every call, and jnp.pad would materialize a fresh device
+        # zeros block each time — the cached block makes the eager pad
+        # a concatenate against an existing device buffer
+        index_p = jnp.concatenate(
+            [index, zeros_cached((n_pad - n, d), index.dtype)], axis=0)
+    else:
+        index_p = index
     select_min = metric not in _IP_FAMILY
     worst = jnp.inf if select_min else -jnp.inf
     # widen the local k by the pad count: a zero pad row can *beat* real

@@ -32,6 +32,7 @@ def tiled_knn(
     tile_dist: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     tile_n: int = 8192,
     merge: Optional[str] = None,
+    donate_queries: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k best (smallest-distance) index rows per query.
 
@@ -64,6 +65,15 @@ def tiled_knn(
       implementation, ``tile_topk`` wins.  The bench ladder measures
       both on hardware.
 
+    ``donate_queries=True`` routes the call through the DONATING twin
+    of the scan executable (identical program, ``donate_argnames=
+    ("queries",)``): the queries buffer is consumed by the call and
+    recycled — callers must own the buffer and not reuse it (the serve
+    layer's padded batch is the intended consumer; docs/ZERO_COPY.md).
+    The scan's (best_d, best_i) carry is aliased in place by XLA inside
+    the program either way — donation extends that recycling to the
+    input buffer itself.
+
     Returns (distances, indices): (n_queries, k) ascending, int32 ids.
     """
     n = index.shape[0]
@@ -77,15 +87,14 @@ def tiled_knn(
     # as a pytree (fresh closures would otherwise retrace the whole
     # scan every call — the r5 retrace audit caught exactly that on
     # brute_force_knn's steady state)
-    return _tiled_knn_run(index, queries, as_pytree_fn(tile_dist),
-                          k=k, tile_n=max(k, min(tile_n, n)),
-                          merge=merge, select_impl=_resolve_impl(None))
+    run = _tiled_knn_run_donated if donate_queries else _tiled_knn_run
+    return run(index, queries, as_pytree_fn(tile_dist),
+               k=k, tile_n=max(k, min(tile_n, n)),
+               merge=merge, select_impl=_resolve_impl(None))
 
 
-@profiled_jit(name="tiled_knn",
-              static_argnames=("k", "tile_n", "merge", "select_impl"))
-def _tiled_knn_run(index, queries, tile_dist, k, tile_n, merge,
-                   select_impl):
+def _tiled_knn_body(index, queries, tile_dist, k, tile_n, merge,
+                    select_impl):
     n = index.shape[0]
     nq = queries.shape[0]
     n_tiles = ceildiv(n, tile_n)
@@ -131,3 +140,15 @@ def _tiled_knn_run(index, queries, tile_dist, k, tile_n, merge,
             jnp.full((nq, k), jnp.iinfo(jnp.int32).max, dtype=jnp.int32))
     (best_d, best_i), _ = lax.scan(step, init, jnp.arange(n_tiles))
     return best_d, best_i
+
+
+_STATICS = ("k", "tile_n", "merge", "select_impl")
+_tiled_knn_run = profiled_jit(
+    name="tiled_knn", static_argnames=_STATICS)(_tiled_knn_body)
+# the donating twin (docs/ZERO_COPY.md): same program, the queries
+# buffer is consumed and recycled.  A separate wrapper (and stats
+# name), never a runtime flag — a donating and a non-donating
+# executable must not share a compile-cache slot
+_tiled_knn_run_donated = profiled_jit(
+    name="tiled_knn_donated", static_argnames=_STATICS,
+    donate_argnames=("queries",))(_tiled_knn_body)

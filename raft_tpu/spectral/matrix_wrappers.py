@@ -26,9 +26,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.error import expects
 from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.sparse.formats import CSR
-from raft_tpu.sparse.linalg import csr_spmv
+from raft_tpu.sparse.linalg import SPMV_IMPLS, csr_spmv
 
 # auto-densify budget (elements): 2**22 f32 = 16 MiB
 _DENSIFY_ELEMS = 1 << 22
@@ -49,6 +50,12 @@ class SparseMatrix:
 
     def __init__(self, csr: CSR, densify: bool | None = None,
                  spmv_impl: str | None = None):
+        # fail a typo'd pin HERE, at construction — not attempts deep
+        # inside the jitted Lanczos solve that consumes the operator
+        expects(spmv_impl is None or spmv_impl in SPMV_IMPLS,
+                "SparseMatrix: spmv_impl=%r not in %s (None = the "
+                "spmv_impl config knob at trace time)",
+                spmv_impl, SPMV_IMPLS)
         self.csr = csr
         if densify is None:
             densify = (is_tpu_backend()

@@ -112,6 +112,36 @@ def test_watchdog_timeout_retried_then_succeeds():
     assert tracing.get_counter("comms.fault_injected") == before_inj + 1
 
 
+def test_abandoned_delayed_attempt_never_dispatches_late():
+    """A Delay outliving the watchdog must NOT dispatch its program
+    after waking: the late collective would race the retry's (or the
+    next caller's) program and deadlock the CPU backend's shared
+    rendezvous.  The abandoned runner bails at the fault seam instead
+    (resilience marks the thread, Delay.apply checks the mark)."""
+    comms = HostComms(default_mesh())
+    size = comms.get_size()
+    comms.allreduce(jnp.ones((size, 1), jnp.float32))   # warm compile
+    executed = []
+    real_execute = comms._execute
+
+    def counting(key, fn, *args, **kwargs):
+        executed.append(key[0])
+        return real_execute(key, fn, *args, **kwargs)
+
+    comms._execute = counting
+    comms.retry_policy = RetryPolicy(max_retries=1, base_delay=0.0,
+                                     timeout=0.1)
+    with faults.inject(comms, faults.Delay(0.5, verb="allreduce",
+                                           times=1)):
+        out = comms.allreduce(jnp.ones((size, 1), jnp.float32))
+        assert (np.asarray(out) == size).all()          # retry won
+        assert executed == ["allreduce"]                # only the retry
+        time.sleep(0.7)                                 # let attempt 1 wake
+        # the abandoned attempt woke, saw the mark, and bailed without
+        # reaching the transport
+        assert executed == ["allreduce"]
+
+
 def test_random_faults_recovered_by_retry_rotating_seed():
     """With seeded random failures, enough retries always win — run under
     stress.sh faults, which rotates RAFT_TPU_FAULT_SEED per iteration."""

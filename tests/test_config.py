@@ -52,6 +52,30 @@ def test_override_innermost_wins():
     assert config.get("tile_merge") == "direct"
 
 
+def test_override_none_reverts_to_env_default(monkeypatch):
+    """override(knob=None) is a scoped revert (ADVICE r5): it must
+    resolve to env/default inside the scope, not pin a literal None
+    that shadows them."""
+    monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "chunked")
+    config.configure(select_impl="approx")
+    with config.override(select_impl=None):
+        # env wins inside the revert scope (configured value bypassed,
+        # exactly like configure(select_impl=None))
+        assert config.get("select_impl") == "chunked"
+        assert config.describe()["select_impl"] == "chunked"
+    assert config.get("select_impl") == "approx"     # scope popped
+    monkeypatch.delenv("RAFT_TPU_SELECT_IMPL")
+    with config.override(select_impl=None):
+        # no env either: the built-in default, never a literal None
+        assert config.get("select_impl") == "topk"
+        assert config.describe()["select_impl"] == "topk"
+    # inner None-revert under an outer pin reverts all the way down
+    with config.override(tile_merge="direct"):
+        with config.override(tile_merge=None):
+            assert config.get("tile_merge") == "tile_topk"
+        assert config.get("tile_merge") == "direct"
+
+
 def test_unknown_knob_and_value_rejected():
     with pytest.raises(ValueError):
         config.configure(no_such_knob="x")

@@ -1,13 +1,15 @@
 """Memory-resource tests (reference test/mr/device/buffer.cpp,
 test/mr/host/buffer.cpp)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from raft_tpu import RaftError
 from raft_tpu.mr import (DeviceBuffer, HostBuffer, PoolAllocator,
-                         device_memory_stats)
+                         ZerosPool, default_zeros_pool,
+                         device_memory_stats, zeros_cached)
 
 
 class TestDeviceBuffer:
@@ -80,6 +82,84 @@ class TestPoolAllocator:
         a.deallocate()
         with pytest.raises(RaftError):
             pool.deallocate(a)
+
+
+class TestZerosPool:
+    def test_shared_block_identity(self):
+        pool = ZerosPool()
+        a = pool.get((4, 3), jnp.float32)
+        b = pool.get((4, 3), jnp.float32)
+        assert b is a                       # ONE shared block, not a copy
+        assert pool.n_hits == 1 and pool.n_misses == 1
+        assert float(np.asarray(a).sum()) == 0.0
+
+    def test_key_isolation_shape_and_dtype(self):
+        pool = ZerosPool()
+        a = pool.get((8,), jnp.float32)
+        assert pool.get((8,), jnp.int32) is not a
+        assert pool.get((9,), jnp.float32) is not a
+        assert pool.n_misses == 3 and pool.n_hits == 0
+
+    def test_lru_bound_evicts_oldest(self):
+        pool = ZerosPool(max_entries=2)
+        a = pool.get((1,))
+        pool.get((2,))
+        pool.get((1,))                      # refresh (1,): (2,) is now LRU
+        pool.get((3,))                      # evicts (2,)
+        assert len(pool) == 2
+        assert pool.get((1,)) is a          # survived
+        pool.get((2,))                      # re-created
+        assert pool.n_misses == 4           # (1,) (2,) (3,) (2,)-again
+
+    def test_pooled_bytes_and_release(self):
+        pool = ZerosPool()
+        blk = pool.get((16,), jnp.float32)
+        assert pool.pooled_bytes() == 16 * 4
+        pool.release()
+        assert len(pool) == 0 and pool.pooled_bytes() == 0
+        # released blocks stay valid for in-flight readers (no eager
+        # delete — GC owns the device memory)
+        assert float(np.asarray(blk).sum()) == 0.0
+
+    def test_byte_bound_evicts_and_oversize_never_cached(self):
+        """The LRU is bounded by BYTES as well as count: wide serve
+        tails must not pin unbounded device memory for the process
+        lifetime, and a single block larger than max_bytes is returned
+        fresh, never cached (it would evict everything else)."""
+        pool = ZerosPool(max_entries=64, max_bytes=4096)
+        big = pool.get((2048,), jnp.float32)      # 8 KiB > max_bytes
+        assert len(pool) == 0 and pool.pooled_bytes() == 0
+        assert float(np.asarray(big).sum()) == 0.0  # still usable
+        for i in range(1, 9):
+            pool.get((256, i), jnp.float32)       # 1 KiB * i blocks
+        assert pool.pooled_bytes() <= 4096
+        assert len(pool) < 8                      # bytes bound, not count
+
+    def test_deleted_block_is_replaced(self):
+        pool = ZerosPool()
+        a = pool.get((5,))
+        a.delete()                          # a consumer broke the
+        b = pool.get((5,))                  # read-only convention
+        assert b is not a and not b.is_deleted()
+
+    def test_zeros_cached_reads_default_pool(self):
+        blk = zeros_cached((7, 2), jnp.int32)
+        assert zeros_cached((7, 2), jnp.int32) is blk
+        assert default_zeros_pool().get((7, 2), jnp.int32) is blk
+        assert blk.dtype == jnp.int32 and blk.shape == (7, 2)
+
+    def test_composition_yields_fresh_storage(self):
+        """The documented consumption pattern (docs/ZERO_COPY.md):
+        composing the shared block via concatenate produces FRESH
+        storage — safe to donate — and never mutates the block."""
+        tail = zeros_cached((3, 2), jnp.float32)
+        rows = jnp.ones((2, 2), jnp.float32)
+        out = jnp.concatenate([rows, tail], axis=0)
+        assert out is not tail
+        jax.block_until_ready(out)
+        assert not tail.is_deleted()
+        np.testing.assert_array_equal(np.asarray(out[2:]),
+                                      np.zeros((3, 2), np.float32))
 
 
 def test_memory_stats_shape():

@@ -685,3 +685,120 @@ class TestThreadBan:
                            monkeypatch) == []
         assert self._check(tmp_path, "raft_tpu/comms/resilience.py",
                            src, monkeypatch) == []
+
+
+# ---------------------------------------------------------------------- #
+# zero-copy serve path: donation + overlapped dispatch (docs/ZERO_COPY.md)
+# ---------------------------------------------------------------------- #
+class TestZeroCopyServe:
+    def test_donate_defaults_and_retry_forces_off(self, index):
+        svc = KNNService(index, k=3, start=False)
+        assert svc.donate is True            # on when no retry policy
+        assert svc.worker.donate is True
+        svc.close()
+        policy = RetryPolicy(max_retries=1, timeout=30.0)
+        svc = KNNService(index, k=3, start=False, retry_policy=policy)
+        assert svc.donate is False           # a retry could replay a
+        assert svc.worker.donate is False   # consumed buffer
+        svc.close()
+        svc = KNNService(index, k=3, start=False, donate=True,
+                         retry_policy=policy)
+        assert svc.donate is False           # explicit opt-in loses too
+        svc.close()
+        svc = KNNService(index, k=3, start=False, donate=False)
+        assert svc.donate is False           # opt-out respected
+        svc.close()
+
+    def test_donating_batch_matches_unbatched_and_spares_callers(
+            self, index, rng):
+        """Donation consumes the PADDED buffer, never a caller's
+        submitted array: every submitted block must survive the batch
+        (resubmittable) and results stay bit-identical to unbatched."""
+        clock = FakeClock()
+        svc = KNNService(index, k=5, start=False, clock=clock,
+                         max_batch_rows=32, max_wait_ms=10.0)
+        assert svc.donate
+        blocks = [jnp.asarray(rng.standard_normal((r, 16)), jnp.float32)
+                  for r in (3, 7, 2)]
+        futs = svc.submit_many(blocks)
+        clock.advance(0.5)
+        assert svc.worker.run_once()
+        for q, f in zip(blocks, futs):
+            assert not q.is_deleted()        # caller array survived
+            d, i = f.result(timeout=0)
+            d0, i0 = brute_force_knn(index, q, 5)
+            assert bool((np.asarray(d) == np.asarray(d0)).all())
+            assert bool((np.asarray(i) == np.asarray(i0)).all())
+        # round 2 resubmits the SAME arrays — a consumed caller buffer
+        # would throw here
+        futs = svc.submit_many(blocks)
+        clock.advance(0.5)
+        assert svc.worker.run_once()
+        for f in futs:
+            f.result(timeout=0)
+        svc.close()
+
+    def test_donate_aliasing_rung_sized_request_copies(self, index,
+                                                       rng):
+        """The one case where pad/coalesce is the identity — a single
+        request exactly rung-sized — must pay the defensive copy, not
+        donate the caller's array out from under them."""
+        clock = FakeClock()
+        svc = KNNService(index, k=5, start=False, clock=clock,
+                         bucket_rungs="8,32", max_batch_rows=32,
+                         max_wait_ms=10.0)
+        q = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+        fut = svc.submit(q)                  # exactly the 8-rung
+        clock.advance(0.5)
+        assert svc.worker.run_once()
+        fut.result(timeout=0)
+        assert not q.is_deleted()
+        d, i = brute_force_knn(index, q, 5)  # still readable
+        assert np.asarray(d).shape == (8, 5)
+        svc.close()
+
+    def test_pad_tail_reuses_zeros_cache(self, rng):
+        from raft_tpu.mr import default_zeros_pool
+
+        pool = default_zeros_pool()
+        a = jnp.asarray(rng.standard_normal((3, 16)), jnp.float32)
+        p1 = pad_rows(a, 8)
+        h0, m0 = pool.n_hits, pool.n_misses
+        b = jnp.asarray(rng.standard_normal((3, 16)), jnp.float32)
+        p2 = pad_rows(b, 8)                  # same (5, 16) tail shape
+        assert pool.n_hits == h0 + 1 and pool.n_misses == m0
+        # fresh storage out (the donation precondition), zero tails
+        assert p2 is not b
+        np.testing.assert_array_equal(np.asarray(p1[3:]),
+                                      np.zeros((5, 16), np.float32))
+        assert pad_rows(a, 3) is a           # no-pad identity unchanged
+
+    def test_overlapped_loop_sustained_load_exact(self, index, rng):
+        """The pipelined worker loop (batch N+1 forms while N runs on
+        device) under sustained threaded load: every result exact,
+        every future resolved, zero post-warmup compiles with the
+        donating executables."""
+        svc = KNNService(index, k=5, max_batch_rows=64, max_wait_ms=0.5,
+                         queue_cap=4096)
+        assert svc.donate
+        rows = [int(r) for r in rng.integers(1, 33, size=60)]
+        blocks = [jnp.asarray(rng.standard_normal((r, 16)), jnp.float32)
+                  for r in rows]
+        baselines = [brute_force_knn(index, q, 5) for q in blocks]
+        reset_compile_cache_stats()
+        svc.warmup()
+        m_warm = _total_misses()
+        # bursts keep the queue non-empty so the loop actually takes
+        # the overlap branch (batcher.take() finds a ready batch while
+        # one is in flight)
+        futs = []
+        for start in range(0, len(blocks), 12):
+            futs.extend(svc.submit_many(blocks[start:start + 12]))
+        for (d0, i0), fut in zip(baselines, futs):
+            d, i = fut.result(timeout=30)
+            assert bool((np.asarray(d) == np.asarray(d0)).all())
+            assert bool((np.asarray(i) == np.asarray(i0)).all())
+        assert _total_misses() == m_warm
+        for q in blocks:
+            assert not q.is_deleted()
+        svc.close()

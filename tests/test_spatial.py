@@ -714,3 +714,45 @@ def test_fused_knn_twophase_k_cap(rng):
     q = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32))
     with pytest.raises(Exception):
         fused_knn_twophase(x, q, 129)
+
+
+def test_fused_knn_twophase_merge_pinned_to_topk(rng, monkeypatch):
+    """A process-wide select_impl pin (e.g. approx95) must NOT reach the
+    twophase phase-2 merge: the merge is part of the kernel's exactness
+    contract and defaults to an explicit impl="topk" pin.  The pallas
+    phase is stubbed (its per-build API skew is irrelevant here) — the
+    assertion is purely about which impl the merge select_k receives."""
+    import importlib
+
+    from raft_tpu import config
+    from raft_tpu.ops import knn_tile
+
+    # the module, not the same-named function spatial/__init__ re-exports
+    sk_mod = importlib.import_module("raft_tpu.spatial.select_k")
+
+    captured = {}
+    real_select_k = sk_mod.select_k
+
+    def spy(keys, k, select_min=True, values=None, impl=None):
+        captured["impl"] = impl
+        return real_select_k(keys, k, select_min=select_min,
+                             values=values, impl="topk")
+
+    def fake_pallas_call(kern, **kw):
+        def run(*operands):
+            return [jnp.zeros(s.shape, s.dtype) for s in kw["out_shape"]]
+        return run
+
+    monkeypatch.setattr(sk_mod, "select_k", spy)
+    monkeypatch.setattr(knn_tile.pl, "pallas_call", fake_pallas_call)
+    monkeypatch.setattr(knn_tile.pltpu, "CompilerParams",
+                        lambda **kw: None, raising=False)
+
+    x = jnp.asarray(rng.standard_normal((300, 8)).astype(np.float32))
+    q = jnp.asarray(rng.standard_normal((5, 8)).astype(np.float32))
+    with config.override(select_impl="approx95"):
+        knn_tile.fused_knn_twophase(x, q, 3)
+    assert captured["impl"] == "topk"
+    # and the explicit-arg escape hatch still reaches the merge
+    knn_tile.fused_knn_twophase(x, q, 3, merge_select_impl="approx")
+    assert captured["impl"] == "approx"

@@ -53,6 +53,26 @@ class TestOperators:
         got = SparseMatrix(CSR.from_dense(d, capacity=80)).mv(x)
         np.testing.assert_allclose(np.asarray(got), d @ x, rtol=1e-5)
 
+    def test_spmv_impl_typo_fails_at_construction(self, rng):
+        """A typo'd spmv_impl pin must fail in __init__ against the knob
+        whitelist, not surface later from inside a jitted solve."""
+        from raft_tpu.core.error import RaftError
+        from raft_tpu.sparse.linalg import SPMV_IMPLS
+
+        d = (rng.random((8, 8)) * (rng.random((8, 8)) < 0.4)
+             ).astype(np.float32)
+        csr = CSR.from_dense(d, capacity=40)
+        with pytest.raises(RaftError, match="spmv_impl"):
+            SparseMatrix(csr, spmv_impl="segement")   # the typo
+        with pytest.raises(RaftError, match="spmv_impl"):
+            LaplacianMatrix(csr, spmv_impl="cusparse")
+        # every whitelisted impl (and the None = knob default) is legal
+        x = rng.random(8).astype(np.float32)
+        for impl in SPMV_IMPLS + (None,):
+            got = SparseMatrix(csr, spmv_impl=impl).mv(x)
+            np.testing.assert_allclose(np.asarray(got), d @ x,
+                                       rtol=1e-4, atol=1e-5)
+
     def test_laplacian_mv(self, rng):
         adj = planted_two_blocks(rng, 8)
         L_ref = np.diag(adj.sum(1)) - adj

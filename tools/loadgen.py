@@ -81,7 +81,21 @@ def _registry_serve_stats(service_name):
             if labels.get("service") == service_name:
                 out["queue_wait_p50_ms"] = series.quantile(0.50) * 1e3
                 out["queue_wait_p95_ms"] = series.quantile(0.95) * 1e3
+    # the zero-copy proof (docs/ZERO_COPY.md): payload bytes bounced
+    # through host numpy anywhere in the process — 0 on the
+    # device-resident paths (absent family == nothing ever staged)
+    out["host_staged_bytes"] = int(
+        reg.family_total("raft_tpu_comms_host_staged_bytes"))
     return out
+
+
+def _compile_misses():
+    """Total compile-cache misses across every profiled_jit wrapper
+    (the steady-state proof: zero NEW misses after warmup)."""
+    from raft_tpu.core.profiler import compile_cache_stats
+
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
 
 
 def build_service(kind, index_rows, dim, k, seed=0, **opts):
@@ -173,6 +187,7 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
     else:
         raise SystemExit("unknown --mode %r" % mode)
 
+    misses0 = _compile_misses()
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -193,6 +208,9 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
         "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
         "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+        # compiles observed DURING the load window: a warmed service in
+        # steady state reports 0 (docs/ZERO_COPY.md acceptance)
+        "post_warmup_compiles": _compile_misses() - misses0,
     }
     report.update(_registry_serve_stats(service.name))
     return report
@@ -251,7 +269,8 @@ def main(argv=None) -> int:
     for key in ("duration_s", "requests_ok", "rejected", "errors", "qps",
                 "p50_ms", "p95_ms", "p99_ms", "queue_wait_p50_ms",
                 "queue_wait_p95_ms", "batches", "mean_batch_rows",
-                "padding_waste", "warmup_s", "buckets"):
+                "padding_waste", "post_warmup_compiles",
+                "host_staged_bytes", "warmup_s", "buckets"):
         if key in report:
             val = report[key]
             if isinstance(val, float):
