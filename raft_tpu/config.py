@@ -62,6 +62,24 @@ serve_queue_cap
     Default admission-control cap on queued requests per service;
     beyond it, ``submit`` raises
     :class:`~raft_tpu.core.error.ServiceOverloadError`.  Free-form int.
+serve_ann_nprobe
+    Default probe count for :class:`raft_tpu.serve.ANNService`
+    (``0`` = the served index's build-time default).  Free-form int;
+    runtime-resolved at service construction.
+serve_ann_nprobe_ladder
+    Comma-separated candidate ``nprobe`` cells an ``ANNService`` warms
+    (every bucket rung × every cell) and :meth:`calibrate` searches for
+    the smallest cell meeting a recall target.  Cells above the index's
+    ``nlist`` are clamped.  Free-form list.
+serve_ann_delta_cap
+    Capacity (rows) of the append-only delta segment that absorbs
+    :meth:`ANNService.insert` between compactions; a full delta sheds
+    inserts with :class:`~raft_tpu.core.error.ServiceOverloadError`.
+    Free-form int.
+serve_ann_compact_rows
+    Delta-row threshold at which the serve worker loop compacts (re-
+    clusters the delta into IVF slots and atomically swaps the index);
+    ``0`` disables automatic compaction.  Free-form int.
 """
 
 from __future__ import annotations
@@ -92,13 +110,21 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "serve_bucket_rungs": ("RAFT_TPU_SERVE_BUCKET_RUNGS", "pow2", None),
     "serve_max_wait_ms": ("RAFT_TPU_SERVE_MAX_WAIT_MS", "2", None),
     "serve_queue_cap": ("RAFT_TPU_SERVE_QUEUE_CAP", "1024", None),
+    "serve_ann_nprobe": ("RAFT_TPU_SERVE_ANN_NPROBE", "0", None),
+    "serve_ann_nprobe_ladder": ("RAFT_TPU_SERVE_ANN_NPROBE_LADDER",
+                                "4,8,16,32,64", None),
+    "serve_ann_delta_cap": ("RAFT_TPU_SERVE_ANN_DELTA_CAP", "4096", None),
+    "serve_ann_compact_rows": ("RAFT_TPU_SERVE_ANN_COMPACT_ROWS",
+                               "2048", None),
 }
 
 # knobs resolved at *runtime* (service/object construction), never baked
 # into a trace: changing one later affects the next construction and the
 # executable-cache caveat warning does not apply
 _RUNTIME_KNOBS = frozenset(
-    ("serve_bucket_rungs", "serve_max_wait_ms", "serve_queue_cap"))
+    ("serve_bucket_rungs", "serve_max_wait_ms", "serve_queue_cap",
+     "serve_ann_nprobe", "serve_ann_nprobe_ladder",
+     "serve_ann_delta_cap", "serve_ann_compact_rows"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

@@ -11,13 +11,17 @@ padded device call per shape bucket, so
 - overload is shed at admission and deadlines expire in-queue
   (:mod:`~raft_tpu.serve.scheduler`),
 - facades own warmup / drain / close lifecycle and the optional
-  query-vector cache (:mod:`~raft_tpu.serve.service`).
+  query-vector cache (:mod:`~raft_tpu.serve.service`),
+- the native IVF quantizers are served with recall-targeted nprobe
+  dispatch and streaming ingestion + worker-loop compaction
+  (:mod:`~raft_tpu.serve.ann_service`).
 
 Session integration: ``Comms.serve(...)`` constructs and registers a
 service; ``health_check()`` reports live services and ``destroy()``
 drains them before comms teardown.
 """
 
+from raft_tpu.serve.ann_service import ANNService  # noqa: F401
 from raft_tpu.serve.batcher import MicroBatcher, ServeFuture  # noqa: F401
 from raft_tpu.serve.bucketing import (  # noqa: F401
     BucketPolicy,
@@ -36,5 +40,5 @@ from raft_tpu.serve.service import (  # noqa: F401
 __all__ = [
     "BucketPolicy", "resolve_rungs", "pad_rows", "coalesce", "split_rows",
     "MicroBatcher", "ServeFuture", "ServeWorker",
-    "Service", "KNNService", "PairwiseService",
+    "Service", "KNNService", "PairwiseService", "ANNService",
 ]

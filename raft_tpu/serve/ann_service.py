@@ -1,0 +1,527 @@
+"""ANNService: serve the native IVF quantizers with streaming ingestion.
+
+The brute-force :class:`~raft_tpu.serve.service.KNNService` tops out
+where its per-query work does — a full index scan per padded batch.
+:class:`ANNService` fronts :func:`raft_tpu.spatial.ann.approx_knn_search`
+over a prebuilt IVF index (Flat / PQ / SQ behind the same constructor
+argument) instead, turning the scan into a few probed slot matmuls, and
+adds the two things a production vector store needs beyond a static
+index:
+
+**Recall-targeted dispatch.**  ``nprobe`` is the quality/latency knob,
+and a hand-pinned value is almost always wrong for the workload (the
+CUDA-L2 lesson in PAPERS.md: searched configurations beat fixed
+defaults).  The service therefore owns a small *ladder* of candidate
+``nprobe`` cells: :meth:`warmup` precompiles every bucket rung × every
+cell, and :meth:`calibrate` measures recall@k (against an exact ground
+truth) and latency per cell, then pins the smallest cell that meets the
+caller's recall target — retargeting at runtime (:meth:`set_nprobe`)
+never compiles.
+
+**Streaming ingestion.**  :meth:`insert` appends vectors to a
+fixed-capacity *delta segment*: a device-resident ``(delta_cap, dim)``
+buffer scanned brute-force and merged into the IVF result stream
+on-device (:func:`raft_tpu.spatial.ann._delta_merge_impl` via
+``select_k``) — one static shape however full the segment is, so
+ingestion never retraces the serving executables, and an inserted
+vector is queryable by the *next formed batch* (the visibility point).
+When the delta crosses ``compact_rows``, the serve worker loop's
+maintenance seam re-clusters it into IVF slots
+(:func:`raft_tpu.spatial.ann.ivf_flat_extend` — nearest-centroid
+assignment, no k-means re-run) and **atomically swaps** the index
+between batches, never mid-batch: every dispatched batch reads one
+immutable ``(index, delta)`` snapshot, so results are deterministic
+across the swap (on exact ties the merge keeps the base copy — the same
+row answers identically from delta or from compacted storage).
+Compaction runs on the existing worker thread — no second thread to
+coordinate, drain/close ordering comes for free (``close`` joins the
+worker, so a mid-flight compaction completes before teardown).
+
+Donation (docs/ZERO_COPY.md): the padded query batch is donated to the
+LAST program that consumes it (IVF scan, refine, or delta merge),
+through the executable-twin machinery in :mod:`raft_tpu.spatial.ann` —
+same contract as ``tiled_knn_donated``: the worker pays a defensive
+copy in the one caller-aliasing case, and donation is off under a
+``RetryPolicy`` (a retry would replay a consumed buffer).
+
+Metrics (``raft_tpu_serve_ann_*``, labels ``service=`` plus ``nprobe=``
+where noted): ``delta_rows`` gauge, ``inserts_total``,
+``compactions_total`` / ``compacted_rows_total`` / ``compact_seconds``,
+``calls_total{nprobe=}`` per-nprobe dispatch counts, and calibration's
+``nprobe_seconds{nprobe=}`` / ``recall{nprobe=}`` — every speed claim
+carries its quality number.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import config
+from raft_tpu.core import metrics as _metrics
+from raft_tpu.core.error import ServiceOverloadError, expects, fail
+from raft_tpu.serve.service import Service, _knob_int, _service_seq
+from raft_tpu.spatial import ann as _ann
+from raft_tpu.spatial.knn import brute_force_knn
+
+__all__ = ["ANNService"]
+
+
+class _AnnState(NamedTuple):
+    """One immutable serving snapshot: a dispatched batch reads exactly
+    one of these (index + delta travel together — the atomic-swap
+    unit), so an insert or compaction can never tear a batch."""
+
+    index: object           # IVFFlatIndex | IVFPQIndex | IVFSQIndex
+    delta_vecs: jnp.ndarray  # (delta_cap, dim) device, zeros past count
+    delta_ids: jnp.ndarray   # (delta_cap,) int32 device, -1 past count
+    delta_rows: int
+
+
+def _labeled(kind: str, name: str, help: str, service: str, **extra):
+    """Registry family with ``service=`` plus optional extra labels,
+    resolved per use (reset-proof, the scheduler helpers' rationale)."""
+    label_names = ("service",) + tuple(sorted(extra))
+    fam = getattr(_metrics.default_registry(), kind)(
+        name, help=help, labels=label_names)
+    return fam.labels(service=service, **extra)
+
+
+def _parse_ladder(spec, nlist: int) -> tuple:
+    """Resolve an nprobe-ladder spec (csv string or int sequence) into
+    an ascending, deduplicated tuple clamped to ``nlist``."""
+    if isinstance(spec, str):
+        try:
+            spec = [int(tok) for tok in spec.split(",") if tok.strip()]
+        except ValueError:
+            raise ValueError(
+                "ANNService: nprobe ladder %r is not a comma-separated "
+                "int list" % spec) from None
+    cells = sorted({min(int(c), nlist) for c in spec if int(c) >= 1})
+    expects(len(cells) > 0,
+            "ANNService: empty nprobe ladder after clamping to nlist=%d",
+            nlist)
+    return tuple(cells)
+
+
+class ANNService(Service):
+    """Micro-batched :func:`~raft_tpu.spatial.ann.approx_knn_search`
+    over one pinned IVF index, with streaming ingestion (module doc).
+
+    Parameters
+    ----------
+    index:
+        A prebuilt :class:`~raft_tpu.spatial.ann.IVFFlatIndex`,
+        ``IVFPQIndex`` or ``IVFSQIndex`` — the constructor knob that
+        picks the quantizer; build it with
+        :func:`~raft_tpu.spatial.ann.approx_knn_build_index`.
+    k:
+        Neighbors returned per query row.
+    nprobe:
+        Probe count served by default; None resolves the
+        ``serve_ann_nprobe`` knob (0 = the index's build-time default).
+    nprobe_ladder:
+        Candidate cells for :meth:`warmup` / :meth:`calibrate`
+        (default: the ``serve_ann_nprobe_ladder`` knob), each clamped
+        to the index's ``nlist``; the served ``nprobe`` is always
+        included.
+    refine_ratio:
+        IVF-PQ exact re-rank ratio passthrough (ignored by Flat/SQ).
+    delta_cap / compact_rows:
+        Delta-segment capacity and the auto-compaction threshold
+        (``serve_ann_delta_cap`` / ``serve_ann_compact_rows`` knobs);
+        ``compact_rows=0`` disables auto-compaction.  Compaction
+        requires an IVF-Flat index — PQ/SQ services still ingest into
+        the delta but must be rebuilt offline (auto-compaction is
+        forced off and :meth:`compact` raises).
+    **opts:
+        The shared :class:`~raft_tpu.serve.service.Service` options
+        (``max_batch_rows``, ``bucket_rungs``, ``max_wait_ms``,
+        ``queue_cap``, ``retry_policy``, ``donate``, ``start``, ...).
+    """
+
+    def __init__(self, index, k: int, *,
+                 nprobe: Optional[int] = None,
+                 nprobe_ladder=None,
+                 refine_ratio: Optional[int] = None,
+                 delta_cap: Optional[int] = None,
+                 compact_rows: Optional[int] = None,
+                 slot_multiple: int = 64,
+                 select_impl: Optional[str] = None,
+                 name: Optional[str] = None, **opts):
+        kinds = (_ann.IVFFlatIndex, _ann.IVFPQIndex, _ann.IVFSQIndex)
+        expects(isinstance(index, kinds),
+                "ANNService: index must be an IVF index "
+                "(IVFFlatIndex/IVFPQIndex/IVFSQIndex), got %r",
+                type(index).__name__)
+        expects(k >= 1, "ANNService: k=%d", k)
+        self.k = int(k)
+        self._nlist = int(index.centroids.shape[0])
+        dim = int(index.centroids.shape[1])
+        dtype = jnp.dtype(index.centroids.dtype)
+        self._refine_ratio = refine_ratio
+        self._slot_multiple = int(slot_multiple)
+        # per-service top-k impl pin, passed explicitly into every
+        # search (the config-doc recommendation: an explicit argument
+        # reaches the trace as a Python value and always takes effect);
+        # "approx" is membership-exact and markedly faster at large k
+        self._select_impl = select_impl
+
+        if nprobe is None:
+            nprobe = _knob_int("serve_ann_nprobe")
+            if nprobe == 0:
+                nprobe = int(index.nprobe)
+        expects(nprobe >= 1, "ANNService: nprobe=%d", int(nprobe))
+        self._nprobe = min(int(nprobe), self._nlist)
+        if nprobe_ladder is None:
+            nprobe_ladder = config.get("serve_ann_nprobe_ladder")
+        self._nprobe_ladder = _parse_ladder(nprobe_ladder, self._nlist)
+        if self._nprobe not in self._nprobe_ladder:
+            self._nprobe_ladder = tuple(sorted(
+                self._nprobe_ladder + (self._nprobe,)))
+
+        if delta_cap is None:
+            delta_cap = _knob_int("serve_ann_delta_cap")
+        expects(delta_cap >= 1, "ANNService: delta_cap=%d", delta_cap)
+        self._delta_cap = int(delta_cap)
+        if compact_rows is None:
+            compact_rows = _knob_int("serve_ann_compact_rows")
+        expects(compact_rows >= 0, "ANNService: compact_rows=%d",
+                compact_rows)
+        self._compactable = isinstance(index, _ann.IVFFlatIndex)
+        # PQ/SQ slot stores hold codes, not vectors: there is nothing
+        # ivf_flat_extend could re-cluster — keep ingesting into the
+        # delta, but never auto-compact (module doc)
+        self._compact_rows = (min(int(compact_rows), self._delta_cap)
+                              if self._compactable else 0)
+
+        # resolved before Service.__init__ so the metric labels (and
+        # the worker's maintenance tick) can use it from the first
+        # snapshot publish onward
+        name = name or "ann%d" % next(_service_seq)
+        self.name = name
+
+        # delta segment: host mirror (the append target) + device
+        # snapshot published in _ann_state; rows >= count carry id -1
+        self._delta_lock = threading.Lock()
+        self._compact_lock = threading.Lock()
+        self._delta_vecs_np = np.zeros((self._delta_cap, dim),
+                                       np.dtype(dtype))
+        self._delta_ids_np = np.full(self._delta_cap, -1, np.int32)
+        self._delta_count = 0
+        self._index = index
+        self._publish_state_locked()
+
+        def execute(padded):
+            st = self._ann_state        # ONE snapshot per batch
+            nprobe_now = self._nprobe
+            delta = ((st.delta_vecs, st.delta_ids)
+                     if st.delta_rows else None)
+            _labeled("counter", "raft_tpu_serve_ann_calls_total",
+                     "ANN batches dispatched per probe count",
+                     self.name, nprobe=nprobe_now).inc()
+            # donation routes the padded buffer into the last consuming
+            # program's executable twin; self.donate is resolved by
+            # Service.__init__ before any batch can run
+            return _ann.approx_knn_search(
+                st.index, padded, self.k, nprobe=nprobe_now,
+                refine_ratio=self._refine_ratio, delta=delta,
+                donate_queries=self.donate,
+                select_impl=self._select_impl)
+
+        super().__init__(
+            name, execute, dim=dim, dtype=dtype,
+            maintenance=self._maintenance_tick, **opts)
+
+    # ------------------------------------------------------------------ #
+    # snapshot plumbing
+    # ------------------------------------------------------------------ #
+    def _publish_state_locked(self) -> None:
+        """Rebuild the immutable serving snapshot from the host mirror
+        (callers hold ``_delta_lock``, or are in ``__init__``)."""
+        self._ann_state = _AnnState(
+            self._index,
+            jnp.asarray(self._delta_vecs_np),
+            jnp.asarray(self._delta_ids_np),
+            self._delta_count)
+        _labeled("gauge", "raft_tpu_serve_ann_delta_rows",
+                 "rows in the append-only delta segment",
+                 self.name).set(self._delta_count)
+
+    @property
+    def nprobe(self) -> int:
+        return self._nprobe
+
+    @property
+    def nprobe_ladder(self) -> tuple:
+        return self._nprobe_ladder
+
+    @property
+    def delta_rows(self) -> int:
+        return self._ann_state.delta_rows
+
+    @property
+    def index(self):
+        """The currently served index (post-compaction swaps visible)."""
+        return self._ann_state.index
+
+    def set_nprobe(self, nprobe: int) -> int:
+        """Re-target the served probe count (clamped to ``nlist``);
+        takes effect on the next formed batch.  Cells outside the
+        warmed ladder serve correctly but pay a compile on first use."""
+        expects(int(nprobe) >= 1, "set_nprobe: nprobe=%d", int(nprobe))
+        self._nprobe = min(int(nprobe), self._nlist)
+        return self._nprobe
+
+    # ------------------------------------------------------------------ #
+    # warmup: every bucket rung x every nprobe cell, both delta arms
+    # ------------------------------------------------------------------ #
+    def warmup(self) -> "ANNService":
+        """AOT-precompile every (bucket rung × nprobe cell) executable —
+        and, per pair, BOTH serving arms: the empty-delta fast path and
+        the delta-merge path (plus their donating twins where dispatch
+        donates) — so steady-state traffic at any admissible shape,
+        any ladder cell, and any delta fill performs zero compiles."""
+        st = self._ann_state
+        blank_vecs = jnp.zeros((self._delta_cap, self.dim), self.dtype)
+        blank_ids = jnp.full((self._delta_cap,), -1, jnp.int32)
+        for rung in self.policy.rungs:
+            for cell in self._nprobe_ladder:
+                # fresh zeros per call: the donating arms consume them
+                out = _ann.approx_knn_search(
+                    st.index, jnp.zeros((rung, self.dim), self.dtype),
+                    self.k, nprobe=cell,
+                    refine_ratio=self._refine_ratio,
+                    donate_queries=self.donate,
+                    select_impl=self._select_impl)
+                jax.block_until_ready(out)
+                out = _ann.approx_knn_search(
+                    st.index, jnp.zeros((rung, self.dim), self.dtype),
+                    self.k, nprobe=cell,
+                    refine_ratio=self._refine_ratio,
+                    delta=(blank_vecs, blank_ids),
+                    donate_queries=self.donate,
+                    select_impl=self._select_impl)
+                jax.block_until_ready(out)
+        self._warmed = self.policy.rungs
+        return self
+
+    # ------------------------------------------------------------------ #
+    # streaming ingestion
+    # ------------------------------------------------------------------ #
+    def insert(self, ids, vectors) -> int:
+        """Append vectors to the delta segment under caller-owned global
+        ids (non-negative int32, disjoint from the index's ids — the
+        caller's contract).  Visible to the next formed batch; returns
+        the delta's row count after the append.
+
+        Raises :class:`~raft_tpu.core.error.ServiceOverloadError` when
+        the segment lacks room — back off and retry after compaction
+        (automatic at ``compact_rows``, or call :meth:`compact`).
+        """
+        expects(self.is_open(), "%s.insert: service is closed", self.name)
+        v = self._check_payload(vectors)
+        key = np.asarray(ids, np.int32).ravel()
+        expects(key.shape[0] == v.shape[0],
+                "%s.insert: %d ids for %d vectors", self.name,
+                key.shape[0], v.shape[0])
+        expects(key.shape[0] == 0 or bool((key >= 0).all()),
+                "%s.insert: negative ids (the delta reserves -1 for "
+                "unfilled capacity)", self.name)
+        n = int(v.shape[0])
+        if n == 0:
+            return self._delta_count
+        expects(n <= self._delta_cap,
+                "%s.insert: %d rows exceed the whole delta capacity %d",
+                self.name, n, self._delta_cap)
+        with self._delta_lock:
+            at = self._delta_count
+            if at + n > self._delta_cap:
+                raise ServiceOverloadError(
+                    "%s.insert: delta segment full (%d + %d > cap %d); "
+                    "wait for compaction and retry" % (
+                        self.name, at, n, self._delta_cap), at,
+                    self._delta_cap)
+            self._delta_vecs_np[at:at + n] = np.asarray(v)
+            self._delta_ids_np[at:at + n] = key
+            self._delta_count = at + n
+            self._publish_state_locked()
+        _labeled("counter", "raft_tpu_serve_ann_inserts_total",
+                 "vectors ingested into the delta segment",
+                 self.name).inc(n)
+        return at + n
+
+    def _maintenance_tick(self) -> None:
+        """Worker-loop hook: compact when the delta crosses the
+        threshold (never while draining — drain must serve out, not
+        start index rebuilds)."""
+        if (self._compact_rows
+                and self._delta_count >= self._compact_rows
+                and not self.batcher.draining()):
+            self.compact()
+
+    def compact(self) -> bool:
+        """Re-cluster the delta segment into IVF slots and atomically
+        swap the served index (module doc); False when the delta was
+        empty.  Safe from any thread (serialized by a lock); rows
+        inserted *during* the rebuild stay in the delta for the next
+        round — the compacted prefix is exact."""
+        expects(self._compactable,
+                "%s.compact: compaction requires an IVFFlatIndex (PQ/SQ "
+                "stores hold codes; rebuild offline)", self.name)
+        with self._compact_lock:
+            with self._delta_lock:
+                n0 = self._delta_count
+                if n0 == 0:
+                    return False
+                vecs = self._delta_vecs_np[:n0].copy()
+                keys = self._delta_ids_np[:n0].copy()
+                old_index = self._index
+            t0 = self._clock()
+            new_index = _ann.ivf_flat_extend(
+                old_index, vecs, keys, slot_multiple=self._slot_multiple)
+            jax.block_until_ready(new_index.slot_vecs)
+            with self._delta_lock:
+                rem = self._delta_count - n0
+                if rem:
+                    self._delta_vecs_np[:rem] = \
+                        self._delta_vecs_np[n0:self._delta_count]
+                    self._delta_ids_np[:rem] = \
+                        self._delta_ids_np[n0:self._delta_count]
+                self._delta_ids_np[rem:] = -1
+                self._delta_count = rem
+                self._index = new_index
+                self._publish_state_locked()   # THE atomic swap
+        _labeled("counter", "raft_tpu_serve_ann_compactions_total",
+                 "delta-to-slots compactions", self.name).inc()
+        _labeled("counter", "raft_tpu_serve_ann_compacted_rows_total",
+                 "rows folded into IVF slots by compaction",
+                 self.name).inc(n0)
+        _labeled("timer", "raft_tpu_serve_ann_compact_seconds",
+                 "compaction latency (re-cluster + swap)",
+                 self.name).observe(self._clock() - t0)
+        return True
+
+    # ------------------------------------------------------------------ #
+    # recall-targeted dispatch
+    # ------------------------------------------------------------------ #
+    def ground_truth_store(self, reference=None, *, state=None):
+        """(vectors, global_ids) for exact ground truth: the caller's
+        reference matrix (ids = row numbers), or the index's own
+        content (lossless for Flat; PQ keeps originals only when built
+        with ``refine_ratio > 1``), plus the live delta rows.
+
+        Reads ONE immutable :class:`_AnnState` snapshot throughout — a
+        concurrent insert or compaction swap cannot tear index content
+        against delta content (reading the mutable host mirror here
+        would race the compactor's prefix shift).  ``state`` lets
+        :meth:`calibrate` pass the very snapshot it measures against.
+        """
+        st = state if state is not None else self._ann_state
+        if reference is not None:
+            vecs = np.asarray(reference)
+            ids = np.arange(vecs.shape[0], dtype=np.int64)
+        elif isinstance(st.index, _ann.IVFFlatIndex):
+            vecs, ids = _ann.ivf_flat_reconstruct(st.index)
+        elif (isinstance(st.index, _ann.IVFPQIndex)
+              and st.index.vectors is not None):
+            vecs = np.asarray(st.index.vectors)
+            ids = np.arange(vecs.shape[0], dtype=np.int64)
+        else:
+            fail("%s.calibrate: pass reference= — a %s index stores "
+                 "quantized codes, not vectors, so exact ground truth "
+                 "cannot be reconstructed from it", self.name,
+                 type(st.index).__name__)
+        if st.delta_rows:
+            vecs = np.concatenate(
+                [vecs, np.asarray(st.delta_vecs[:st.delta_rows])],
+                axis=0)
+            ids = np.concatenate(
+                [ids, np.asarray(st.delta_ids[:st.delta_rows],
+                                 np.int64)])
+        return vecs, ids
+
+    def calibrate(self, queries, target_recall: float = 0.9, *,
+                  reference=None, set_default: bool = True,
+                  measure_all: bool = False) -> dict:
+        """Search the nprobe ladder for the smallest cell meeting
+        ``target_recall`` at this service's k (recall@k against an
+        exact brute-force ground truth computed once), measuring
+        latency per cell — the searched-not-pinned configuration the
+        serving layer dispatches at.
+
+        Returns ``{"chosen_nprobe", "target_recall", "met_target",
+        "table": [{nprobe, recall_at_k, latency_s}, ...]}``; with
+        ``set_default`` the chosen cell becomes the served ``nprobe``.
+        Cells are measured through the same search entry points serving
+        uses (current index + delta), so the numbers transfer.  The
+        walk stops at the first (cheapest) cell meeting the target;
+        ``measure_all`` keeps walking for the full recall/latency curve.
+        """
+        q = self._check_payload(queries)
+        expects(0.0 < target_recall <= 1.0,
+                "%s.calibrate: target_recall=%r", self.name, target_recall)
+        # one snapshot for BOTH the ground truth and the measured
+        # searches — a concurrent swap cannot skew recall
+        st = self._ann_state
+        gt_vecs, gt_ids = self.ground_truth_store(reference, state=st)
+        expects(gt_vecs.shape[0] >= self.k,
+                "%s.calibrate: ground-truth store has %d rows < k=%d",
+                self.name, gt_vecs.shape[0], self.k)
+        _, gt_rows = brute_force_knn(jnp.asarray(gt_vecs), q, self.k)
+        gt = gt_ids[np.asarray(gt_rows)]                 # (nq, k) global
+        delta = ((st.delta_vecs, st.delta_ids) if st.delta_rows
+                 else None)
+        table = []
+        chosen = None
+        for cell in self._nprobe_ladder:
+            t0 = self._clock()
+            out = _ann.approx_knn_search(
+                st.index, q, self.k, nprobe=cell,
+                refine_ratio=self._refine_ratio, delta=delta,
+                select_impl=self._select_impl)
+            jax.block_until_ready(out)
+            dt = self._clock() - t0
+            got = np.asarray(out[1])
+            recall = float(np.mean([
+                len(set(got[r]) & set(gt[r])) / self.k
+                for r in range(got.shape[0])]))
+            _labeled("timer", "raft_tpu_serve_ann_nprobe_seconds",
+                     "calibration search latency per probe count",
+                     self.name, nprobe=cell).observe(dt)
+            _labeled("gauge", "raft_tpu_serve_ann_recall",
+                     "calibration recall@k per probe count",
+                     self.name, nprobe=cell).set(recall)
+            table.append({"nprobe": cell,
+                          "recall_at_k": round(recall, 4),
+                          "latency_s": round(dt, 5)})
+            if chosen is None and recall >= target_recall:
+                chosen = cell
+                if not measure_all:
+                    break  # ladder ascends: first hit is the cheapest
+                # measure_all keeps walking for the full recall/latency
+                # curve (the bench's per-nprobe table)
+        met = chosen is not None
+        if chosen is None:
+            chosen = self._nprobe_ladder[-1]  # best effort: max cell
+        if set_default:
+            self.set_nprobe(chosen)
+        return {"chosen_nprobe": chosen, "target_recall": target_recall,
+                "met_target": met, "k": self.k, "table": table}
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update({
+            "kind": type(self._index).__name__,
+            "nprobe": self._nprobe,
+            "nprobe_ladder": list(self._nprobe_ladder),
+            "delta_rows": self.delta_rows,
+            "delta_cap": self._delta_cap,
+            "compact_rows": self._compact_rows,
+        })
+        return out

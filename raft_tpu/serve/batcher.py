@@ -175,6 +175,13 @@ class MicroBatcher:
         with self._cond:
             return not self._q
 
+    def draining(self) -> bool:
+        """Whether admission has stopped (drain/close in progress) —
+        maintenance work (e.g. compaction) should not start once the
+        service is winding down."""
+        with self._cond:
+            return self._draining
+
     # ------------------------------------------------------------------ #
     # worker side
     # ------------------------------------------------------------------ #
@@ -206,21 +213,36 @@ class MicroBatcher:
                 return None
             return self._pop_batch_locked()
 
-    def wait_for_batch(self) -> Optional[List[_Request]]:
+    def wait_for_batch(self, timeout: Optional[float] = None
+                       ) -> Optional[List[_Request]]:
         """Blocking: the next batch, or None once stopped and empty
-        (the worker loop's exit signal)."""
+        (the worker loop's exit signal).
+
+        ``timeout`` bounds the wait: an empty list is returned when it
+        elapses with no batch ready — the worker loop's maintenance
+        poll (periodic compaction must get the thread even while the
+        queue idles; ``[]`` is "no work yet", distinct from the None
+        exit signal)."""
+        deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
             while True:
                 if self._ready_locked(self._clock()):
                     return self._pop_batch_locked()
                 if self._stopped and not self._q:
                     return None
+                poll = None
+                if deadline is not None:
+                    poll = deadline - self._clock()
+                    if poll <= 0:
+                        return []
                 if self._q:
-                    remaining = (self._q[0].enqueue_t + self.max_wait_s
-                                 - self._clock())
-                    self._cond.wait(timeout=max(1e-3, remaining))
+                    remaining = max(1e-3,
+                                    self._q[0].enqueue_t + self.max_wait_s
+                                    - self._clock())
+                    self._cond.wait(timeout=remaining if poll is None
+                                    else min(remaining, poll))
                 else:
-                    self._cond.wait()
+                    self._cond.wait(timeout=poll)
 
     # ------------------------------------------------------------------ #
     # lifecycle

@@ -122,6 +122,17 @@ class ServeWorker:
         consumption; services wire this, see docs/ZERO_COPY.md).  The
         worker guarantees the donated buffer never aliases a caller's
         submitted array.
+    maintenance:
+        Optional zero-arg callback run ON the worker thread between
+        batch cycles (and on an idle poll every
+        ``maintenance_interval_s``): the serving loop's home for
+        background index work — ANN delta compaction — without a second
+        thread to coordinate (``ci/style_check.py``'s thread hygiene
+        argument).  It runs between dispatches, never mid-batch, so an
+        index swap it performs can never tear a batch; exceptions are
+        counted (``raft_tpu_serve_maintenance_errors_total``) and
+        swallowed — a failing compactor must not kill the loop serving
+        everyone.
     clock:
         Shared with the batcher for deadline math.
     """
@@ -131,12 +142,16 @@ class ServeWorker:
                  execute: Callable,
                  retry_policy=None,
                  donate: bool = False,
+                 maintenance: Optional[Callable[[], None]] = None,
+                 maintenance_interval_s: float = 0.05,
                  clock: Callable[[], float] = time.monotonic):
         self.name = name
         self._batcher = batcher
         self._policy = policy
         self._execute = execute
         self._retry_policy = retry_policy
+        self._maintenance = maintenance
+        self._maint_interval = float(maintenance_interval_s)
         # the worker OWNS the donation-eligibility rule: donation is
         # off whenever a retry could replay the consumed buffer.
         # Public: Service passes intent and reads the resolved value
@@ -191,11 +206,18 @@ class ServeWorker:
         no overlap gained."""
         pipelined = self._retry_policy is None
         pending = None
+        poll = (self._maint_interval if self._maintenance is not None
+                else None)
         while True:
             if pending is None:
-                batch = self._batcher.wait_for_batch()
+                batch = self._batcher.wait_for_batch(timeout=poll)
                 if batch is None:
                     return
+                if not batch:
+                    # idle maintenance poll — no work queued, so a
+                    # long compaction delays nobody
+                    self.run_maintenance()
+                    continue
             else:
                 # opportunistic, non-blocking: if the policy has a
                 # batch ready NOW, start it before finishing the
@@ -211,6 +233,7 @@ class ServeWorker:
                         with self._state:
                             self._busy = False
                             self._state.notify_all()
+                    self.run_maintenance()
                     continue
             with self._state:
                 self._busy = True
@@ -228,6 +251,14 @@ class ServeWorker:
                     with self._state:
                         self._busy = False
                         self._state.notify_all()
+            # the maintenance seam: between batch cycles, never
+            # mid-batch, and ALWAYS after the previous batch's riders
+            # were resolved — a long compaction here overlaps at most
+            # the just-launched batch's device compute, never withholds
+            # results that are already sitting ready (the same argument
+            # the retry path makes about deferring _finish).  Cheap
+            # no-op when nothing is due.
+            self.run_maintenance()
 
     def run_once(self) -> bool:
         """Manual stepping for threadless/deterministic operation: form
@@ -237,6 +268,30 @@ class ServeWorker:
             return False
         self.dispatch(batch)
         return True
+
+    def run_maintenance(self) -> None:
+        """Run the maintenance callback (if any) on the calling thread.
+
+        The worker loop calls this between batch cycles; threadless
+        services may step it manually.  ``_busy`` is held (and restored
+        — a pipelined in-flight batch keeps it set) so ``drain``
+        observes maintenance as work in progress: after ``drain()``
+        returns, no compaction is mid-flight.  Never raises."""
+        fn = self._maintenance
+        if fn is None:
+            return
+        with self._state:
+            was_busy = self._busy
+            self._busy = True
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — counted, never loop-fatal
+            _counter("raft_tpu_serve_maintenance_errors_total",
+                     "maintenance callback failures", self.name).inc()
+        finally:
+            with self._state:
+                self._busy = was_busy
+                self._state.notify_all()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop admission and serve out everything queued/in flight.

@@ -136,6 +136,10 @@ class Service:
     query_cache_size:
         > 0 enables the :class:`VecCache` query-vector cache
         (:meth:`cache_put` / :meth:`submit_keys`).
+    maintenance / maintenance_interval_s:
+        Optional background-work callback run on the worker thread
+        between batches (see :class:`ServeWorker`) — the ANN service's
+        compaction seam.
     start:
         Spawn the worker thread now (False = threadless: tests drive
         :attr:`worker` ``.run_once()`` under an injected ``clock``).
@@ -150,6 +154,8 @@ class Service:
                  retry_policy=None,
                  donate: Optional[bool] = None,
                  query_cache_size: int = 0,
+                 maintenance: Optional[Callable[[], None]] = None,
+                 maintenance_interval_s: float = 0.05,
                  start: bool = True,
                  clock: Callable[[], float] = time.monotonic):
         expects(dim >= 1, "Service: dim=%d", dim)
@@ -177,7 +183,11 @@ class Service:
             queue_cap=int(queue_cap), clock=clock)
         self.worker = ServeWorker(name, self.batcher, self.policy,
                                   execute, retry_policy=retry_policy,
-                                  donate=donate_intent, clock=clock)
+                                  donate=donate_intent,
+                                  maintenance=maintenance,
+                                  maintenance_interval_s=(
+                                      maintenance_interval_s),
+                                  clock=clock)
         self.donate = self.worker.donate
         self._warmed: Tuple[int, ...] = ()
         self._closed = False

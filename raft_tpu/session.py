@@ -410,24 +410,31 @@ class Comms:
         session (:mod:`raft_tpu.serve`).
 
         ``kind``: ``"knn"`` (:class:`~raft_tpu.serve.KNNService`;
-        kwargs: ``index``, ``k``, ``metric``, ...) or ``"pairwise"``
+        kwargs: ``index``, ``k``, ``metric``, ...), ``"pairwise"``
         (:class:`~raft_tpu.serve.PairwiseService`; kwargs: ``y``,
-        ``metric``, ...), plus the shared service options
-        (``max_batch_rows``, ``bucket_rungs``, ``max_wait_ms``,
-        ``queue_cap``, ``retry_policy``, ``query_cache_size``).  The
-        session defaults ``retry_policy`` to its own verb policy so
-        per-batch watchdog/retry semantics match the communicator's.
+        ``metric``, ...) or ``"ann"``
+        (:class:`~raft_tpu.serve.ANNService`; kwargs: a prebuilt IVF
+        ``index``, ``k``, ``nprobe``, ``delta_cap``, ...), plus the
+        shared service options (``max_batch_rows``, ``bucket_rungs``,
+        ``max_wait_ms``, ``queue_cap``, ``retry_policy``,
+        ``query_cache_size``).  The session defaults ``retry_policy``
+        to its own verb policy so per-batch watchdog/retry semantics
+        match the communicator's.
 
         Registration is what buys the lifecycle guarantees:
         :meth:`health_check` reports the service and :meth:`destroy`
-        drains it before comms teardown.  The returned service is
-        started; call ``warmup()`` before taking traffic to
-        precompile every shape bucket.
+        drains it before comms teardown — for an ANN service the drain
+        also closes out compaction: the worker thread that runs
+        maintenance is joined, so no index swap is mid-flight when the
+        communicator goes down.  The returned service is started; call
+        ``warmup()`` before taking traffic to precompile every shape
+        bucket (× nprobe cell for ANN).
         """
         expects(self.initialized, "serve: session not initialized")
-        from raft_tpu.serve import KNNService, PairwiseService
+        from raft_tpu.serve import ANNService, KNNService, PairwiseService
 
-        kinds = {"knn": KNNService, "pairwise": PairwiseService}
+        kinds = {"knn": KNNService, "pairwise": PairwiseService,
+                 "ann": ANNService}
         expects(kind in kinds, "serve: unknown service kind %r "
                 "(have: %s)", kind, ", ".join(sorted(kinds)))
         expects(name is None or name not in self._services,

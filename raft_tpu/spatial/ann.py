@@ -37,7 +37,7 @@ metric set, ann_quantized_faiss.cuh:94-118).
 
 from __future__ import annotations
 
-import functools
+import warnings
 from dataclasses import dataclass
 from typing import NamedTuple, Optional, Tuple
 
@@ -49,6 +49,7 @@ from jax import lax
 from raft_tpu import config
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import record_on_handle
+from raft_tpu.core.profiler import profiled_jit
 from raft_tpu.core.utils import round_up_safe
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import expanded_sq_dists
@@ -93,6 +94,12 @@ class IVFFlatIndex(NamedTuple):
     list_sizes: jnp.ndarray    # (nlist,)
     metric: DistanceType
     nprobe: int                # default probe count from build params
+    # (n_slots, cap) precomputed squared norms: computing them in the
+    # probe scan forces the gathered (nq, cap, d) slot block to
+    # materialize (the einsum alone fuses the gather away) — measured
+    # ~10x the whole step's cost on the CPU backend.  Optional only for
+    # hand-built legacy tuples; search falls back to an eager compute.
+    slot_norms: Optional[jnp.ndarray] = None
 
 
 class IVFPQIndex(NamedTuple):
@@ -129,8 +136,42 @@ class IVFSQIndex(NamedTuple):
 # --------------------------------------------------------------------- #
 # shared coarse quantizer plumbing
 # --------------------------------------------------------------------- #
-def _coarse_assign(X, nlist, seed):
-    """k-means coarse quantizer + list assignment."""
+@jax.jit
+def _assign_chunk_jit(chunk, centroids):
+    return jnp.argmin(expanded_sq_dists(chunk, centroids),
+                      axis=1).astype(jnp.int32)
+
+
+def _assign_labels(X, centroids, chunk: int = 131072) -> jnp.ndarray:
+    """Nearest-centroid assignment in row chunks: one (chunk, nlist)
+    expanded-L2 matmul + argmin per step, so the full pass never
+    materializes an (m, nlist) distance matrix for large m."""
+    X = jnp.asarray(X)
+    outs = [_assign_chunk_jit(X[start:start + chunk], centroids)
+            for start in range(0, X.shape[0], chunk)]
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
+def _coarse_assign(X, nlist, seed, train_rows: Optional[int] = None):
+    """k-means coarse quantizer + list assignment.
+
+    ``train_rows`` (opt-in) trains k-means on a seeded row subsample and
+    assigns ALL rows in one chunked nearest-centroid pass — the FAISS
+    ``max_points_per_centroid`` trade: past ~100 training points per
+    centroid the Lloyd iterations dominate build time while centroid
+    quality has long saturated, so a 1M-row build pays minutes of
+    k-means for noise.  ``None`` keeps the historical full-data
+    training (bit-identical to prior builds).
+    """
+    m = X.shape[0]
+    if train_rows is not None and train_rows < m:
+        expects(train_rows >= nlist,
+                "_coarse_assign: train_rows=%d < nlist=%d",
+                train_rows, nlist)
+        rows = np.sort(np.random.default_rng(seed).choice(
+            m, train_rows, replace=False))
+        res = kmeans(X[jnp.asarray(rows)], nlist, seed=seed, max_iter=25)
+        return res.centroids, _assign_labels(X, res.centroids)
     res = kmeans(X, nlist, seed=seed, max_iter=25)
     return res.centroids, res.labels
 
@@ -204,8 +245,37 @@ def _check_metric(name, metric):
             "ann_quantized_faiss.cuh:94-118)", name, int(metric))
 
 
+# entry points that already warned about an over-nlist nprobe clamp (the
+# warning is one-time per entry point: a serving loop probing at a
+# clamped count must not spam a warning per batch)
+_NPROBE_CLAMP_WARNED = set()
+
+
+def _validate_nprobe(name: str, nprobe, nlist: int) -> int:
+    """Validate and resolve a probe count at the public entry points.
+
+    A non-positive ``nprobe`` is a caller bug and raises
+    :class:`~raft_tpu.core.error.LogicError`; ``nprobe > nlist`` is
+    clamped to ``nlist`` with a one-time warning (probing every list is
+    well-defined — a full scan — but almost always a mis-sized knob, and
+    silently passing the oversized count into the probe scan would bake
+    garbage probe ranks into the compiled program's shape).
+    """
+    nprobe = int(nprobe)
+    expects(nprobe >= 1, "%s: nprobe must be >= 1, got %d", name, nprobe)
+    if nprobe > nlist:
+        if name not in _NPROBE_CLAMP_WARNED:
+            _NPROBE_CLAMP_WARNED.add(name)
+            warnings.warn(
+                "%s: nprobe=%d exceeds nlist=%d; clamping to nlist "
+                "(reported once per entry point)" % (name, nprobe, nlist),
+                stacklevel=3)
+        nprobe = nlist
+    return nprobe
+
+
 def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
-                       metric, probes=None):
+                       metric, probes=None, select_impl=None):
     """Shared IVF search driver: probe centroids, then scan the probed
     lists' slots one at a time with a running top-k.
 
@@ -229,7 +299,8 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
     nprobe = min(nprobe, nlist)
     if probes is None:
         qc = expanded_sq_dists(q, centroids)
-        _, probes = select_k(qc, nprobe, select_min=True)    # (nq, nprobe)
+        _, probes = select_k(qc, nprobe, select_min=True,
+                             impl=select_impl)               # (nq, nprobe)
     slots = cent_slots[probes].reshape(nq, -1)               # -1-padded
     prank = jnp.broadcast_to(
         jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_slots)[None],
@@ -257,7 +328,8 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
                          jnp.inf).astype(dt)
         cat_d = jnp.concatenate([run_d, dist], axis=1)
         cat_i = jnp.concatenate([run_i, ids], axis=1)
-        return select_k(cat_d, k, select_min=True, values=cat_i)
+        return select_k(cat_d, k, select_min=True, values=cat_i,
+                        impl=select_impl)
 
     dist, ids = lax.fori_loop(0, n_live, body, init)
     if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
@@ -265,6 +337,74 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
     return dist, ids
 
 
+# --------------------------------------------------------------------- #
+# delta segment: streaming-ingestion merge (docs/SERVING.md)
+# --------------------------------------------------------------------- #
+def _delta_merge_impl(delta_vecs, delta_ids, base_d, base_i, q, k, sqrt):
+    """Brute-force scan of an append-only delta segment merged into a
+    base (IVF) result stream.
+
+    ``delta_ids < 0`` marks unfilled capacity rows — their distances are
+    forced to ``+inf`` so they can never displace a real candidate, and
+    the segment keeps ONE static shape however full it is (a growing
+    delta must not retrace the serving executables).  Base entries ride
+    first in the concatenation, so on exact ties the stable top-k keeps
+    the base copy — results are deterministic across a compaction swap
+    that migrates a row from delta to base storage.
+    """
+    qn = jnp.sum(q * q, axis=1)
+    dn = jnp.sum(delta_vecs * delta_vecs, axis=1)
+    dist = (qn[:, None] + dn[None, :]
+            - 2.0 * jnp.einsum("nd,cd->nc", q, delta_vecs,
+                               precision="highest"))
+    valid = delta_ids >= 0
+    dist = jnp.where(valid[None, :], jnp.maximum(dist, 0.0),
+                     jnp.inf).astype(base_d.dtype)
+    if sqrt:
+        # the base stream already carries sqrted distances (the search
+        # applies the metric's sqrt before returning) — match it so the
+        # merged keys are commensurable
+        dist = jnp.sqrt(dist)
+    ids = jnp.broadcast_to(
+        jnp.where(valid, delta_ids, -1).astype(jnp.int32)[None, :],
+        dist.shape)
+    cat_d = jnp.concatenate([base_d, dist], axis=1)
+    cat_i = jnp.concatenate([base_i.astype(jnp.int32), ids], axis=1)
+    # the base-first tie rule above IS the determinism-across-swap
+    # contract, and only the stable "topk" payload select honors tie
+    # order — so this one select is pinned regardless of the caller's
+    # select_impl (which still speeds the per-step probe scans).  Cost:
+    # one (nq, k + delta_cap) sort per batch, only on the delta arm.
+    return select_k(cat_d, k, select_min=True, values=cat_i,
+                    impl="topk")
+
+
+_DELTA_STATICS = ("k", "sqrt")
+_delta_merge_jit = profiled_jit(
+    name="ann_delta_merge",
+    static_argnames=_DELTA_STATICS)(_delta_merge_impl)
+# donating twin (docs/ZERO_COPY.md): a separate wrapper, not a flag — a
+# donating and a non-donating executable must never share a cache slot
+_delta_merge_jit_donated = profiled_jit(
+    name="ann_delta_merge_donated", static_argnames=_DELTA_STATICS,
+    donate_argnames=("q",))(_delta_merge_impl)
+
+
+def _merge_delta(out, delta, q, k, metric, donate_queries):
+    """Apply the delta-segment merge to a base search result (shared by
+    the three quantizer entry points)."""
+    delta_vecs, delta_ids = delta
+    delta_vecs = jnp.asarray(delta_vecs)
+    delta_ids = jnp.asarray(delta_ids, jnp.int32)
+    expects(delta_vecs.ndim == 2 and delta_vecs.shape[1] == q.shape[1],
+            "ann delta segment: expected (rows, %d) vectors, got %r",
+            q.shape[1], tuple(delta_vecs.shape))
+    expects(delta_ids.shape == (delta_vecs.shape[0],),
+            "ann delta segment: ids shape %r does not match %d rows",
+            tuple(delta_ids.shape), delta_vecs.shape[0])
+    sqrt = metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded)
+    fn = _delta_merge_jit_donated if donate_queries else _delta_merge_jit
+    return fn(delta_vecs, delta_ids, out[0], out[1], q, k, sqrt)
 
 
 # --------------------------------------------------------------------- #
@@ -272,14 +412,16 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
 # --------------------------------------------------------------------- #
 def ivf_flat_build(X, params: IVFFlatParams,
                    metric: DistanceType = D.L2Expanded,
-                   seed: int = 1234, handle=None) -> IVFFlatIndex:
+                   seed: int = 1234, handle=None,
+                   train_rows: Optional[int] = None) -> IVFFlatIndex:
     """Build an IVF-Flat index (reference approx_knn_build_index IVFFlat
-    path, ann_quantized_faiss.cuh:129-141)."""
+    path, ann_quantized_faiss.cuh:129-141).  ``train_rows`` opts into
+    subsampled k-means training (:func:`_coarse_assign`)."""
     X = jnp.asarray(X)
     m, d = X.shape
     expects(params.nlist <= m, "ivf_flat_build: nlist > n_vectors")
     _check_metric("ivf_flat_build", metric)
-    centroids, labels = _coarse_assign(X, params.nlist, seed)
+    centroids, labels = _coarse_assign(X, params.nlist, seed, train_rows)
     slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
         np.asarray(labels), params.nlist)
     rows_j = jnp.asarray(slot_rows)
@@ -287,39 +429,79 @@ def ivf_flat_build(X, params: IVFFlatParams,
     slot_vecs = X[gather] * (rows_j >= 0)[..., None]
     idx = IVFFlatIndex(centroids, slot_vecs, rows_j, jnp.asarray(slot_cent),
                        jnp.asarray(cent_slots),
-                       jnp.asarray(counts, jnp.int32), metric, params.nprobe)
+                       jnp.asarray(counts, jnp.int32), metric, params.nprobe,
+                       slot_norms=jnp.sum(slot_vecs * slot_vecs, -1))
     record_on_handle(handle, slot_vecs)
     return idx
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric"))
-def _ivf_flat_search_jit(centroids, slot_vecs, slot_ids, cent_slots, q, k,
-                         nprobe, metric):
+def _ivf_flat_search_impl(centroids, slot_vecs, slot_norms, slot_ids,
+                          cent_slots, q, k, nprobe, metric,
+                          select_impl=None):
     qn = jnp.sum(q * q, axis=1)
 
     def step_dist(slx, _pjx):
         vecs = slot_vecs[slx]                         # (nq, cap, d)
         ids = slot_ids[slx]                           # (nq, cap)
-        dist = (qn[:, None] + jnp.sum(vecs * vecs, -1)
+        # precomputed slot norms: the gathered vecs block then feeds
+        # ONLY the einsum, which fuses the gather away instead of
+        # materializing (nq, cap, d) (the index-field comment)
+        dist = (qn[:, None] + slot_norms[slx]
                 - 2.0 * jnp.einsum("nd,ncd->nc", q, vecs,
                                    precision="highest"))
         return dist, ids
 
     return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
-                              nprobe, metric)
+                              nprobe, metric, select_impl=select_impl)
+
+
+# profiled_jit (not bare jax.jit): the serving layer's warmup proof and
+# loadgen's post-warmup-compile count read compile_cache_stats(), so the
+# programs ANNService fronts must attribute their compiles there like
+# every other served primitive (tiled_knn, serve_pairwise)
+_IVF_FLAT_STATICS = ("k", "nprobe", "metric", "select_impl")
+_ivf_flat_search_jit = profiled_jit(
+    name="ivf_flat_search",
+    static_argnames=_IVF_FLAT_STATICS)(_ivf_flat_search_impl)
+_ivf_flat_search_jit_donated = profiled_jit(
+    name="ivf_flat_search_donated", static_argnames=_IVF_FLAT_STATICS,
+    donate_argnames=("q",))(_ivf_flat_search_impl)
 
 
 def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
-                    nprobe: Optional[int] = None, handle=None
+                    nprobe: Optional[int] = None, handle=None, *,
+                    delta=None, donate_queries: bool = False,
+                    select_impl: Optional[str] = None
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search an IVF-Flat index (reference approx_knn_search, ann.hpp:71);
-    ``nprobe`` defaults to the build params' value."""
+    ``nprobe`` defaults to the build params' value.
+
+    ``delta=(vectors, ids)`` merges an append-only delta segment into
+    the result stream (:func:`_delta_merge_impl`); ``donate_queries``
+    donates the query buffer to the LAST program that consumes it
+    (docs/ZERO_COPY.md) — callers must not reuse ``queries`` after a
+    donating call.  ``select_impl`` pins the per-step top-k
+    implementation explicitly (None = the ``select_impl`` knob;
+    ``"approx"`` is membership-exact at recall 1.0 and measured ~7x
+    faster than the full-sort payload path at k=100 on the CPU
+    backend, at the cost of tie order).
+    """
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
-    expects(nprobe >= 1, "ivf_flat_search: nprobe must be >= 1")
-    out = _ivf_flat_search_jit(index.centroids, index.slot_vecs,
-                               index.slot_ids, index.cent_slots,
-                               q, k, nprobe, DistanceType(int(index.metric)))
+    nprobe = _validate_nprobe("ivf_flat_search", nprobe,
+                              int(index.centroids.shape[0]))
+    metric = DistanceType(int(index.metric))
+    norms = index.slot_norms
+    if norms is None:   # hand-built legacy tuple: eager fallback
+        norms = jnp.sum(index.slot_vecs * index.slot_vecs, -1)
+    base_fn = (_ivf_flat_search_jit_donated
+               if donate_queries and delta is None
+               else _ivf_flat_search_jit)
+    out = base_fn(index.centroids, index.slot_vecs, norms,
+                  index.slot_ids, index.cent_slots, q, k, nprobe,
+                  metric, select_impl=select_impl)
+    if delta is not None:
+        out = _merge_delta(out, delta, q, k, metric, donate_queries)
     record_on_handle(handle, *out)
     return out
 
@@ -329,7 +511,8 @@ def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
 # --------------------------------------------------------------------- #
 def ivf_pq_build(X, params: IVFPQParams,
                  metric: DistanceType = D.L2Expanded,
-                 seed: int = 1234, handle=None) -> IVFPQIndex:
+                 seed: int = 1234, handle=None,
+                 train_rows: Optional[int] = None) -> IVFPQIndex:
     """Build IVF-PQ: coarse quantize, then per-subspace k-means codebooks
     over residuals (reference IVFPQ path, ann_quantized_faiss.cuh:143-160)."""
     X = jnp.asarray(X)
@@ -338,7 +521,7 @@ def ivf_pq_build(X, params: IVFPQParams,
     expects(d % M == 0, "ivf_pq_build: dim %d not divisible by M=%d", d, M)
     _check_metric("ivf_pq_build", metric)
     dsub = d // M
-    centroids, labels = _coarse_assign(X, params.nlist, seed)
+    centroids, labels = _coarse_assign(X, params.nlist, seed, train_rows)
     resid = X - centroids[labels]
 
     codebooks = []
@@ -370,11 +553,9 @@ def ivf_pq_build(X, params: IVFPQParams,
     return idx
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("k", "nprobe", "metric", "adc"))
-def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
-                       slot_centroid, cent_slots, q, k, nprobe, metric,
-                       adc="gather"):
+def _ivf_pq_search_impl(centroids, codebooks, slot_codes, slot_ids,
+                        slot_centroid, cent_slots, q, k, nprobe, metric,
+                        adc="gather", select_impl=None):
     M, ksub, dsub = codebooks.shape
     nlist = centroids.shape[0]
     nq = q.shape[0]
@@ -387,7 +568,8 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
     # unguaranteed tie order (approx_max_k).
     np_eff = min(nprobe, nlist)
     qc = expanded_sq_dists(q, centroids)
-    _, probes = select_k(qc, np_eff, select_min=True)   # (nq, np_eff)
+    _, probes = select_k(qc, np_eff, select_min=True,
+                         impl=select_impl)              # (nq, np_eff)
     resid = q[:, None, :] - centroids[probes]           # (nq, np_eff, d)
     rs = resid.reshape(nq, np_eff, M, dsub)
     lut_all = (jnp.sum(rs * rs, -1)[..., None] + cb_norms[None, None]
@@ -427,11 +609,20 @@ def _ivf_pq_search_jit(centroids, codebooks, slot_codes, slot_ids,
         return dist, slot_ids[slx]
 
     return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
-                              nprobe, metric, probes=probes)
+                              nprobe, metric, probes=probes,
+                              select_impl=select_impl)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "sqrt"))
-def _refine_jit(vectors, q, cand_ids, k, sqrt):
+_IVF_PQ_STATICS = ("k", "nprobe", "metric", "adc", "select_impl")
+_ivf_pq_search_jit = profiled_jit(
+    name="ivf_pq_search",
+    static_argnames=_IVF_PQ_STATICS)(_ivf_pq_search_impl)
+_ivf_pq_search_jit_donated = profiled_jit(
+    name="ivf_pq_search_donated", static_argnames=_IVF_PQ_STATICS,
+    donate_argnames=("q",))(_ivf_pq_search_impl)
+
+
+def _refine_impl(vectors, q, cand_ids, k, sqrt):
     """Exact re-rank of ADC candidates against the original vectors
     (the quality half of FAISS's IndexRefineFlat, which the reference
     inherits via ann_quantized_faiss.cuh:75)."""
@@ -447,15 +638,29 @@ def _refine_jit(vectors, q, cand_ids, k, sqrt):
     return out_d, out_i
 
 
+_REFINE_STATICS = ("k", "sqrt")
+_refine_jit = profiled_jit(
+    name="ivf_pq_refine", static_argnames=_REFINE_STATICS)(_refine_impl)
+_refine_jit_donated = profiled_jit(
+    name="ivf_pq_refine_donated", static_argnames=_REFINE_STATICS,
+    donate_argnames=("q",))(_refine_impl)
+
+
 def ivf_pq_search(index: IVFPQIndex, queries, k: int,
                   nprobe: Optional[int] = None,
-                  refine_ratio: Optional[int] = None, handle=None):
+                  refine_ratio: Optional[int] = None, handle=None, *,
+                  delta=None, donate_queries: bool = False,
+                  select_impl: Optional[str] = None):
     """ADC search; when the index holds original vectors and
     ``refine_ratio`` (default: build-time value) is > 1, the top
-    ``k*refine_ratio`` ADC candidates are re-ranked exactly."""
+    ``k*refine_ratio`` ADC candidates are re-ranked exactly.
+    ``delta`` / ``donate_queries`` as in :func:`ivf_flat_search`; the
+    query buffer is donated only to the LAST stage that consumes it
+    (ADC scan → refine → delta merge)."""
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
-    expects(nprobe >= 1, "ivf_pq_search: nprobe must be >= 1")
+    nprobe = _validate_nprobe("ivf_pq_search", nprobe,
+                              int(index.centroids.shape[0]))
     ratio = index.refine_ratio if refine_ratio is None else refine_ratio
     ratio = max(int(ratio), 1)
     refine = ratio > 1 and index.vectors is not None
@@ -467,13 +672,21 @@ def ivf_pq_search(index: IVFPQIndex, queries, k: int,
     adc = config.get("pq_adc")
     expects(adc in ("gather", "onehot"),
             "ivf_pq_search: unknown pq_adc %s", adc)
-    out = _ivf_pq_search_jit(index.centroids, index.codebooks,
-                             index.slot_codes, index.slot_ids,
-                             index.slot_centroid, index.cent_slots,
-                             q, k_search, nprobe, metric, adc=adc)
+    base_fn = (_ivf_pq_search_jit_donated
+               if donate_queries and not refine and delta is None
+               else _ivf_pq_search_jit)
+    out = base_fn(index.centroids, index.codebooks,
+                  index.slot_codes, index.slot_ids,
+                  index.slot_centroid, index.cent_slots,
+                  q, k_search, nprobe, metric, adc=adc,
+                  select_impl=select_impl)
     if refine:
         sqrt = metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded)
-        out = _refine_jit(index.vectors, q, out[1], k, sqrt)
+        refine_fn = (_refine_jit_donated
+                     if donate_queries and delta is None else _refine_jit)
+        out = refine_fn(index.vectors, q, out[1], k, sqrt)
+    if delta is not None:
+        out = _merge_delta(out, delta, q, k, metric, donate_queries)
     record_on_handle(handle, *out)
     return out
 
@@ -483,14 +696,15 @@ def ivf_pq_search(index: IVFPQIndex, queries, k: int,
 # --------------------------------------------------------------------- #
 def ivf_sq_build(X, params: IVFSQParams,
                  metric: DistanceType = D.L2Expanded,
-                 seed: int = 1234, handle=None) -> IVFSQIndex:
+                 seed: int = 1234, handle=None,
+                 train_rows: Optional[int] = None) -> IVFSQIndex:
     """8-bit scalar quantization of residuals (QT_8bit; reference IVFSQ
     path, ann_quantized_faiss.cuh:162-176)."""
     expects(params.qtype in ("QT_8bit", "QT_8bit_uniform"),
             "ivf_sq_build: unsupported qtype %s", params.qtype)
     _check_metric("ivf_sq_build", metric)
     X = jnp.asarray(X)
-    centroids, labels = _coarse_assign(X, params.nlist, seed)
+    centroids, labels = _coarse_assign(X, params.nlist, seed, train_rows)
     resid = X - centroids[labels] if params.encode_residual else X
     lo = jnp.min(resid, axis=0)
     hi = jnp.max(resid, axis=0)
@@ -514,11 +728,9 @@ def ivf_sq_build(X, params: IVFSQParams,
     return idx
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe",
-                                             "encode_residual", "metric"))
-def _ivf_sq_search_jit(centroids, slot_q, scale, offset, slot_ids,
-                       slot_centroid, cent_slots, q, k, nprobe,
-                       encode_residual, metric):
+def _ivf_sq_search_impl(centroids, slot_q, scale, offset, slot_ids,
+                        slot_centroid, cent_slots, q, k, nprobe,
+                        encode_residual, metric, select_impl=None):
     qn = jnp.sum(q * q, axis=1)
 
     def step_dist(slx, _pjx):
@@ -533,21 +745,139 @@ def _ivf_sq_search_jit(centroids, slot_q, scale, offset, slot_ids,
         return dist, slot_ids[slx]
 
     return _probe_scan_search(q, centroids, cent_slots, step_dist, k,
-                              nprobe, metric)
+                              nprobe, metric, select_impl=select_impl)
+
+
+_IVF_SQ_STATICS = ("k", "nprobe", "encode_residual", "metric",
+                   "select_impl")
+_ivf_sq_search_jit = profiled_jit(
+    name="ivf_sq_search",
+    static_argnames=_IVF_SQ_STATICS)(_ivf_sq_search_impl)
+_ivf_sq_search_jit_donated = profiled_jit(
+    name="ivf_sq_search_donated", static_argnames=_IVF_SQ_STATICS,
+    donate_argnames=("q",))(_ivf_sq_search_impl)
 
 
 def ivf_sq_search(index: IVFSQIndex, queries, k: int,
-                  nprobe: Optional[int] = None, handle=None):
-    """Search; honors the build-time ``encode_residual`` setting."""
+                  nprobe: Optional[int] = None, handle=None, *,
+                  delta=None, donate_queries: bool = False,
+                  select_impl: Optional[str] = None):
+    """Search; honors the build-time ``encode_residual`` setting.
+    ``delta`` / ``donate_queries`` / ``select_impl`` as in
+    :func:`ivf_flat_search`."""
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
-    expects(nprobe >= 1, "ivf_sq_search: nprobe must be >= 1")
-    out = _ivf_sq_search_jit(index.centroids, index.slot_q, index.scale,
-                             index.offset, index.slot_ids,
-                             index.slot_centroid, index.cent_slots,
-                             q, k, nprobe, bool(index.encode_residual),
-                             DistanceType(int(index.metric)))
+    nprobe = _validate_nprobe("ivf_sq_search", nprobe,
+                              int(index.centroids.shape[0]))
+    base_fn = (_ivf_sq_search_jit_donated
+               if donate_queries and delta is None
+               else _ivf_sq_search_jit)
+    out = base_fn(index.centroids, index.slot_q, index.scale,
+                  index.offset, index.slot_ids,
+                  index.slot_centroid, index.cent_slots,
+                  q, k, nprobe, bool(index.encode_residual),
+                  DistanceType(int(index.metric)),
+                  select_impl=select_impl)
+    if delta is not None:
+        out = _merge_delta(out, delta, q, k,
+                           DistanceType(int(index.metric)),
+                           donate_queries)
     record_on_handle(handle, *out)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# streaming ingestion: reconstruction + compaction (docs/SERVING.md)
+# --------------------------------------------------------------------- #
+def ivf_flat_reconstruct(index: IVFFlatIndex
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Recover the stored ``(vectors, ids)`` from slot storage (valid
+    rows only, slot order).  The exact inverse of the build gather —
+    IVF-Flat stores raw vectors, so reconstruction is lossless."""
+    ids = np.asarray(index.slot_ids).reshape(-1)
+    mask = ids >= 0
+    vecs = np.asarray(index.slot_vecs).reshape(
+        -1, index.slot_vecs.shape[-1])
+    return vecs[mask], ids[mask].astype(np.int64)
+
+
+def ivf_flat_extend(index: IVFFlatIndex, vectors, ids, *,
+                    slot_multiple: int = 64,
+                    handle=None) -> IVFFlatIndex:
+    """Fold new rows into an existing IVF-Flat index WITHOUT re-running
+    k-means: assign each new vector to its nearest existing centroid,
+    then rebuild the slotted storage over old + new rows — the
+    compaction half of streaming ingestion (docs/SERVING.md).
+
+    Centroids, metric, default nprobe and slot ``cap`` are preserved;
+    ``slot_ids`` carry the caller's global id space (the existing
+    index's ids plus ``ids``; keeping them collision-free is the
+    caller's contract).  ``slot_multiple`` rounds the rebuilt slot count
+    (and the per-list slot-table width, to a multiple of 8) UP, so
+    successive compactions that stay inside the rounded shape reuse the
+    compiled search executables instead of paying one recompile per
+    compaction — padding slots are never referenced by ``cent_slots``
+    and cost no scan time (the probe scan is compacted valid-first).
+    """
+    expects(slot_multiple >= 1, "ivf_flat_extend: slot_multiple=%d",
+            slot_multiple)
+    new_vecs = jnp.asarray(vectors)
+    expects(new_vecs.ndim == 2
+            and new_vecs.shape[1] == index.centroids.shape[1],
+            "ivf_flat_extend: expected (rows, %d) vectors, got %r",
+            int(index.centroids.shape[1]), tuple(new_vecs.shape))
+    new_ids = np.asarray(ids, np.int64).ravel()
+    expects(new_ids.shape[0] == new_vecs.shape[0],
+            "ivf_flat_extend: %d ids for %d vectors",
+            new_ids.shape[0], new_vecs.shape[0])
+    nlist = int(index.centroids.shape[0])
+    cap = int(index.slot_vecs.shape[1])
+
+    old_vecs, old_ids = ivf_flat_reconstruct(index)
+    old_labels = np.repeat(np.asarray(index.slot_centroid), cap)[
+        np.asarray(index.slot_ids).reshape(-1) >= 0]
+    if new_vecs.shape[0]:
+        new_labels = np.asarray(_assign_labels(new_vecs,
+                                               index.centroids))
+        all_vecs = np.concatenate(
+            [old_vecs, np.asarray(new_vecs, old_vecs.dtype)], axis=0)
+        all_ids = np.concatenate([old_ids, new_ids])
+        labels = np.concatenate(
+            [old_labels.astype(np.int64), new_labels.astype(np.int64)])
+    else:
+        all_vecs, all_ids = old_vecs, old_ids
+        labels = old_labels.astype(np.int64)
+
+    slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
+        labels, nlist, cap=cap)
+    # shape-stability padding: extra slots hold ids=-1 / zero vectors
+    # and no cent_slots entry points at them
+    n_slots = slot_rows.shape[0]
+    pad_slots = round_up_safe(max(n_slots, 1), slot_multiple) - n_slots
+    if pad_slots:
+        slot_rows = np.concatenate(
+            [slot_rows, np.full((pad_slots, cap), -1, slot_rows.dtype)])
+        slot_cent = np.concatenate(
+            [slot_cent, np.zeros(pad_slots, slot_cent.dtype)])
+    max_slots = cent_slots.shape[1]
+    pad_width = round_up_safe(max(max_slots, 1), 8) - max_slots
+    if pad_width:
+        cent_slots = np.concatenate(
+            [cent_slots, np.full((nlist, pad_width), -1,
+                                 cent_slots.dtype)], axis=1)
+
+    rows_j = jnp.asarray(slot_rows)
+    gather = jnp.where(rows_j >= 0, rows_j, 0)
+    all_v = jnp.asarray(all_vecs)
+    slot_vecs = all_v[gather] * (rows_j >= 0)[..., None]
+    slot_ids = jnp.where(rows_j >= 0,
+                         jnp.asarray(all_ids, jnp.int32)[gather], -1)
+    out = IVFFlatIndex(index.centroids, slot_vecs, slot_ids,
+                       jnp.asarray(slot_cent), jnp.asarray(cent_slots),
+                       jnp.asarray(counts, jnp.int32), index.metric,
+                       index.nprobe,
+                       slot_norms=jnp.sum(slot_vecs * slot_vecs, -1))
+    record_on_handle(handle, slot_vecs)
     return out
 
 
@@ -555,23 +885,40 @@ def ivf_sq_search(index: IVFSQIndex, queries, k: int,
 # dispatcher (reference ann.hpp:45,71)
 # --------------------------------------------------------------------- #
 def approx_knn_build_index(X, params, metric: DistanceType = D.L2Expanded,
-                           seed: int = 1234, handle=None):
+                           seed: int = 1234, handle=None,
+                           train_rows: Optional[int] = None):
     if isinstance(params, IVFPQParams):
-        return ivf_pq_build(X, params, metric, seed, handle=handle)
+        return ivf_pq_build(X, params, metric, seed, handle=handle,
+                            train_rows=train_rows)
     if isinstance(params, IVFSQParams):
-        return ivf_sq_build(X, params, metric, seed, handle=handle)
+        return ivf_sq_build(X, params, metric, seed, handle=handle,
+                            train_rows=train_rows)
     if isinstance(params, IVFFlatParams):
-        return ivf_flat_build(X, params, metric, seed, handle=handle)
+        return ivf_flat_build(X, params, metric, seed, handle=handle,
+                              train_rows=train_rows)
     raise TypeError(f"unknown ANN params {type(params)}")
 
 
 def approx_knn_search(index, queries, k: int, nprobe: Optional[int] = None,
-                      refine_ratio: Optional[int] = None, handle=None):
+                      refine_ratio: Optional[int] = None, handle=None, *,
+                      delta=None, donate_queries: bool = False,
+                      select_impl: Optional[str] = None):
+    """Dispatch by index type; ``delta=(vectors, ids)`` merges an
+    append-only delta segment into the result stream,
+    ``donate_queries`` donates the query buffer to its last consumer,
+    and ``select_impl`` pins the top-k implementation
+    (see :func:`ivf_flat_search`)."""
     if isinstance(index, IVFPQIndex):
         return ivf_pq_search(index, queries, k, nprobe,
-                             refine_ratio=refine_ratio, handle=handle)
+                             refine_ratio=refine_ratio, handle=handle,
+                             delta=delta, donate_queries=donate_queries,
+                             select_impl=select_impl)
     if isinstance(index, IVFSQIndex):
-        return ivf_sq_search(index, queries, k, nprobe, handle=handle)
+        return ivf_sq_search(index, queries, k, nprobe, handle=handle,
+                             delta=delta, donate_queries=donate_queries,
+                             select_impl=select_impl)
     if isinstance(index, IVFFlatIndex):
-        return ivf_flat_search(index, queries, k, nprobe, handle=handle)
+        return ivf_flat_search(index, queries, k, nprobe, handle=handle,
+                               delta=delta, donate_queries=donate_queries,
+                               select_impl=select_impl)
     raise TypeError(f"unknown ANN index {type(index)}")

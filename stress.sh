@@ -12,9 +12,11 @@
 #
 # `./stress.sh serve [N]` loops the serving-layer suite N times
 # (default 10) with a rotating data/submit-order seed
-# (RAFT_TPU_SERVE_SEED) — the concurrent-submitter tests are the only
-# genuinely nondeterministic scheduling in the library, so the loop is
-# what shakes out batching/drain races; a failure reproduces with the
+# (RAFT_TPU_SERVE_SEED) — the concurrent-submitter tests (including
+# test_serve_ann.py's insert/compaction-under-traffic interleavings,
+# same `serve` marker) are the only genuinely nondeterministic
+# scheduling in the library, so the loop is what shakes out
+# batching/drain/compaction races; a failure reproduces with the
 # printed seed.
 set -euo pipefail
 cd "$(dirname "$0")"

@@ -176,6 +176,108 @@ class TestParams:
                                    D.InnerProduct)
 
 
+class TestNprobeValidation:
+    """ISSUE 6 satellite: nprobe edge regressions — non-positive raises
+    LogicError, over-nlist clamps with a one-time warning instead of
+    passing garbage into the probe scan."""
+
+    @pytest.fixture
+    def flat(self, data):
+        X, Q = data
+        return approx_knn_build_index(
+            X, IVFFlatParams(nlist=10, nprobe=4), D.L2Expanded), Q
+
+    @pytest.mark.parametrize("bad", [0, -1, -7])
+    def test_nonpositive_nprobe_raises(self, flat, bad):
+        from raft_tpu.core.error import LogicError
+
+        idx, Q = flat
+        with pytest.raises(LogicError):
+            approx_knn_search(idx, Q, k=5, nprobe=bad)
+
+    @pytest.mark.parametrize("params", [
+        IVFFlatParams(nlist=10, nprobe=4),
+        IVFPQParams(nlist=10, nprobe=4, M=4),
+        IVFSQParams(nlist=10, nprobe=4),
+    ])
+    def test_nonpositive_nprobe_raises_all_kinds(self, data, params):
+        from raft_tpu.core.error import LogicError
+
+        X, Q = data
+        idx = approx_knn_build_index(X, params, D.L2Expanded)
+        with pytest.raises(LogicError):
+            approx_knn_search(idx, Q, k=5, nprobe=0)
+
+    def test_oversized_nprobe_clamps_with_one_time_warning(self, flat):
+        import warnings
+
+        from raft_tpu.spatial import ann as ann_mod
+
+        idx, Q = flat
+        ann_mod._NPROBE_CLAMP_WARNED.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            d_big, i_big = approx_knn_search(idx, Q, k=5, nprobe=999)
+        clamp_w = [x for x in w if "clamping to nlist" in str(x.message)]
+        assert len(clamp_w) == 1
+        # clamped == explicit full probe, bitwise
+        d_full, i_full = approx_knn_search(idx, Q, k=5, nprobe=10)
+        assert (np.asarray(d_big) == np.asarray(d_full)).all()
+        assert (np.asarray(i_big) == np.asarray(i_full)).all()
+        # one-time: a second oversized call does not warn again
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            approx_knn_search(idx, Q, k=5, nprobe=999)
+        assert not [x for x in w2
+                    if "clamping to nlist" in str(x.message)]
+
+
+class TestDeltaSegment:
+    """Delta-aware search entry points (streaming ingestion substrate):
+    empty delta is a bitwise no-op, live delta rows merge exactly, and
+    ivf_flat_extend folds them in losslessly."""
+
+    def test_empty_delta_is_identity(self, data):
+        import jax.numpy as jnp
+
+        X, Q = data
+        idx = approx_knn_build_index(
+            X, IVFFlatParams(nlist=10, nprobe=4), D.L2Expanded)
+        d0, i0 = approx_knn_search(idx, Q, k=5)
+        blank = (jnp.zeros((16, 16), jnp.float32),
+                 jnp.full((16,), -1, jnp.int32))
+        d1, i1 = approx_knn_search(idx, Q, k=5, delta=blank)
+        assert (np.asarray(d0) == np.asarray(d1)).all()
+        assert (np.asarray(i0) == np.asarray(i1)).all()
+
+    def test_delta_rows_merge_and_extend_matches(self, data):
+        import jax.numpy as jnp
+
+        X, Q = data
+        idx = approx_knn_build_index(
+            X, IVFFlatParams(nlist=10, nprobe=10), D.L2Expanded)
+        # delta = 3 perturbed queries under fresh global ids
+        dv = np.zeros((8, 16), np.float32)
+        di = np.full(8, -1, np.int32)
+        dv[:3] = Q[:3] + 1e-3
+        di[:3] = [2000, 2001, 2002]
+        d1, i1 = approx_knn_search(
+            idx, Q, k=5, delta=(jnp.asarray(dv), jnp.asarray(di)))
+        assert (np.asarray(i1)[:3, 0] == di[:3]).all()
+        # brute force over X + delta rows agrees on the id sets
+        X_aug = np.concatenate([X, dv[:3]])
+        _, ref = brute(X_aug, Q, 5)
+        ref_ids = np.where(ref >= 1000, ref + 1000, ref)
+        assert recall(np.asarray(i1), ref_ids) == 1.0
+        # compaction: extend produces the same answers from slot storage
+        from raft_tpu.spatial.ann import ivf_flat_extend
+
+        idx2 = ivf_flat_extend(idx, dv[:3], di[:3])
+        d2, i2 = approx_knn_search(idx2, Q, k=5)
+        assert (np.asarray(i2) == np.asarray(i1)).all()
+        assert np.allclose(np.asarray(d2), np.asarray(d1), atol=1e-4)
+
+
 class TestBallCover:
     @pytest.mark.parametrize("metric", [D.L2SqrtExpanded, D.L2Expanded])
     def test_exact_2d(self, metric):

@@ -1,0 +1,417 @@
+"""ANNService (raft_tpu.serve.ann_service): served-vs-direct identity,
+warmup compile-cache proof across rungs x nprobe cells, streaming
+ingestion (insert visibility, delta overflow shed), compaction (manual,
+automatic under concurrent traffic, drain ordering), recall-targeted
+calibration, session integration, and the loadgen recall@k scoring.
+
+Deterministic halves run threadless services (``start=False``) stepped
+through ``worker.run_once()`` / explicit ``compact()`` calls; the
+concurrency half runs real workers with tiny windows and thresholds
+(``./stress.sh serve N`` rotates RAFT_TPU_SERVE_SEED over this file
+too — same ``serve`` marker).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import (
+    LogicError,
+    ServiceOverloadError,
+)
+from raft_tpu.core.metrics import default_registry
+from raft_tpu.core.profiler import (
+    compile_cache_stats,
+    reset_compile_cache_stats,
+)
+from raft_tpu.serve import ANNService
+from raft_tpu.spatial import ann
+from raft_tpu.spatial.knn import brute_force_knn
+
+pytestmark = pytest.mark.serve
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def flat_index(rng):
+    X = jnp.asarray(rng.standard_normal((2000, 24)), jnp.float32)
+    return ann.ivf_flat_build(X, ann.IVFFlatParams(nlist=16, nprobe=8),
+                              seed=SEED)
+
+
+def _total_misses():
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
+
+
+def make_ann(index, *, start=False, **kw):
+    kw.setdefault("max_batch_rows", 32)
+    kw.setdefault("bucket_rungs", (8, 32))
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("nprobe_ladder", (4, 8))
+    kw.setdefault("delta_cap", 64)
+    kw.setdefault("compact_rows", 0)   # manual compaction by default
+    return ANNService(index, k=10, start=start, **kw)
+
+
+def _step(svc, fut, timeout=1.0):
+    """Drive a threadless worker until ``fut`` resolves (the window is
+    wall-clock; poll run_once until the batcher releases the batch)."""
+    t0 = time.monotonic()
+    while not fut.done():
+        svc.worker.run_once()
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("future did not resolve")
+        time.sleep(0.002)
+    return fut.result(timeout=0)
+
+
+class TestServedVsDirect:
+    def test_bit_identity_no_donate(self, flat_index, rng):
+        svc = make_ann(flat_index, donate=False)
+        q = jnp.asarray(rng.standard_normal((6, 24)), jnp.float32)
+        d, i = _step(svc, svc.submit(q))
+        d0, i0 = ann.ivf_flat_search(flat_index, q, 10)
+        # same profiled_jit executable (empty delta, no donation):
+        # bitwise equality, not closeness
+        assert bool((np.asarray(d) == np.asarray(d0)).all())
+        assert bool((np.asarray(i) == np.asarray(i0)).all())
+        svc.close()
+
+    def test_bit_identity_donating_default(self, flat_index, rng):
+        svc = make_ann(flat_index)
+        assert svc.donate    # default on without a retry policy
+        q = jnp.asarray(rng.standard_normal((6, 24)), jnp.float32)
+        d, i = _step(svc, svc.submit(q))
+        d0, i0 = ann.ivf_flat_search(flat_index, q, 10)
+        # the donating twin runs the same HLO; donation only recycles
+        # the input buffer (docs/ZERO_COPY.md)
+        assert bool((np.asarray(d) == np.asarray(d0)).all())
+        assert bool((np.asarray(i) == np.asarray(i0)).all())
+        # the caller's array survives (the worker pads/copies)
+        assert q.shape == (6, 24)
+        np.asarray(q)
+        svc.close()
+
+    def test_pq_and_sq_served(self, rng):
+        X = jnp.asarray(rng.standard_normal((1500, 16)), jnp.float32)
+        for build, params in (
+                (ann.ivf_pq_build, ann.IVFPQParams(nlist=8, nprobe=8,
+                                                   M=4)),
+                (ann.ivf_sq_build, ann.IVFSQParams(nlist=8, nprobe=8))):
+            idx = build(X, params, seed=SEED)
+            svc = make_ann(idx, nprobe_ladder=(8,))
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            d, i = _step(svc, svc.submit(q))
+            d0, i0 = ann.approx_knn_search(idx, q, 10)
+            assert bool((np.asarray(i) == np.asarray(i0)).all())
+            assert np.allclose(np.asarray(d), np.asarray(d0))
+            # PQ/SQ stores hold codes: compaction is flat-only
+            assert svc.stats()["compact_rows"] == 0
+            with pytest.raises(LogicError):
+                svc.compact()
+            svc.close()
+
+
+class TestWarmupCompileCache:
+    def test_rungs_times_nprobe_zero_steady_state_compiles(self, rng):
+        # uniquely-shaped index: compiled executables persist across
+        # reset_compile_cache_stats, so the miss-count proof needs
+        # cache keys no earlier test in this process can have compiled
+        X = jnp.asarray(rng.standard_normal((2161, 24)), jnp.float32)
+        index = ann.ivf_flat_build(
+            X, ann.IVFFlatParams(nlist=16, nprobe=8), seed=SEED)
+        svc = make_ann(index, delta_cap=48)
+        reset_compile_cache_stats()
+        assert svc.warmed_rungs == ()
+        svc.warmup()
+        assert svc.warmed_rungs == (8, 32)
+        m_warm = _total_misses()
+        # at least one compile per (rung x cell x {plain, delta} arm)
+        assert m_warm >= len(svc.policy.rungs) * len(svc.nprobe_ladder)
+        # steady state: every admissible shape x every ladder cell x
+        # both delta arms lands on a warmed executable
+        for cell in svc.nprobe_ladder:
+            svc.set_nprobe(cell)
+            for r in (1, 7, 8, 31):
+                q = jnp.asarray(rng.standard_normal((r, 24)),
+                                jnp.float32)
+                _step(svc, svc.submit(q))
+        svc.insert([41000], rng.standard_normal((1, 24)))
+        for cell in svc.nprobe_ladder:
+            svc.set_nprobe(cell)
+            _step(svc, svc.submit(
+                jnp.asarray(rng.standard_normal((5, 24)), jnp.float32)))
+        assert _total_misses() == m_warm
+        svc.close()
+
+
+class TestStreamingIngestion:
+    def test_insert_then_query_sees_vector(self, flat_index, rng):
+        svc = make_ann(flat_index)
+        probe = jnp.asarray(rng.standard_normal((1, 24)), jnp.float32)
+        d0, i0 = _step(svc, svc.submit(probe))
+        assert 77777 not in set(np.asarray(i0).ravel())
+        svc.insert([77777], probe)
+        assert svc.delta_rows == 1
+        d1, i1 = _step(svc, svc.submit(probe))
+        # the inserted vector IS the query: exact match at distance ~0,
+        # visible before any compaction (the visibility point is the
+        # next formed batch)
+        assert int(np.asarray(i1)[0, 0]) == 77777
+        assert float(np.asarray(d1)[0, 0]) <= 1e-5
+        svc.close()
+
+    def test_insert_validation_and_overflow_shed(self, flat_index, rng):
+        svc = make_ann(flat_index, delta_cap=8)
+        with pytest.raises(LogicError):
+            svc.insert([-1], rng.standard_normal((1, 24)))
+        with pytest.raises(LogicError):
+            svc.insert([1, 2], rng.standard_normal((1, 24)))
+        with pytest.raises(LogicError):   # single block beyond capacity
+            svc.insert(np.arange(9), rng.standard_normal((9, 24)))
+        svc.insert(np.arange(6), rng.standard_normal((6, 24)))
+        with pytest.raises(ServiceOverloadError):
+            svc.insert([6, 7, 8], rng.standard_normal((3, 24)))
+        # shed, not corrupted: the first six rows are still there
+        assert svc.delta_rows == 6
+        svc.close()
+
+    def test_results_unchanged_across_compaction_swap(self, flat_index,
+                                                      rng):
+        # full probe: the brute-force cross-check below needs the scan
+        # to be exact (nprobe < nlist legitimately misses neighbors)
+        svc = make_ann(flat_index, nprobe=16, nprobe_ladder=(16,))
+        new_v = jnp.asarray(rng.standard_normal((12, 24)), jnp.float32)
+        svc.insert(np.arange(50000, 50012), new_v)
+        q = jnp.asarray(rng.standard_normal((7, 24)), jnp.float32)
+        d_pre, i_pre = _step(svc, svc.submit(q))
+        assert svc.compact()
+        assert svc.delta_rows == 0
+        assert svc.index is not flat_index      # atomic swap happened
+        d_post, i_post = _step(svc, svc.submit(q))
+        # the exact result set survives the swap: same neighbor ids in
+        # the same order; distances agree to float tolerance (the same
+        # row is now computed by the slot scan instead of the delta
+        # merge)
+        assert bool((np.asarray(i_pre) == np.asarray(i_post)).all())
+        assert np.allclose(np.asarray(d_pre), np.asarray(d_post),
+                           atol=1e-4)
+        # and the compacted index agrees with brute force over the
+        # reconstructed store (sets per row: near-equal distances at
+        # the rank boundary may order differently across formulations)
+        vecs, ids = svc.ground_truth_store()
+        bd, bi = brute_force_knn(jnp.asarray(vecs), q, 10)
+        want = ids[np.asarray(bi)]
+        got = np.asarray(i_post)
+        for r in range(got.shape[0]):
+            assert set(got[r]) == set(want[r]), (r, got[r], want[r])
+        svc.close()
+
+    def test_compact_noop_on_empty_delta(self, flat_index):
+        svc = make_ann(flat_index)
+        assert svc.compact() is False
+        svc.close()
+
+
+class TestCompactionUnderTraffic:
+    def test_auto_compaction_with_concurrent_submitters(self, rng):
+        X = jnp.asarray(rng.standard_normal((3000, 24)), jnp.float32)
+        index = ann.ivf_flat_build(
+            X, ann.IVFFlatParams(nlist=16, nprobe=16), seed=SEED)
+        svc = ANNService(index, k=10, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=0.5,
+                         nprobe_ladder=(16,), nprobe=16,
+                         delta_cap=256, compact_rows=24,
+                         maintenance_interval_s=0.005, start=True)
+        stop = threading.Event()
+        errors = []
+        results = []
+        q_fixed = jnp.asarray(rng.standard_normal((3, 24)), jnp.float32)
+
+        def submitter(tid):
+            g = np.random.default_rng(SEED + tid)
+            while not stop.is_set():
+                try:
+                    fut = svc.submit(jnp.asarray(
+                        g.standard_normal((2, 24)), jnp.float32))
+                    fut.result(timeout=10.0)
+                    fut2 = svc.submit(q_fixed)
+                    results.append(fut2.result(timeout=10.0))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=submitter, args=(t,),
+                                    daemon=True) for t in range(4)]
+        for t in threads:
+            t.start()
+        inserted = 0
+        for round_ in range(8):
+            svc.insert(np.arange(60000 + inserted,
+                                 60000 + inserted + 16),
+                       rng.standard_normal((16, 24)))
+            inserted += 16
+            time.sleep(0.05)
+        # wait for the worker-loop maintenance to compact below the
+        # threshold (it may legitimately keep a small tail)
+        t0 = time.monotonic()
+        while svc.delta_rows >= 24 and time.monotonic() - t0 < 10:
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors, errors[:3]
+        fam = default_registry().get(
+            "raft_tpu_serve_ann_compactions_total")
+        compactions = 0.0
+        if fam is not None:
+            for labels, series in fam.series():
+                if labels.get("service") == svc.name:
+                    compactions = series.value
+        assert compactions >= 1, "auto-compaction never ran under load"
+        assert svc.delta_rows < 24
+        # every mid-flight answer for the fixed query matches one of
+        # the legal snapshots; the FINAL state must contain all
+        # inserted rows exactly once — verify against brute force
+        d_fin, i_fin = _step_live(svc, q_fixed)
+        vecs, ids = svc.ground_truth_store()
+        assert len(np.unique(ids)) == len(ids)
+        bd, bi = brute_force_knn(jnp.asarray(vecs), q_fixed, 10)
+        assert bool((ids[np.asarray(bi)] == np.asarray(i_fin)).all())
+        assert results, "no fixed-query results collected"
+        svc.close()
+        assert not svc.worker.is_alive()
+
+
+def _step_live(svc, q):
+    """Submit against a live (threaded) worker and wait."""
+    return svc.submit(q).result(timeout=10.0)
+
+
+class TestDrainAndSession:
+    def test_drain_closes_compaction_cleanly(self, flat_index, rng):
+        svc = ANNService(flat_index, k=10, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=0.5,
+                         nprobe_ladder=(8,), delta_cap=64,
+                         compact_rows=4,
+                         maintenance_interval_s=0.005, start=True)
+        svc.insert(np.arange(70000, 70010),
+                   rng.standard_normal((10, 24)))
+        svc.close()          # drain -> join: no compaction mid-flight
+        assert not svc.worker.is_alive()
+        # whether the tick fired before the drain or not, no row was
+        # lost: index content + delta = base + inserted
+        vecs, ids = svc.ground_truth_store()
+        assert vecs.shape[0] == 2000 + 10
+        assert set(range(70000, 70010)) <= set(ids.tolist())
+        # a second close is a no-op
+        svc.close()
+
+    def test_overload_shed_on_submit(self, flat_index, rng):
+        svc = make_ann(flat_index, queue_cap=2)
+        q = jnp.asarray(rng.standard_normal((1, 24)), jnp.float32)
+        svc.submit(q)
+        svc.submit(q)
+        with pytest.raises(ServiceOverloadError):
+            svc.submit(q)
+        svc.close(drain=False)
+
+    def test_session_serve_ann_registers_and_drains(self, flat_index,
+                                                    rng):
+        from raft_tpu.session import Comms
+
+        with Comms() as sess:
+            svc = sess.serve(kind="ann", index=flat_index, k=10,
+                             max_batch_rows=32, bucket_rungs=(8, 32),
+                             nprobe_ladder=(8,), delta_cap=32,
+                             compact_rows=0)
+            assert svc.name in sess.services
+            hc = sess.health_check()
+            assert svc.name in hc["services"]
+            assert hc["services"][svc.name]["kind"] == "IVFFlatIndex"
+            q = jnp.asarray(rng.standard_normal((2, 24)), jnp.float32)
+            d, i = _step_live(svc, q)
+            assert d.shape == (2, 10)
+        assert not svc.is_open()
+        assert not svc.worker.is_alive()
+
+
+class TestCalibration:
+    def test_calibrate_picks_cheapest_cell_meeting_target(self, rng):
+        # well-clustered data: tiny nprobe already reaches the target,
+        # so calibration must stop at the FIRST (cheapest) cell
+        centers = rng.standard_normal((16, 24)).astype(np.float32) * 8
+        assign = rng.integers(0, 16, 4000)
+        X = jnp.asarray(centers[assign]
+                        + 0.1 * rng.standard_normal((4000, 24)),
+                        jnp.float32)
+        index = ann.ivf_flat_build(
+            X, ann.IVFFlatParams(nlist=16, nprobe=8), seed=SEED)
+        svc = make_ann(index, nprobe_ladder=(1, 2, 4, 16))
+        q = jnp.asarray(np.asarray(X)[:32]
+                        + 0.05 * rng.standard_normal((32, 24)),
+                        jnp.float32)
+        rep = svc.calibrate(q, target_recall=0.9)
+        assert rep["met_target"]
+        assert rep["chosen_nprobe"] == rep["table"][-1]["nprobe"]
+        assert svc.nprobe == rep["chosen_nprobe"]
+        # full-probe cell is exact: recall 1.0 by construction
+        rep_all = svc.calibrate(q, target_recall=2.0 - 1.0,
+                                measure_all=True, set_default=False)
+        assert rep_all["table"][-1]["nprobe"] == 16
+        # full probe is an exact scan; allow rank-boundary tie flips
+        # between the slot-scan and brute-force formulations
+        assert rep_all["table"][-1]["recall_at_k"] >= 0.99
+        svc.close()
+
+    def test_set_nprobe_clamps_and_retargets(self, flat_index):
+        svc = make_ann(flat_index)
+        assert svc.set_nprobe(999) == 16   # clamped to nlist
+        with pytest.raises(LogicError):
+            svc.set_nprobe(0)
+        svc.close()
+
+
+class TestLoadgenRecall:
+    def test_run_load_reports_recall_one_for_exact_service(self, rng):
+        from raft_tpu.serve import KNNService
+        from tools.loadgen import make_query_pool, run_load
+
+        ref = jnp.asarray(rng.standard_normal((500, 16)), jnp.float32)
+        svc = KNNService(ref, k=5, max_batch_rows=16, max_wait_ms=0.5)
+        svc.loadgen_ref = ref
+        pool = make_query_pool(ref, 2, n=4, seed=SEED)
+        rep = run_load(svc, mode="closed", duration=0.5, concurrency=2,
+                       recall=True, query_pool=pool)
+        svc.close()
+        assert rep["requests_ok"] > 0
+        assert rep["recall_k"] == 5
+        # exact service: recall@k is 1.0 by definition
+        assert rep["recall_at_k"] == 1.0
+
+    def test_run_load_recall_for_ann_service(self, flat_index):
+        from tools.loadgen import make_query_pool, run_load
+
+        svc = make_ann(flat_index, nprobe_ladder=(16,), nprobe=16,
+                       start=True)
+        ref, _ = svc.ground_truth_store()
+        pool = make_query_pool(ref, 2, n=4, seed=SEED)
+        rep = run_load(svc, mode="closed", duration=0.5, concurrency=2,
+                       recall=True, query_pool=pool)
+        svc.close()
+        assert rep["requests_ok"] > 0
+        # full probe (nprobe == nlist) is exact for IVF-Flat (modulo
+        # rank-boundary tie flips vs the brute-force formulation)
+        assert rep["recall_at_k"] >= 0.99

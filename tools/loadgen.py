@@ -22,9 +22,20 @@ Usage:
     python tools/loadgen.py --mode open --qps 500 --duration 5 \\
         --rows 4 --index-rows 50000 --dim 64 --k 10
     python tools/loadgen.py --service pairwise --mode closed ...
+    python tools/loadgen.py --service ann --clusters 64 --nlist 64 \\
+        --recall-target 0.9 --k 100 ...
+
+``--service ann`` fronts an IVF-Flat index
+(:class:`raft_tpu.serve.ANNService`) and ALWAYS reports **recall@k**
+against a brute-force ground truth computed once per run — an
+approximate index's QPS number is meaningless without its quality
+number (``--recall`` adds the same scoring to the exact services,
+where it doubles as an end-to-end correctness check: recall 1.0).
+``--recall-target`` calibrates ``nprobe`` to the target before the
+measured run (recall-targeted dispatch, docs/SERVING.md).
 
 Importable: :func:`run_load` returns the report dict (bench.py's
-``serve`` rung and tests reuse it).
+``serve`` rungs and tests reuse it).
 """
 
 from __future__ import annotations
@@ -98,29 +109,120 @@ def _compile_misses():
                for s in fn.values())
 
 
-def build_service(kind, index_rows, dim, k, seed=0, **opts):
-    """A ready (not yet warmed) service over a synthetic index."""
+def synth_data(index_rows, dim, seed=0, clusters=0, cluster_std=0.3):
+    """Synthetic reference matrix: i.i.d. gaussian rows, or (clusters >
+    0) a gaussian mixture — the shape real embedding workloads have and
+    the one where an IVF index earns its keep; recall is still measured
+    honestly against brute force over the same data either way."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    if clusters <= 0:
+        return rng.standard_normal((index_rows, dim)).astype(np.float32)
+    centers = rng.standard_normal((clusters, dim)).astype(np.float32)
+    assign = rng.integers(0, clusters, index_rows)
+    return (centers[assign] + cluster_std * rng.standard_normal(
+        (index_rows, dim))).astype(np.float32)
+
+
+def make_query_pool(ref, rows, n=32, seed=1, noise=0.1):
+    """Query blocks drawn NEAR the data (perturbed reference rows):
+    queries from the served distribution, not from empty space —
+    matters for any recall measurement on clustered data."""
     import jax.numpy as jnp
     import numpy as np
 
-    from raft_tpu.serve import KNNService, PairwiseService
-
     rng = np.random.default_rng(seed)
-    ref = jnp.asarray(rng.standard_normal((index_rows, dim)), jnp.float32)
+    picks = rng.integers(0, ref.shape[0], (n, rows))
+    base = np.asarray(ref)
+    return [jnp.asarray(base[p] + noise * rng.standard_normal(
+        (rows, base.shape[1])), jnp.float32) for p in picks]
+
+
+def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
+                  nlist=None, nprobe=None, train_rows=None, **opts):
+    """A ready (not yet warmed) service over a synthetic index.
+
+    ``kind="ann"`` builds an IVF-Flat index over the data first
+    (``nlist`` defaults to ~sqrt(rows); ``train_rows`` opts into
+    subsampled k-means training) and fronts it with
+    :class:`~raft_tpu.serve.ANNService`.  The generated reference
+    matrix is attached as ``service.loadgen_ref`` so recall ground
+    truth and query pools can reuse it without regeneration.
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.serve import ANNService, KNNService, PairwiseService
+
+    ref = jnp.asarray(synth_data(index_rows, dim, seed=seed,
+                                 clusters=clusters))
     if kind == "knn":
-        return KNNService(ref, k=k, **opts)
-    if kind == "pairwise":
-        return PairwiseService(ref, **opts)
-    raise SystemExit("unknown --service %r" % kind)
+        svc = KNNService(ref, k=k, **opts)
+    elif kind == "pairwise":
+        svc = PairwiseService(ref, **opts)
+    elif kind == "ann":
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+        if nlist is None:
+            nlist = max(16, min(4096, int(round(index_rows ** 0.5))))
+        params = IVFFlatParams(nlist=int(nlist),
+                               nprobe=int(nprobe) if nprobe else 8)
+        index = ivf_flat_build(ref, params, train_rows=train_rows)
+        svc = ANNService(index, k=k, **opts)
+    else:
+        raise SystemExit("unknown --service %r" % kind)
+    svc.loadgen_ref = ref
+    return svc
+
+
+def _ground_truth_for_pool(service, pool, k):
+    """Exact per-pool-block neighbor ids, computed ONCE per run (the
+    brute-force half of every recall@k number this tool reports).
+
+    Ground truth comes from the service's own content: ``loadgen_ref``
+    when :func:`build_service` attached it, else the pinned index
+    matrix (KNNService) or the reconstructable store + live delta
+    (ANNService.ground_truth_store).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.spatial.knn import brute_force_knn
+
+    ref = getattr(service, "loadgen_ref", None)
+    if ref is not None:
+        vecs, ids = np.asarray(ref), None
+    elif hasattr(service, "ground_truth_store"):
+        vecs, ids = service.ground_truth_store()
+    elif hasattr(service, "index"):
+        vecs, ids = np.asarray(service.index), None
+    else:
+        raise SystemExit(
+            "recall requested but %s exposes no reference data"
+            % service.name)
+    cat = jnp.concatenate(list(pool), axis=0)
+    _, rows_idx = brute_force_knn(jnp.asarray(vecs), cat, k)
+    rows_idx = np.asarray(rows_idx)
+    gt = rows_idx if ids is None else np.asarray(ids)[rows_idx]
+    n = pool[0].shape[0]
+    return [gt[j * n:(j + 1) * n] for j in range(len(pool))]
 
 
 def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
-             qps=100.0, rows=4, seed=0, deadline=None):
+             qps=100.0, rows=4, seed=0, deadline=None, recall=False,
+             query_pool=None):
     """Drive ``service`` for ``duration`` seconds; returns the report.
 
     Latencies are client-observed submit→result seconds.  Rejected
     submits (admission control) and expired deadlines are counted, not
     raised — overload behavior is the *measurement*, not a failure.
+
+    ``recall=True`` computes a brute-force ground truth for the query
+    pool once up front and scores every completed request's returned
+    ids against it — the report then carries ``recall_at_k`` next to
+    p50/p95/p99, so a speed claim cannot shed quality silently.
+    ``query_pool`` overrides the default i.i.d. gaussian pool (see
+    :func:`make_query_pool` for data-aligned queries).
     """
     import jax.numpy as jnp
     import numpy as np
@@ -130,11 +232,27 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
     rng = np.random.default_rng(seed)
     # pre-generated query pool: the generator must not bottleneck on
     # fresh RNG draws mid-flight
-    pool = [jnp.asarray(rng.standard_normal((rows, service.dim)),
-                        jnp.float32) for _ in range(32)]
+    if query_pool is not None:
+        pool = list(query_pool)
+        row_counts = {int(p.shape[0]) for p in pool}
+        if len(row_counts) != 1:
+            raise SystemExit("query_pool blocks must share a row count")
+        rows = row_counts.pop()
+    else:
+        pool = [jnp.asarray(rng.standard_normal((rows, service.dim)),
+                            jnp.float32) for _ in range(32)]
+    gt = None
+    recall_k = getattr(service, "k", None)
+    if recall:
+        if recall_k is None:
+            raise SystemExit(
+                "recall requested but %s has no k (not a kNN-shaped "
+                "service)" % service.name)
+        gt = _ground_truth_for_pool(service, pool, recall_k)
     lock = threading.Lock()
     latencies = []
     counts = {"ok": 0, "rejected": 0, "errors": 0}
+    recall_acc = {"sum": 0.0, "n": 0}
     stop_t = time.monotonic() + duration
 
     def one_request(i):
@@ -142,7 +260,7 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         t0 = time.monotonic()
         try:
             fut = service.submit(q, timeout=deadline)
-            fut.result(timeout=max(30.0, duration))
+            out = fut.result(timeout=max(30.0, duration))
         except ServiceOverloadError:
             with lock:
                 counts["rejected"] += 1
@@ -152,9 +270,19 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
                 counts["errors"] += 1
             return
         dt = time.monotonic() - t0
+        r = None
+        if gt is not None:
+            got = np.asarray(out[1])
+            want = gt[i % len(pool)]
+            r = float(np.mean([
+                len(set(got[j]) & set(want[j])) / recall_k
+                for j in range(got.shape[0])]))
         with lock:
             counts["ok"] += 1
             latencies.append(dt)
+            if r is not None:
+                recall_acc["sum"] += r
+                recall_acc["n"] += 1
 
     spawned = []  # open-loop per-request threads (joined after the pacer)
     if mode == "closed":
@@ -205,6 +333,11 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         "rejected": counts["rejected"],
         "errors": counts["errors"],
         "qps": round(counts["ok"] / wall, 2) if wall else 0.0,
+        # request-level vs row-level throughput: requests carry `rows`
+        # query rows each, and the raw-primitive rungs (knn_1m) count
+        # rows — cross-rung speedup ratios must compare query_qps
+        "query_qps": round(counts["ok"] * rows / wall, 2) if wall
+        else 0.0,
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
         "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
         "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
@@ -212,14 +345,34 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         # steady state reports 0 (docs/ZERO_COPY.md acceptance)
         "post_warmup_compiles": _compile_misses() - misses0,
     }
+    if gt is not None:
+        report["recall_at_k"] = (
+            round(recall_acc["sum"] / recall_acc["n"], 4)
+            if recall_acc["n"] else 0.0)
+        report["recall_k"] = int(recall_k)
     report.update(_registry_serve_stats(service.name))
     return report
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--service", choices=("knn", "pairwise"),
+    ap.add_argument("--service", choices=("knn", "pairwise", "ann"),
                     default="knn")
+    ap.add_argument("--clusters", type=int, default=0,
+                    help="gaussian-mixture data with this many clusters "
+                         "(0 = i.i.d. gaussian)")
+    ap.add_argument("--nlist", type=int, default=None,
+                    help="ann: IVF list count (default ~sqrt(rows))")
+    ap.add_argument("--nprobe", type=int, default=None,
+                    help="ann: served probe count (default: knob/index)")
+    ap.add_argument("--train-rows", type=int, default=None,
+                    help="ann: subsampled k-means training rows")
+    ap.add_argument("--recall", action="store_true",
+                    help="score recall@k against brute-force ground "
+                         "truth (automatic for --service ann)")
+    ap.add_argument("--recall-target", type=float, default=None,
+                    help="ann: calibrate nprobe to this recall@k "
+                         "before the load run")
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--qps", type=float, default=100.0,
                     help="open-loop arrival rate")
@@ -246,27 +399,51 @@ def main(argv=None) -> int:
         opts["max_wait_ms"] = args.max_wait_ms
     if args.queue_cap is not None:
         opts["queue_cap"] = args.queue_cap
+    if args.service == "ann":
+        opts.update(nlist=args.nlist, nprobe=args.nprobe,
+                    train_rows=args.train_rows)
     service = build_service(args.service, args.index_rows, args.dim,
-                            args.k, seed=args.seed, **opts)
+                            args.k, seed=args.seed,
+                            clusters=args.clusters, **opts)
     t0 = time.monotonic()
     service.warmup()
     warmup_s = time.monotonic() - t0
+    want_recall = args.recall or args.service == "ann"
+    pool = None
+    if want_recall:
+        # queries drawn near the data: recall on clustered data is
+        # meaningless for queries sampled from empty space
+        pool = make_query_pool(service.loadgen_ref, args.rows,
+                               seed=args.seed + 1)
+    calibration = None
+    if args.recall_target is not None and args.service == "ann":
+        import jax.numpy as jnp
+
+        cal_q = jnp.concatenate(pool[:8], axis=0)
+        calibration = service.calibrate(cal_q, args.recall_target)
     try:
         report = run_load(service, mode=args.mode,
                           duration=args.duration,
                           concurrency=args.concurrency, qps=args.qps,
                           rows=args.rows, seed=args.seed,
-                          deadline=args.deadline)
+                          deadline=args.deadline, recall=want_recall,
+                          query_pool=pool)
     finally:
         service.close()
     report["warmup_s"] = round(warmup_s, 3)
     report["buckets"] = list(service.policy.rungs)
+    if args.service == "ann":
+        report["nprobe"] = service.nprobe
+        report["delta_rows"] = service.delta_rows
+    if calibration is not None:
+        report["calibration"] = calibration
 
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
     print("== loadgen: %s %s ==" % (args.service, args.mode))
     for key in ("duration_s", "requests_ok", "rejected", "errors", "qps",
+                "recall_at_k", "recall_k", "nprobe", "delta_rows",
                 "p50_ms", "p95_ms", "p99_ms", "queue_wait_p50_ms",
                 "queue_wait_p95_ms", "batches", "mean_batch_rows",
                 "padding_waste", "post_warmup_compiles",

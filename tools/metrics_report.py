@@ -158,6 +158,52 @@ def _serve_summary(metrics: dict) -> list:
                             _fmt_s(w["p95"]) if w else "-",
                             _fmt_s(e["p50"]) if e else "-",
                             _fmt_s(e["p95"]) if e else "-"))
+    lines.extend(_serve_ann_summary(metrics))
+    return lines
+
+
+def _serve_ann_summary(metrics: dict) -> list:
+    """ANN-service digest (``raft_tpu_serve_ann_*``): streaming-
+    ingestion state (delta rows, inserts, compactions) and the
+    per-nprobe dispatch mix, so an operator can see at a glance which
+    recall cell traffic is actually served at."""
+    delta = metrics.get("raft_tpu_serve_ann_delta_rows", {})
+    services = {}
+    for s in delta.get("series", []):
+        svc = s["labels"].get("service")
+        if svc is not None:
+            services[svc] = {"delta_rows": s["value"]}
+    if not services:
+        return []
+
+    def add(name, key):
+        for s in metrics.get(name, {}).get("series", []):
+            svc = s["labels"].get("service")
+            if svc in services:
+                services[svc][key] = s["value"]
+
+    add("raft_tpu_serve_ann_inserts_total", "inserts")
+    add("raft_tpu_serve_ann_compactions_total", "compactions")
+    add("raft_tpu_serve_ann_compacted_rows_total", "compacted_rows")
+    calls = {}
+    for s in metrics.get("raft_tpu_serve_ann_calls_total",
+                         {}).get("series", []):
+        svc = s["labels"].get("service")
+        if svc in services:
+            calls.setdefault(svc, []).append(
+                (s["labels"].get("nprobe"), int(s["value"])))
+    lines = []
+    for svc in sorted(services):
+        st = services[svc]
+        lines.append(
+            "  %-24s ANN: delta_rows=%-6d inserts=%-7d "
+            "compactions=%d (rows=%d)"
+            % (svc, st.get("delta_rows", 0), st.get("inserts", 0),
+               st.get("compactions", 0), st.get("compacted_rows", 0)))
+        mix = sorted(calls.get(svc, []), key=lambda t: str(t[0]))
+        if mix:
+            lines.append("  %-24s   batches by nprobe: %s" % (
+                "", "  ".join("nprobe=%s:%d" % t for t in mix)))
     return lines
 
 
