@@ -34,6 +34,14 @@ Checks, per Python source file:
   ``comms-host-ok`` marker comment is exempt (device *handles* like
   mesh construction, and the deliberately-counted ``staging="host"``
   baseline).
+- no silent ``except Exception`` inside ``raft_tpu/serve/``: a serving
+  failure must go SOMEWHERE a rider or an operator can see it — the
+  handler must relay to rider futures (``_set_exception``), feed the
+  metrics registry (``.inc`` / ``.observe`` / ``.record_failure``), or
+  re-``raise``; an audited silent path carries a ``serve-exc-ok``
+  marker comment on the ``except`` line (docs/FAULT_MODEL.md "Serving
+  failure model" — the self-healing story dies the day a failure is
+  swallowed invisibly).
 
 Exit code 0 when clean; prints one line per violation otherwise.
 """
@@ -75,6 +83,26 @@ COMMS_NP_ALLOWLIST = (
 COMMS_NP_ATTRS = ("asarray", "array")
 COMMS_NP_MARKER = "comms-host-ok"
 
+# serve except-Exception audit (raft_tpu/serve/ only): a broad handler
+# must relay, count, or re-raise — see module doc
+SERVE_EXC_DIR = os.path.join("raft_tpu", "serve") + os.sep
+SERVE_EXC_MARKER = "serve-exc-ok"
+SERVE_EXC_RELAY_ATTRS = ("_set_exception", "inc", "observe",
+                         "record_failure", "_fail_batch")
+
+
+def _serve_handler_visible(handler):
+    """Whether an ``except Exception`` handler relays (futures), counts
+    (metrics), or re-raises — anything else is a silent swallow."""
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            return True
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in SERVE_EXC_RELAY_ATTRS):
+            return True
+    return False
+
 
 def check_file(path):
     problems = []
@@ -102,6 +130,7 @@ def check_file(path):
                        and rel not in THREAD_ALLOWLIST)
     in_comms_np_scope = (rel.startswith(COMMS_NP_DIR)
                          and rel not in COMMS_NP_ALLOWLIST)
+    in_serve_exc_scope = rel.startswith(SERVE_EXC_DIR)
     src_lines = src.splitlines()
     # aliases the time/threading modules are bound to ("import time",
     # "import time as t") — attribute-call matching must follow them or
@@ -114,6 +143,20 @@ def check_file(path):
                 and node.module.startswith("raft_tpu")
                 and any(a.name == "*" for a in node.names)):
             problems.append(f"{rel}:{node.lineno}: wildcard raft_tpu import")
+        if (in_serve_exc_scope and isinstance(node, ast.ExceptHandler)
+                and (node.type is None
+                     or (isinstance(node.type, ast.Name)
+                         and node.type.id in ("Exception",
+                                              "BaseException")))
+                and SERVE_EXC_MARKER
+                not in src_lines[node.lineno - 1]
+                and not _serve_handler_visible(node)):
+            problems.append(
+                f"{rel}:{node.lineno}: silent except Exception in "
+                "serve/ — relay to rider futures (_set_exception), "
+                "count it (.inc/.observe/record_failure), re-raise, "
+                f"or mark the audited line `{SERVE_EXC_MARKER}` "
+                "(docs/FAULT_MODEL.md)")
         if in_thread_scope:
             if isinstance(node, ast.Import):
                 for a in node.names:

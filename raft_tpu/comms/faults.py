@@ -35,6 +35,15 @@ Usage::
     with faults.inject(comms, faults.FailNth(1, verb="allreduce")) as log:
         out = comms.allreduce(x)      # first execution fails, retry wins
     assert log.injected[0].verb == "allreduce"
+
+The same fault objects also drive the **serving** execute seam
+(:func:`raft_tpu.serve.resilience.inject_worker` patches
+``ServeWorker._execute`` the way :class:`FaultInjector` patches
+``HostComms._execute``): ``FailNth`` / ``Delay`` / ``RandomFail`` are
+target-agnostic, so one seeded fault vocabulary covers both layers.
+``Abort`` is comms-only (it latches the communicator — the serving
+analog is the circuit breaker tripping on the failures the other
+faults inject).
 """
 
 from __future__ import annotations
@@ -214,30 +223,36 @@ class FaultInjector:
         self.calls: List[Tuple[str, tuple]] = []
         self.injected: List[Injection] = []
 
+    def _fire(self, target, verb: str, key: tuple) -> None:
+        """Record the call and apply the first matching fault (raising
+        to inject a failure).  ``target`` is whatever object the seam
+        wraps — the communicator here, the serve worker at the serving
+        seam (:mod:`raft_tpu.serve.resilience` reuses this loop)."""
+        self.calls.append((verb, key))
+        for i, fault in enumerate(self._faults):
+            if not fault.matches(verb, key):
+                continue
+            self._match_counts[i] += 1
+            n = self._match_counts[i]
+            try:
+                applied = fault.apply(target, verb, key, n)
+            except Exception:
+                self.injected.append(Injection(verb, n, fault))
+                tracing.counter_inc("comms.fault_injected")
+                raise
+            if applied:
+                # counter already incremented by the fault itself
+                # (pre-sleep); only the log entry lands here
+                self.injected.append(Injection(verb, n, fault))
+            break  # first matching fault owns this call
+
     def activate(self) -> None:
         assert self._orig_execute is None, "injector already active"
         self._orig_execute = self._comms._execute
         orig = self._orig_execute
 
         def patched(key, fn, *args, **kwargs):
-            verb = key[0]
-            self.calls.append((verb, key))
-            for i, fault in enumerate(self._faults):
-                if not fault.matches(verb, key):
-                    continue
-                self._match_counts[i] += 1
-                n = self._match_counts[i]
-                try:
-                    applied = fault.apply(self._comms, verb, key, n)
-                except Exception:
-                    self.injected.append(Injection(verb, n, fault))
-                    tracing.counter_inc("comms.fault_injected")
-                    raise
-                if applied:
-                    # counter already incremented by the fault itself
-                    # (pre-sleep); only the log entry lands here
-                    self.injected.append(Injection(verb, n, fault))
-                break  # first matching fault owns this call
+            self._fire(self._comms, key[0], key)
             return orig(key, fn, *args, **kwargs)
 
         self._comms._execute = patched

@@ -80,6 +80,27 @@ serve_ann_compact_rows
     Delta-row threshold at which the serve worker loop compacts (re-
     clusters the delta into IVF slots and atomically swaps the index);
     ``0`` disables automatic compaction.  Free-form int.
+serve_breaker_threshold
+    Consecutive batch failures that trip a service's circuit breaker
+    (:class:`raft_tpu.serve.resilience.CircuitBreaker`); ``0`` disables
+    consecutive tracking.  Free-form int; runtime-resolved at service
+    construction.
+serve_breaker_window / serve_breaker_window_failures
+    Windowed failure tracking: trip when the last ``window`` batch
+    outcomes contain at least ``window_failures`` failures (catches a
+    flapping service that never fails *consecutively* enough).
+    ``window_failures=0`` disables windowed tracking.  Free-form ints.
+serve_breaker_cooldown_ms
+    How long a tripped (open) breaker sheds before letting half-open
+    probe traffic through.  Free-form float milliseconds.
+serve_ann_degrade_frac
+    Queue-pressure threshold for :class:`raft_tpu.serve.ANNService`
+    degraded-mode dispatch: when queued requests reach this fraction of
+    ``serve_queue_cap`` (or the breaker is half-open after a trip), the
+    service steps down its calibrated ``nprobe`` ladder — lower recall,
+    lower latency — instead of shedding, and restores the calibrated
+    cell when pressure clears.  ``0`` disables the brownout.  Free-form
+    float in (0, 1].
 """
 
 from __future__ import annotations
@@ -116,6 +137,16 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "serve_ann_delta_cap": ("RAFT_TPU_SERVE_ANN_DELTA_CAP", "4096", None),
     "serve_ann_compact_rows": ("RAFT_TPU_SERVE_ANN_COMPACT_ROWS",
                                "2048", None),
+    "serve_breaker_threshold": ("RAFT_TPU_SERVE_BREAKER_THRESHOLD",
+                                "5", None),
+    "serve_breaker_window": ("RAFT_TPU_SERVE_BREAKER_WINDOW",
+                             "16", None),
+    "serve_breaker_window_failures": (
+        "RAFT_TPU_SERVE_BREAKER_WINDOW_FAILURES", "8", None),
+    "serve_breaker_cooldown_ms": ("RAFT_TPU_SERVE_BREAKER_COOLDOWN_MS",
+                                  "250", None),
+    "serve_ann_degrade_frac": ("RAFT_TPU_SERVE_ANN_DEGRADE_FRAC",
+                               "0.75", None),
 }
 
 # knobs resolved at *runtime* (service/object construction), never baked
@@ -124,7 +155,10 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
 _RUNTIME_KNOBS = frozenset(
     ("serve_bucket_rungs", "serve_max_wait_ms", "serve_queue_cap",
      "serve_ann_nprobe", "serve_ann_nprobe_ladder",
-     "serve_ann_delta_cap", "serve_ann_compact_rows"))
+     "serve_ann_delta_cap", "serve_ann_compact_rows",
+     "serve_breaker_threshold", "serve_breaker_window",
+     "serve_breaker_window_failures", "serve_breaker_cooldown_ms",
+     "serve_ann_degrade_frac"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

@@ -81,6 +81,39 @@ class ServiceOverloadError(RaftError):
             % (message, self.queue_depth, self.queue_cap))
 
 
+class ServiceUnavailableError(RaftError):
+    """The service cannot accept requests *at all* right now — its
+    circuit breaker is open (too many consecutive/windowed batch
+    failures), its worker thread has died, or a recovery is in progress
+    (:mod:`raft_tpu.serve.resilience`).  Distinct from
+    :class:`ServiceOverloadError`: overload means "healthy but full —
+    back off briefly"; unavailable means "broken or healing — shed now
+    and retry after ``retry_after_s``" (queueing into a broken worker
+    would only convert the outage into client timeouts).
+
+    Attributes
+    ----------
+    service:
+        Name of the service that shed the request.
+    reason:
+        Short machine-readable cause (``"breaker_open"``,
+        ``"worker_dead"``, ``"recovering"``).
+    retry_after_s:
+        Hint: seconds until the service may admit again (0.0 when
+        unknown — e.g. a dead worker awaiting an explicit
+        ``restart()``/recovery).
+    """
+
+    def __init__(self, message: str, service: str, reason: str,
+                 retry_after_s: float = 0.0):
+        self.service = str(service)
+        self.reason = str(reason)
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            "%s (service=%s reason=%s retry_after_s=%.3f)"
+            % (message, self.service, self.reason, self.retry_after_s))
+
+
 class CommError(RaftError):
     """Communicator failure (analog of the reference's NCCL/UCX error
     surfacing: ``RAFT_NCCL_TRY`` / the ERROR arm of ``status_t``,

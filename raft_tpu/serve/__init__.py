@@ -14,11 +14,16 @@ padded device call per shape bucket, so
   query-vector cache (:mod:`~raft_tpu.serve.service`),
 - the native IVF quantizers are served with recall-targeted nprobe
   dispatch and streaming ingestion + worker-loop compaction
-  (:mod:`~raft_tpu.serve.ann_service`).
+  (:mod:`~raft_tpu.serve.ann_service`),
+- the serving failure contract — serve-seam fault injection, per-
+  service circuit breaker, recovery orchestration, degraded-mode
+  dispatch — lives in :mod:`~raft_tpu.serve.resilience`
+  (docs/FAULT_MODEL.md "Serving failure model").
 
 Session integration: ``Comms.serve(...)`` constructs and registers a
-service; ``health_check()`` reports live services and ``destroy()``
-drains them before comms teardown.
+service; ``health_check()`` reports live services (breaker state and
+maintenance failures included), ``self_heal()`` recovers them, and
+``destroy()`` drains them before comms teardown.
 """
 
 from raft_tpu.serve.ann_service import ANNService  # noqa: F401
@@ -29,6 +34,13 @@ from raft_tpu.serve.bucketing import (  # noqa: F401
     pad_rows,
     resolve_rungs,
     split_rows,
+)
+from raft_tpu.serve.resilience import (  # noqa: F401
+    BreakerState,
+    CircuitBreaker,
+    RecoveryManager,
+    ServeFaultInjector,
+    inject_worker,
 )
 from raft_tpu.serve.scheduler import ServeWorker  # noqa: F401
 from raft_tpu.serve.service import (  # noqa: F401
@@ -41,4 +53,6 @@ __all__ = [
     "BucketPolicy", "resolve_rungs", "pad_rows", "coalesce", "split_rows",
     "MicroBatcher", "ServeFuture", "ServeWorker",
     "Service", "KNNService", "PairwiseService", "ANNService",
+    "BreakerState", "CircuitBreaker", "RecoveryManager",
+    "ServeFaultInjector", "inject_worker",
 ]

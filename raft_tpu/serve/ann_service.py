@@ -64,7 +64,9 @@ import numpy as np
 from raft_tpu import config
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import ServiceOverloadError, expects, fail
-from raft_tpu.serve.service import Service, _knob_int, _service_seq
+from raft_tpu.serve.resilience import BreakerState
+from raft_tpu.serve.service import (Service, _knob_float, _knob_int,
+                                    _service_seq)
 from raft_tpu.spatial import ann as _ann
 from raft_tpu.spatial.knn import brute_force_knn
 
@@ -138,6 +140,15 @@ class ANNService(Service):
         requires an IVF-Flat index — PQ/SQ services still ingest into
         the delta but must be rebuilt offline (auto-compaction is
         forced off and :meth:`compact` raises).
+    degrade_queue_frac:
+        Degraded-mode dispatch (quality brownout, docs/FAULT_MODEL.md):
+        when queued requests reach this fraction of the admission cap —
+        or the circuit breaker is half-open after a trip — batches are
+        served one step *down* the calibrated nprobe ladder (lower
+        recall, lower latency, already warmed) instead of shedding; the
+        calibrated cell is restored as soon as pressure clears.
+        Defaults to the ``serve_ann_degrade_frac`` knob; ``0`` disables.
+        Counted via the ``raft_tpu_serve_degraded_*`` family.
     **opts:
         The shared :class:`~raft_tpu.serve.service.Service` options
         (``max_batch_rows``, ``bucket_rungs``, ``max_wait_ms``,
@@ -150,6 +161,7 @@ class ANNService(Service):
                  refine_ratio: Optional[int] = None,
                  delta_cap: Optional[int] = None,
                  compact_rows: Optional[int] = None,
+                 degrade_queue_frac: Optional[float] = None,
                  slot_multiple: int = 64,
                  select_impl: Optional[str] = None,
                  name: Optional[str] = None, **opts):
@@ -198,6 +210,14 @@ class ANNService(Service):
         # delta, but never auto-compact (module doc)
         self._compact_rows = (min(int(compact_rows), self._delta_cap)
                               if self._compactable else 0)
+        if degrade_queue_frac is None:
+            degrade_queue_frac = _knob_float("serve_ann_degrade_frac")
+        expects(0.0 <= degrade_queue_frac <= 1.0,
+                "ANNService: degrade_queue_frac=%r", degrade_queue_frac)
+        self._degrade_frac = float(degrade_queue_frac)
+        # manual brownout lever (ladder steps); pressure/breaker checks
+        # raise the effective level per batch without touching this
+        self._degrade_hold = 0
 
         # resolved before Service.__init__ so the metric labels (and
         # the worker's maintenance tick) can use it from the first
@@ -218,12 +238,22 @@ class ANNService(Service):
 
         def execute(padded):
             st = self._ann_state        # ONE snapshot per batch
-            nprobe_now = self._nprobe
+            nprobe_now, degraded = self._effective_nprobe()
             delta = ((st.delta_vecs, st.delta_ids)
                      if st.delta_rows else None)
             _labeled("counter", "raft_tpu_serve_ann_calls_total",
                      "ANN batches dispatched per probe count",
                      self.name, nprobe=nprobe_now).inc()
+            if degraded:
+                _labeled("counter",
+                         "raft_tpu_serve_degraded_batches_total",
+                         "batches served below the calibrated quality "
+                         "cell (nprobe brownout)", self.name).inc()
+            _labeled("gauge", "raft_tpu_serve_degraded_active",
+                     "whether the LAST dispatched batch was served "
+                     "below the calibrated cell (per-batch signal; "
+                     "idle services keep the last value)",
+                     self.name).set(1 if degraded else 0)
             # donation routes the padded buffer into the last consuming
             # program's executable twin; self.donate is resolved by
             # Service.__init__ before any batch can run
@@ -276,6 +306,78 @@ class ANNService(Service):
         expects(int(nprobe) >= 1, "set_nprobe: nprobe=%d", int(nprobe))
         self._nprobe = min(int(nprobe), self._nlist)
         return self._nprobe
+
+    # ------------------------------------------------------------------ #
+    # degraded-mode dispatch (quality brownout, docs/FAULT_MODEL.md)
+    # ------------------------------------------------------------------ #
+    def _degrade_level(self) -> int:
+        """Ladder steps to walk down for the NEXT batch: the manual
+        hold (:meth:`degrade`), plus one step while the queue is
+        pressured past ``degrade_queue_frac`` of the admission cap or
+        the breaker is half-open (tripped-but-recovering: probe traffic
+        should be cheap traffic).  Evaluated per batch, so the
+        calibrated cell restores the moment pressure clears."""
+        level = self._degrade_hold
+        if (self._degrade_frac > 0.0
+                and self.batcher.depth()
+                >= self._degrade_frac * self.batcher.queue_cap):
+            level = max(level, 1)
+        br = getattr(self, "breaker", None)
+        if br is not None and br.state is BreakerState.HALF_OPEN:
+            level = max(level, 1)
+        return level
+
+    def _effective_nprobe(self):
+        """(nprobe, degraded) for the next batch: the served cell, or
+        ``level`` ladder steps below it.  Every ladder cell is warmed,
+        so a brownout never compiles."""
+        base = self._nprobe
+        level = self._degrade_level()
+        if level <= 0:
+            return base, False
+        ladder = self._nprobe_ladder
+        # index of the served cell (calibrate/set_nprobe pin ladder
+        # cells; a hand-set off-ladder value maps to the nearest cell
+        # at or below it)
+        i = 0
+        for j, cell in enumerate(ladder):
+            if cell <= base:
+                i = j
+        eff = ladder[max(0, i - level)]
+        return min(eff, base), eff < base
+
+    def degrade(self, levels: int = 1) -> None:
+        """Manually hold dispatch ``levels`` ladder steps below the
+        calibrated cell (operator lever; the pressure/breaker checks
+        engage on their own).  ``levels=0`` == :meth:`restore`."""
+        expects(levels >= 0, "degrade: levels=%d", levels)
+        self._degrade_hold = int(levels)
+
+    def restore(self) -> None:
+        """Release the manual brownout hold (pressure/breaker-driven
+        degradation still applies while its cause persists)."""
+        self._degrade_hold = 0
+        if self._degrade_level() == 0:
+            # clear the per-batch gauge now: an idle service would
+            # otherwise report the pre-restore brownout until the next
+            # batch happens to dispatch
+            _labeled("gauge", "raft_tpu_serve_degraded_active",
+                     "whether the LAST dispatched batch was served "
+                     "below the calibrated cell (per-batch signal; "
+                     "idle services keep the last value)",
+                     self.name).set(0)
+
+    # ------------------------------------------------------------------ #
+    def post_recover(self) -> None:
+        """Carry the serving snapshot across a mesh rebuild
+        (:class:`~raft_tpu.serve.resilience.RecoveryManager` step 4):
+        re-materialize the device-resident delta segment from the host
+        mirror and re-publish the immutable ``(index, delta)`` snapshot
+        — every row inserted before the failure is still queryable.
+        The index's own arrays are device-committed by the next search
+        the rebuilt executables run (``warmup()`` follows this hook)."""
+        with self._delta_lock:
+            self._publish_state_locked()
 
     # ------------------------------------------------------------------ #
     # warmup: every bucket rung x every nprobe cell, both delta arms
@@ -523,5 +625,7 @@ class ANNService(Service):
             "delta_rows": self.delta_rows,
             "delta_cap": self._delta_cap,
             "compact_rows": self._compact_rows,
+            "degrade_queue_frac": self._degrade_frac,
+            "degrade_hold": self._degrade_hold,
         })
         return out

@@ -31,7 +31,12 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
-from raft_tpu.core.error import LogicError, ServiceOverloadError, expects
+from raft_tpu.core.error import (
+    CommTimeoutError,
+    LogicError,
+    ServiceOverloadError,
+    expects,
+)
 
 __all__ = ["ServeFuture", "MicroBatcher"]
 
@@ -44,12 +49,13 @@ class ServeFuture:
     of threads may :meth:`result` / :meth:`wait` on it.
     """
 
-    __slots__ = ("_event", "_result", "_error")
+    __slots__ = ("_event", "_result", "_error", "_service")
 
-    def __init__(self):
+    def __init__(self, service: str = "serve"):
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
+        self._service = service
 
     # -- worker side --------------------------------------------------- #
     def _set_result(self, value: Any) -> None:
@@ -67,11 +73,21 @@ class ServeFuture:
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._event.wait(timeout)
 
+    def _unresolved(self, timeout: Optional[float]) -> CommTimeoutError:
+        # the deadline taxonomy everywhere else (queue expiry, watchdog,
+        # close) raises CommTimeoutError — a caller-side wait blowing
+        # its budget is the same failure class, not a bare TimeoutError
+        return CommTimeoutError(
+            "serve future for service %r unresolved after waiting %s"
+            % (self._service,
+               "%.3fs" % timeout if timeout is not None else "forever"))
+
     def result(self, timeout: Optional[float] = None) -> Any:
         """The request's result; raises the request's failure, or
-        :class:`TimeoutError` if it is not resolved within ``timeout``."""
+        :class:`~raft_tpu.core.error.CommTimeoutError` (naming the
+        service and the wait) if unresolved within ``timeout``."""
         if not self._event.wait(timeout):
-            raise TimeoutError("serve future not resolved in time")
+            raise self._unresolved(timeout)
         if self._error is not None:
             raise self._error
         return self._result
@@ -79,22 +95,27 @@ class ServeFuture:
     def exception(self, timeout: Optional[float] = None
                   ) -> Optional[BaseException]:
         if not self._event.wait(timeout):
-            raise TimeoutError("serve future not resolved in time")
+            raise self._unresolved(timeout)
         return self._error
 
 
 class _Request:
     """One queued query block (rows of one submitter's array)."""
 
-    __slots__ = ("payload", "rows", "enqueue_t", "deadline_t", "future")
+    __slots__ = ("payload", "rows", "enqueue_t", "deadline_t", "future",
+                 "requeued")
 
     def __init__(self, payload, rows: int, enqueue_t: float,
-                 deadline_t: Optional[float]):
+                 deadline_t: Optional[float], service: str = "serve"):
         self.payload = payload
         self.rows = rows
         self.enqueue_t = enqueue_t
         self.deadline_t = deadline_t
-        self.future = ServeFuture()
+        self.future = ServeFuture(service)
+        # the at-most-once recovery re-enqueue mark (scheduler._fail
+        # _batch): a rider whose batch died while the breaker tripped is
+        # put back exactly once; a second failure relays the error
+        self.requeued = False
 
 
 class MicroBatcher:
@@ -117,7 +138,8 @@ class MicroBatcher:
 
     def __init__(self, max_batch_rows: int, max_wait_s: float,
                  queue_cap: int,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "serve"):
         expects(max_batch_rows >= 1,
                 "MicroBatcher: max_batch_rows=%d", max_batch_rows)
         expects(max_wait_s >= 0.0,
@@ -126,10 +148,12 @@ class MicroBatcher:
         self.max_batch_rows = int(max_batch_rows)
         self.max_wait_s = float(max_wait_s)
         self.queue_cap = int(queue_cap)
+        self.name = str(name)
         self._clock = clock
         self._cond = threading.Condition()
         self._q: "collections.deque[_Request]" = collections.deque()
         self._rows_queued = 0
+        self._paused = False
         self._draining = False
         self._stopped = False
 
@@ -148,7 +172,8 @@ class MicroBatcher:
                 "submit: %d rows outside [1, max_batch_rows=%d] — a "
                 "request must fit one batch whole", rows,
                 self.max_batch_rows)
-        req = _Request(payload, rows, self._clock(), deadline_t)
+        req = _Request(payload, rows, self._clock(), deadline_t,
+                       self.name)
         with self._cond:
             if self._draining or self._stopped:
                 raise LogicError(
@@ -182,6 +207,47 @@ class MicroBatcher:
         with self._cond:
             return self._draining
 
+    def paused(self) -> bool:
+        """Whether batch formation is paused (recovery in progress)."""
+        with self._cond:
+            return self._paused
+
+    # ------------------------------------------------------------------ #
+    # recovery seams (raft_tpu/serve/resilience.py)
+    # ------------------------------------------------------------------ #
+    def pause(self) -> None:
+        """Stop forming batches (recovery in progress): queued requests
+        stay queued, the worker idles.  Unlike :meth:`begin_drain` this
+        is reversible (:meth:`resume`); the service façade sheds *new*
+        submits with ``ServiceUnavailableError`` while paused."""
+        with self._cond:
+            self._paused = True
+            self._cond.notify_all()
+
+    def resume(self) -> None:
+        """Re-admit after a pause: batch formation restarts and the
+        queued backlog (including recovery re-enqueues) dispatches."""
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def requeue(self, reqs: List[_Request]) -> bool:
+        """Put already-admitted requests back at the FRONT of the queue
+        (recovery re-enqueue: riders of a batch that died while the
+        breaker tripped are served after recovery instead of lost).
+        Bypasses the admission cap and the drain gate — these requests
+        were admitted once and must resolve exactly once.  Returns False
+        (caller must fail the futures instead) once the queue is
+        stopped: after :meth:`shutdown` nobody will ever serve them."""
+        with self._cond:
+            if self._stopped:
+                return False
+            for req in reversed(reqs):
+                self._q.appendleft(req)
+                self._rows_queued += req.rows
+            self._cond.notify_all()
+        return True
+
     # ------------------------------------------------------------------ #
     # worker side
     # ------------------------------------------------------------------ #
@@ -200,6 +266,8 @@ class MicroBatcher:
             return False
         if self._draining or self._stopped:
             return True
+        if self._paused:
+            return False
         if self._rows_queued >= self.max_batch_rows:
             return True
         return (now - self._q[0].enqueue_t) >= self.max_wait_s
@@ -235,13 +303,16 @@ class MicroBatcher:
                     poll = deadline - self._clock()
                     if poll <= 0:
                         return []
-                if self._q:
+                if self._q and not self._paused:
                     remaining = max(1e-3,
                                     self._q[0].enqueue_t + self.max_wait_s
                                     - self._clock())
                     self._cond.wait(timeout=remaining if poll is None
                                     else min(remaining, poll))
                 else:
+                    # empty — or paused for recovery: an overdue head
+                    # request must not turn this into a 1 kHz spin;
+                    # resume() notifies, so the wake-up is immediate
                     self._cond.wait(timeout=poll)
 
     # ------------------------------------------------------------------ #
@@ -249,9 +320,13 @@ class MicroBatcher:
     # ------------------------------------------------------------------ #
     def begin_drain(self) -> None:
         """Stop admitting; flush queued requests immediately (no point
-        holding the micro-batch window open — nobody new is coming)."""
+        holding the micro-batch window open — nobody new is coming).
+        Overrides a recovery pause: drain must serve (or fail) the
+        queue out, never hold it hostage to a recovery that will not
+        finish."""
         with self._cond:
             self._draining = True
+            self._paused = False
             self._cond.notify_all()
 
     def shutdown(self) -> List[_Request]:

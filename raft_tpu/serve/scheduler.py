@@ -130,9 +130,19 @@ class ServeWorker:
         thread to coordinate (``ci/style_check.py``'s thread hygiene
         argument).  It runs between dispatches, never mid-batch, so an
         index swap it performs can never tear a batch; exceptions are
-        counted (``raft_tpu_serve_maintenance_errors_total``) and
-        swallowed — a failing compactor must not kill the loop serving
-        everyone.
+        counted (``raft_tpu_serve_maintenance_errors_total``), captured
+        as :attr:`last_maintenance_error` (surfaced through
+        ``Service.stats()`` / session ``health_check()`` — a silently
+        failing compactor is visible) and swallowed — a failing
+        compactor must not kill the loop serving everyone.
+    breaker:
+        Optional :class:`~raft_tpu.serve.resilience.CircuitBreaker`.
+        The worker records every batch outcome into it; while it is
+        OPEN the loop holds batch formation (no point burning queued
+        riders against a broken device), and a batch failure that finds
+        it open re-enqueues its riders **once** (``_Request.requeued``)
+        instead of failing them — the in-flight-futures-survive-
+        recovery guarantee (docs/FAULT_MODEL.md).
     clock:
         Shared with the batcher for deadline math.
     """
@@ -144,6 +154,7 @@ class ServeWorker:
                  donate: bool = False,
                  maintenance: Optional[Callable[[], None]] = None,
                  maintenance_interval_s: float = 0.05,
+                 breaker=None,
                  clock: Callable[[], float] = time.monotonic):
         self.name = name
         self._batcher = batcher
@@ -152,6 +163,11 @@ class ServeWorker:
         self._retry_policy = retry_policy
         self._maintenance = maintenance
         self._maint_interval = float(maintenance_interval_s)
+        self.breaker = breaker
+        # last maintenance failure, surfaced via Service.stats():
+        # {"type", "message", "at"} — "at" is the worker clock's
+        # monotonic seconds (the only clock the library may read)
+        self.last_maintenance_error: Optional[dict] = None
         # the worker OWNS the donation-eligibility rule: donation is
         # off whenever a retry could replay the consumed buffer.
         # Public: Service passes intent and reads the resolved value
@@ -190,6 +206,49 @@ class ServeWorker:
         with self._state:
             return self._thread is not None
 
+    def dead(self) -> bool:
+        """True when the worker thread was started and has died — the
+        hot-path admission check (one lock acquisition per submit)."""
+        with self._state:
+            return (self._thread is not None
+                    and not self._thread.is_alive())
+
+    def restart(self) -> bool:
+        """Replace a dead worker thread — the health-repair lever
+        (session ``health_check`` names dead workers;
+        :class:`~raft_tpu.serve.resilience.RecoveryManager` pulls
+        this).  False while the current thread is alive or the worker
+        was never started (nothing to repair); raises once closed."""
+        with self._state:
+            expects(not self._closed, "ServeWorker %s is closed",
+                    self.name)
+            t = self._thread
+            if t is None or t.is_alive():
+                return False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="raft-tpu-serve-%s" % self.name)
+            self._thread.start()
+        _counter("raft_tpu_serve_worker_restarts_total",
+                 "dead worker threads replaced", self.name).inc()
+        return True
+
+    def quiesce(self, timeout: Optional[float] = None) -> bool:
+        """Wait until no batch is mid-dispatch (worker idle between
+        cycles, or dead).  Unlike :meth:`drain` this touches no
+        admission state: queued requests stay queued — the recovery
+        sequence pauses the batcher first, quiesces here, and serves
+        the backlog out after re-admission.  True when quiet."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._state:
+            while self._busy:
+                if not (self._thread and self._thread.is_alive()):
+                    return True  # a dead thread holds no batch
+                if deadline is not None and self._clock() >= deadline:
+                    return False
+                self._state.wait(timeout=0.05)
+            return True
+
     def _loop(self) -> None:
         """Pipelined worker loop: dispatch batch N+1 while batch N's
         device call runs (module doc).  ``pending`` is the one in-flight
@@ -209,6 +268,28 @@ class ServeWorker:
         poll = (self._maint_interval if self._maintenance is not None
                 else None)
         while True:
+            hold = self._dispatch_hold()
+            if hold > 0.0:
+                # breaker open: stop forming batches — dispatching the
+                # queued backlog against a broken device would only
+                # burn every rider's single re-enqueue.  Finish the
+                # in-flight batch (its results may already be sitting
+                # ready), then idle-poll until the cooldown admits
+                # half-open probes.  Drain overrides the hold (the
+                # gate checks draining): close must serve out or fail,
+                # never wait on a recovery that is not coming.
+                if pending is not None:
+                    try:
+                        self._finish(pending)
+                    finally:
+                        pending = None
+                        with self._state:
+                            self._busy = False
+                            self._state.notify_all()
+                with self._state:
+                    self._state.wait(timeout=min(hold, 0.05))
+                self.run_maintenance()
+                continue
             if pending is None:
                 batch = self._batcher.wait_for_batch(timeout=poll)
                 if batch is None:
@@ -260,9 +341,20 @@ class ServeWorker:
             # no-op when nothing is due.
             self.run_maintenance()
 
+    def _dispatch_hold(self) -> float:
+        """Seconds the breaker wants dispatch held (0.0 = go).  Drain
+        wins over the hold: a draining queue must be served out (or
+        failed onto futures) rather than held for a recovery."""
+        if self.breaker is None or self._batcher.draining():
+            return 0.0
+        return self.breaker.dispatch_hold()
+
     def run_once(self) -> bool:
         """Manual stepping for threadless/deterministic operation: form
-        and dispatch one batch if the policy allows; True if one ran."""
+        and dispatch one batch if the policy allows (and the breaker
+        does not hold); True if one ran."""
+        if self._dispatch_hold() > 0.0:
+            return False
         batch = self._batcher.take()
         if not batch:
             return False
@@ -285,9 +377,17 @@ class ServeWorker:
             self._busy = True
         try:
             fn()
-        except Exception:  # noqa: BLE001 — counted, never loop-fatal
+            self.last_maintenance_error = None
+        except Exception as e:  # noqa: BLE001 — counted, never loop-fatal
             _counter("raft_tpu_serve_maintenance_errors_total",
                      "maintenance callback failures", self.name).inc()
+            # a bare counter hides WHAT keeps failing: capture the last
+            # failure for Service.stats() / session health_check
+            self.last_maintenance_error = {
+                "type": type(e).__name__,
+                "message": str(e)[:500],
+                "at": self._clock(),
+            }
         finally:
             with self._state:
                 self._busy = was_busy
@@ -345,6 +445,38 @@ class ServeWorker:
     # ------------------------------------------------------------------ #
     # dispatch
     # ------------------------------------------------------------------ #
+    def _fail_batch(self, live: List[_Request],
+                    exc: BaseException) -> None:
+        """Relay one batch failure.  Classification first: the breaker
+        ignores caller bugs and decides whether this failure is
+        *service-level* (it is now, or already was, open).  Service-
+        level failures re-enqueue each rider ONCE — at the moment of a
+        trip the in-flight futures are put back to be served after
+        recovery, not lost — while a rider on its second strike (or any
+        non-service-level failure) gets the exception, PR 3's original
+        riders-resubmit contract.  Never raises."""
+        _counter("raft_tpu_serve_batch_errors_total",
+                 "batches whose device call failed", self.name).inc()
+        service_level = (self.breaker.record_failure(exc)
+                         if self.breaker is not None else False)
+        retry: List[_Request] = []
+        for req in live:
+            if service_level and not req.requeued:
+                req.requeued = True
+                retry.append(req)
+            else:
+                req.future._set_exception(exc)
+        if retry:
+            if self._batcher.requeue(retry):
+                _counter("raft_tpu_serve_requeued_total",
+                         "riders re-enqueued once across a breaker "
+                         "trip/recovery", self.name).inc(len(retry))
+            else:
+                # queue already shut down: nobody will ever serve the
+                # re-enqueue — the exception is the only resolution
+                for req in retry:
+                    req.future._set_exception(exc)
+
     def _expire_locked_out(self, batch: List[_Request],
                            now: float) -> List[_Request]:
         live: List[_Request] = []
@@ -364,9 +496,11 @@ class ServeWorker:
         return live
 
     def dispatch(self, batch: Sequence[_Request]) -> None:
-        """Run one formed batch to completion (never raises: every
-        failure lands on the riders' futures — a poisoned batch must
-        not kill the loop serving everyone else).  Synchronous
+        """Run one formed batch to completion (never raises for
+        Exception-class failures: they land on the riders' futures — a
+        poisoned batch must not kill the loop serving everyone else.
+        A worker-killing BaseException still propagates, but only
+        after every rider was resolved or re-enqueued).  Synchronous
         start+finish — the manual-stepping (``run_once``) and drain
         entry point; the worker loop pipelines the two halves."""
         inflight = self._start(batch)
@@ -428,16 +562,19 @@ class ServeWorker:
                 out = self._execute(padded)
             return _Inflight(live, spans, bucket, payload_rows, out,
                              t_launch)
-        except Exception as e:  # noqa: BLE001 — relayed to every rider
-            _counter("raft_tpu_serve_batch_errors_total",
-                     "batches whose device call failed", self.name).inc()
-            for req in live:
-                req.future._set_exception(e)
+        except BaseException as e:  # noqa: BLE001 — relayed/requeued per rider
+            self._fail_batch(live, e)
             if launched:
                 self._inflight_rows -= payload_rows
             _gauge("raft_tpu_serve_inflight_rows",
                    "payload rows in launched, not-yet-split device "
                    "calls", self.name).set(self._inflight_rows)
+            if not isinstance(e, Exception):
+                # worker-killing class (SystemExit & co.): the thread
+                # is about to die — but only AFTER every rider was
+                # resolved or re-enqueued above, so no future is lost
+                # and restart() can serve the requeued backlog
+                raise
             return None
 
     def _finish(self, inflight: "_Inflight") -> None:
@@ -477,11 +614,10 @@ class ServeWorker:
             for req, (start, stop) in zip(live, spans):
                 req.future._set_result(jax.tree_util.tree_map(
                     lambda leaf: leaf[start:stop], out))
-        except Exception as e:  # noqa: BLE001 — relayed to every rider
-            _counter("raft_tpu_serve_batch_errors_total",
-                     "batches whose device call failed", self.name).inc()
-            for req in live:
-                req.future._set_exception(e)
+        except BaseException as e:  # noqa: BLE001 — relayed/requeued per rider
+            self._fail_batch(live, e)
+            if not isinstance(e, Exception):
+                raise  # worker-killing: die with every rider resolved
             return
         finally:
             self._inflight_rows -= inflight.payload_rows
@@ -489,6 +625,8 @@ class ServeWorker:
                    "payload rows in launched, not-yet-split device "
                    "calls", self.name).set(self._inflight_rows)
         # accounting only after a successful dispatch
+        if self.breaker is not None:
+            self.breaker.record_success()
         _counter("raft_tpu_serve_batches_total", "dispatched batches",
                  self.name).inc()
         _counter("raft_tpu_serve_requests_total", "served requests",

@@ -55,12 +55,18 @@ import jax.numpy as jnp
 
 from raft_tpu import config
 from raft_tpu.cache import VecCache
-from raft_tpu.core.error import LogicError, ServiceOverloadError, expects
+from raft_tpu.core.error import (
+    LogicError,
+    ServiceOverloadError,
+    ServiceUnavailableError,
+    expects,
+)
 from raft_tpu.core.profiler import profiled_jit
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.serve.batcher import MicroBatcher, ServeFuture
 from raft_tpu.serve.bucketing import BucketPolicy, resolve_rungs
+from raft_tpu.serve.resilience import BreakerState, CircuitBreaker
 from raft_tpu.serve.scheduler import ServeWorker, _counter, _gauge
 from raft_tpu.spatial.knn import brute_force_knn
 
@@ -133,6 +139,14 @@ class Service:
         batch.  Default: on whenever no ``retry_policy`` is set (a
         retry would replay a consumed buffer); pass ``False`` to opt
         out.
+    breaker:
+        The service circuit breaker
+        (:class:`~raft_tpu.serve.resilience.CircuitBreaker`;
+        docs/FAULT_MODEL.md "Serving failure model").  Default (None):
+        construct one from the ``serve_breaker_*`` config knobs —
+        every service is breaker-protected out of the box.  Pass a
+        configured instance to tune it, or ``False`` to opt out
+        entirely (PR 3's relay-every-failure behavior).
     query_cache_size:
         > 0 enables the :class:`VecCache` query-vector cache
         (:meth:`cache_put` / :meth:`submit_keys`).
@@ -153,6 +167,7 @@ class Service:
                  queue_cap: Optional[int] = None,
                  retry_policy=None,
                  donate: Optional[bool] = None,
+                 breaker=None,
                  query_cache_size: int = 0,
                  maintenance: Optional[Callable[[], None]] = None,
                  maintenance_interval_s: float = 0.05,
@@ -180,13 +195,34 @@ class Service:
         self.batcher = MicroBatcher(
             max_batch_rows=self.policy.max_rows,
             max_wait_s=float(max_wait_ms) / 1e3,
-            queue_cap=int(queue_cap), clock=clock)
+            queue_cap=int(queue_cap), clock=clock, name=name)
+        if breaker is None:
+            threshold = _knob_int("serve_breaker_threshold")
+            window_failures = _knob_int("serve_breaker_window_failures")
+            if threshold == 0 and window_failures == 0:
+                # both trip conditions knobbed off == breaker off (the
+                # env-level opt-out; breaker=False is the code-level
+                # one) — a breaker that can never open is just overhead
+                breaker = None
+            else:
+                breaker = CircuitBreaker(
+                    name,
+                    failure_threshold=threshold,
+                    window=_knob_int("serve_breaker_window"),
+                    window_failures=window_failures,
+                    cooldown_s=_knob_float("serve_breaker_cooldown_ms")
+                    / 1e3,
+                    clock=clock)
+        elif breaker is False:
+            breaker = None
+        self.breaker = breaker
         self.worker = ServeWorker(name, self.batcher, self.policy,
                                   execute, retry_policy=retry_policy,
                                   donate=donate_intent,
                                   maintenance=maintenance,
                                   maintenance_interval_s=(
                                       maintenance_interval_s),
+                                  breaker=breaker,
                                   clock=clock)
         self.donate = self.worker.donate
         self._warmed: Tuple[int, ...] = ()
@@ -227,6 +263,29 @@ class Service:
         """Stop admission, serve out the queue; True when empty."""
         return self.worker.drain(timeout=timeout)
 
+    # -- recovery seams (raft_tpu/serve/resilience.py) ----------------- #
+    def pause(self) -> None:
+        """Suspend the service for recovery: new submits shed with
+        :class:`~raft_tpu.core.error.ServiceUnavailableError`
+        (``reason="recovering"``), batch formation stops, queued
+        requests wait.  Reversible (:meth:`resume`) — unlike drain."""
+        self.batcher.pause()
+
+    def resume(self) -> None:
+        """Re-admit after :meth:`pause`: batch formation restarts (the
+        queued backlog first) and the breaker — whose history described
+        the pre-recovery world — is reset closed."""
+        self.batcher.resume()
+        if self.breaker is not None:
+            self.breaker.reset()
+
+    def post_recover(self) -> None:
+        """Hook run by :class:`~raft_tpu.serve.resilience.RecoveryManager`
+        after a communicator/mesh rebuild, before ``warmup()``.  The
+        base services pin only immutable operands — nothing to redo;
+        :class:`~raft_tpu.serve.ann_service.ANNService` re-publishes
+        its ``(index, delta)`` snapshot here."""
+
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> None:
         """Drain (by default) and stop the worker.  Idempotent."""
@@ -262,10 +321,20 @@ class Service:
         it expires while the request is still queued, the future fails
         with :class:`~raft_tpu.core.error.CommTimeoutError` instead of
         occupying a batch (deadline-aware shedding).
+
+        Unavailability sheds FAST with
+        :class:`~raft_tpu.core.error.ServiceUnavailableError` before
+        anything is queued: a dead worker thread (the queue would only
+        absorb requests nobody serves — restart/recover first), an open
+        circuit breaker (``retry_after_s`` carries the cooldown), or a
+        recovery in progress.
         """
         expects(not self._closed, "%s.submit: service is closed",
                 self.name)
+        # payload validation FIRST: a malformed request is the caller's
+        # bug and must not consume a half-open probe slot
         q = self._check_payload(queries)
+        self._check_available()
         deadline_t = None if timeout is None else self._clock() + timeout
         try:
             fut = self.batcher.submit(q, int(q.shape[0]), deadline_t)
@@ -279,6 +348,39 @@ class Service:
         _gauge("raft_tpu_serve_queue_depth", "requests queued",
                self.name).set(self.batcher.depth())
         return fut
+
+    def _shed_unavailable(self, message: str, reason: str,
+                          retry_after_s: float = 0.0) -> None:
+        _counter("raft_tpu_serve_unavailable_total",
+                 "requests shed because the service is broken or "
+                 "healing (breaker open / dead worker / recovering)",
+                 self.name).inc()
+        raise ServiceUnavailableError(message, self.name, reason,
+                                      retry_after_s)
+
+    def _check_available(self) -> None:
+        """The fail-fast half of admission (docs/FAULT_MODEL.md): a
+        request must never be queued into a service that cannot
+        possibly serve it."""
+        w = self.worker
+        if w.dead():
+            self._shed_unavailable(
+                "%s.submit: worker thread has died — restart() or "
+                "recover before resubmitting" % self.name,
+                "worker_dead")
+        if self.batcher.paused():
+            self._shed_unavailable(
+                "%s.submit: recovery in progress" % self.name,
+                "recovering")
+        if self.breaker is not None and not self.breaker.allow():
+            half_open = self.breaker.state is BreakerState.HALF_OPEN
+            self._shed_unavailable(
+                "%s.submit: circuit breaker is %s — back off and "
+                "retry" % (self.name,
+                           "half-open (probe budget spent)"
+                           if half_open else "open"),
+                "breaker_half_open" if half_open else "breaker_open",
+                self.breaker.retry_after())
 
     def submit_many(self, blocks: Sequence,
                     timeout: Optional[float] = None) -> List[ServeFuture]:
@@ -347,7 +449,7 @@ class Service:
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
         """Small live-state dict (health_check embeds it)."""
-        return {
+        out = {
             "open": self.is_open(),
             "worker_started": self.worker.started(),
             "worker_alive": self.worker.is_alive(),
@@ -355,7 +457,14 @@ class Service:
             "rows_queued": self.batcher.rows_queued(),
             "rungs": list(self.policy.rungs),
             "warmed": bool(self._warmed),
+            "paused": self.batcher.paused(),
+            # a silently failing compactor/maintenance callback must be
+            # visible here, not only as a bare counter
+            "last_maintenance_error": self.worker.last_maintenance_error,
         }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.describe()
+        return out
 
 
 class KNNService(Service):

@@ -306,10 +306,14 @@ class Comms:
 
         When serve workers are registered (:meth:`serve`), the verdict
         additionally carries ``"services"``: each live service's
-        ``stats()`` dict; a service that is open but whose worker
-        thread has died fails the overall ``ok`` (it is silently
-        dropping every queued request), while an intentionally closed
-        service is reported but does not fail health.
+        ``stats()`` dict — including circuit-breaker state and the last
+        maintenance failure (a silently failing compaction is visible
+        here).  A service that is open but whose worker thread has died
+        fails the overall ``ok`` (it is silently dropping every queued
+        request; ``ServeWorker.restart()`` / :meth:`self_heal` are the
+        repair levers), as does an open service whose breaker is
+        tripped open (it is shedding everything).  An intentionally
+        closed service is reported but does not fail health.
 
         Cost note: the battery is not free — ``test_commsplit`` builds
         throwaway sub-communicators whose programs recompile on every
@@ -329,12 +333,21 @@ class Comms:
             services = {name: svc.stats()
                         for name, svc in self._services.items()}
             out["services"] = services
+
             # fail health only for a service that SHOULD be serving: a
-            # started worker that died while the service is still open
-            # (threadless test-mode services and closed services pass)
-            out["ok"] = ok and all(
-                s["worker_alive"] or not s["worker_started"]
-                or not s["open"] for s in services.values())
+            # started worker that died, or a breaker tripped open,
+            # while the service is still open (threadless test-mode
+            # services and closed services pass)
+            def _service_ok(s):
+                if not s["open"]:
+                    return True
+                if s["worker_started"] and not s["worker_alive"]:
+                    return False
+                br = s.get("breaker")
+                return not (br and br.get("state") == "open")
+
+            out["ok"] = ok and all(_service_ok(s)
+                                   for s in services.values())
         return out
 
     def recover(self, devices: Optional[Sequence] = None,
@@ -402,6 +415,26 @@ class Comms:
             print(f"Recovered comms session {self.sessionId} on "
                   f"{len(devices)} surviving devices")
         return self.comms
+
+    def self_heal(self, **recover_kwargs) -> Dict:
+        """Health-check, and if anything is wrong — aborted
+        communicator, dead device, dead worker thread, tripped breaker
+        — run the full serving recovery sequence
+        (:class:`raft_tpu.serve.resilience.RecoveryManager`): pause
+        admission, quiesce in-flight batches, rebuild the communicator
+        on the devices the check reported live, re-publish service
+        state and re-run ``warmup()``, restart dead workers, re-admit.
+
+        Returns ``{"report": health_check dict, "recovered": bool,
+        "recovery": recover report or None}``.  Call from a supervising
+        thread (operator loop / chaos harness), never from a serve
+        worker.  ``recover_kwargs`` forward to
+        :meth:`RecoveryManager.recover` (``devices=`` / ``mesh=``
+        override the probed survivor list)."""
+        expects(self.initialized, "self_heal: session not initialized")
+        from raft_tpu.serve.resilience import RecoveryManager
+
+        return RecoveryManager(self).check_and_recover(**recover_kwargs)
 
     # -- serving (docs/SERVING.md) ------------------------------------- #
     def serve(self, kind: str = "knn", *, name: Optional[str] = None,

@@ -10,6 +10,13 @@
 # injection harness is fully seeded, so any failure reproduces with the
 # printed seed.
 #
+# `./stress.sh chaos [N]` loops the serving chaos scenario N times
+# (default 10) with a rotating seed: tools/loadgen.py --chaos injects
+# seeded serve-seam faults plus a mid-run simulated device loss and
+# asserts every submitted request resolves exactly once with a result
+# or typed error (docs/FAULT_MODEL.md "Serving failure model"); a
+# failure reproduces with the printed seed.
+#
 # `./stress.sh serve [N]` loops the serving-layer suite N times
 # (default 10) with a rotating data/submit-order seed
 # (RAFT_TPU_SERVE_SEED) — the concurrent-submitter tests (including
@@ -27,6 +34,16 @@ if [[ "${1:-}" == "faults" ]]; then
     for i in $(seq 1 "$n"); do
         echo "== faults stress $i/$n (RAFT_TPU_FAULT_SEED=$i) =="
         RAFT_TPU_FAULT_SEED="$i" python -m pytest tests/ -q -m faults
+    done
+    exit 0
+fi
+if [[ "${1:-}" == "chaos" ]]; then
+    n="${2:-10}"
+    for i in $(seq 1 "$n"); do
+        echo "== serve chaos $i/$n (seed=$i) =="
+        python tools/loadgen.py --chaos --seed "$i" --duration 3 \
+            --concurrency 4 --index-rows 3000 --dim 16 --k 5 \
+            --max-batch-rows 64 --max-wait-ms 1
     done
     exit 0
 fi

@@ -66,12 +66,17 @@ def make_ann(index, *, start=False, **kw):
     return ANNService(index, k=10, start=start, **kw)
 
 
-def _step(svc, fut, timeout=1.0):
+def _step(svc, fut, timeout=5.0):
     """Drive a threadless worker until ``fut`` resolves (the window is
-    wall-clock; poll run_once until the batcher releases the batch)."""
+    wall-clock; poll run_once until the batcher releases the batch).
+    The timeout only fires while the future is genuinely unresolved —
+    a ``run_once`` whose first dispatch pays a long compile must not
+    trip it after the fact."""
     t0 = time.monotonic()
     while not fut.done():
         svc.worker.run_once()
+        if fut.done():
+            break
         if time.monotonic() - t0 > timeout:
             raise AssertionError("future did not resolve")
         time.sleep(0.002)

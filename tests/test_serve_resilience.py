@@ -1,0 +1,731 @@
+"""Serving resilience (raft_tpu.serve.resilience): serve-seam fault
+injection, circuit breaker + failure classification, requeue-once,
+degraded-mode ANN dispatch, recovery orchestration, session self_heal,
+and the chaos acceptance scenario (docs/FAULT_MODEL.md "Serving failure
+model").
+
+Deterministic halves drive a FakeClock through the injectable-clock
+seam and step workers manually; the orchestration/chaos halves use real
+worker threads.  ``./stress.sh chaos N`` loops the loadgen chaos
+scenario with rotating seeds on top of this file's fixed-seed version.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.comms import faults
+from raft_tpu.core.error import (
+    CommTimeoutError,
+    LogicError,
+    ServiceUnavailableError,
+)
+from raft_tpu.core.metrics import default_registry
+from raft_tpu.serve import (
+    ANNService,
+    BreakerState,
+    CircuitBreaker,
+    KNNService,
+    RecoveryManager,
+    Service,
+    inject_worker,
+)
+from raft_tpu.spatial.knn import brute_force_knn
+
+pytestmark = pytest.mark.serve
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def index(rng):
+    return jnp.asarray(rng.standard_normal((300, 16)), jnp.float32)
+
+
+def _echo_service(clock, **kw):
+    return Service("echo", lambda p: p * 2.0, dim=4, start=False,
+                   max_batch_rows=8, max_wait_ms=0.0, clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------- #
+# circuit breaker state machine
+# ---------------------------------------------------------------------- #
+class TestCircuitBreaker:
+    def test_consecutive_trip_cooldown_probe_close(self):
+        clock = FakeClock()
+        br = CircuitBreaker("b", failure_threshold=3, window_failures=0,
+                            cooldown_s=1.0, clock=clock)
+        boom = RuntimeError("device gone")
+        assert not br.record_failure(boom)
+        assert not br.record_failure(boom)
+        assert br.state is BreakerState.CLOSED and br.allow()
+        assert br.record_failure(boom)          # third strike trips
+        assert br.state is BreakerState.OPEN
+        assert not br.allow()
+        assert br.retry_after() == pytest.approx(1.0)
+        assert br.dispatch_hold() == pytest.approx(1.0)
+        clock.advance(1.01)                     # cooldown elapses
+        assert br.dispatch_hold() == 0.0
+        assert br.state is BreakerState.HALF_OPEN
+        assert br.allow()                       # probe admission
+        br.record_success()
+        assert br.state is BreakerState.CLOSED  # close_after=1
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        clock = FakeClock()
+        br = CircuitBreaker("b", failure_threshold=1, cooldown_s=2.0,
+                            clock=clock)
+        br.record_failure(RuntimeError("x"))
+        clock.advance(2.5)
+        assert br.state is BreakerState.HALF_OPEN
+        assert br.record_failure(RuntimeError("probe failed"))
+        assert br.state is BreakerState.OPEN
+        assert br.retry_after() == pytest.approx(2.0)
+
+    def test_windowed_trip_catches_flapping(self):
+        clock = FakeClock()
+        br = CircuitBreaker("b", failure_threshold=0, window=6,
+                            window_failures=3, clock=clock)
+        for _ in range(2):
+            br.record_success()
+            assert not br.record_failure(RuntimeError("flap"))
+        br.record_success()
+        assert br.record_failure(RuntimeError("flap"))  # 3rd in window
+        assert br.state is BreakerState.OPEN
+
+    def test_caller_bugs_classified_out(self):
+        clock = FakeClock()
+        br = CircuitBreaker("b", failure_threshold=1, clock=clock)
+        for exc in (LogicError("bad shape", collect_stack=False),
+                    ValueError("x"), TypeError("x")):
+            assert not br.record_failure(exc)
+        assert br.state is BreakerState.CLOSED
+        assert br.describe()["consecutive_failures"] == 0
+
+    def test_half_open_probe_budget(self):
+        clock = FakeClock()
+        br = CircuitBreaker("b", failure_threshold=1, cooldown_s=0.5,
+                            half_open_probes=2, clock=clock)
+        br.record_failure(RuntimeError("x"))
+        clock.advance(0.6)
+        assert br.allow() and br.allow()
+        assert not br.allow()                   # budget exhausted
+        br.record_success()
+        assert br.state is BreakerState.CLOSED
+        assert br.allow()
+
+    def test_half_open_budget_refreshes_each_cooldown(self):
+        """A probe that never produces a batch outcome (expired in
+        queue, shed, malformed) must not wedge HALF_OPEN shut: each
+        elapsed cooldown grants a fresh probe budget."""
+        clock = FakeClock()
+        br = CircuitBreaker("b", failure_threshold=1, cooldown_s=1.0,
+                            half_open_probes=1, clock=clock)
+        br.record_failure(RuntimeError("x"))
+        clock.advance(1.1)
+        assert br.allow()                       # the one probe slot
+        assert not br.allow()                   # spent; no outcome ever
+        clock.advance(1.1)                      # a cooldown later
+        assert br.allow()                       # fresh budget, not wedged
+
+    def test_both_conditions_disabled_rejected(self):
+        with pytest.raises(LogicError):
+            CircuitBreaker("b", failure_threshold=0, window_failures=0)
+
+    def test_manual_trip_and_reset(self):
+        clock = FakeClock()
+        br = CircuitBreaker("b", clock=clock)
+        br.trip()
+        assert br.state is BreakerState.OPEN
+        br.reset()
+        assert br.state is BreakerState.CLOSED
+
+
+# ---------------------------------------------------------------------- #
+# serve-seam fault injection (the comms vocabulary, retargeted)
+# ---------------------------------------------------------------------- #
+class TestServeSeamInjection:
+    def test_failnth_hits_the_seam_and_restores(self):
+        clock = FakeClock()
+        svc = _echo_service(clock, breaker=False)
+        with inject_worker(svc.worker,
+                           faults.FailNth(1, verb="serve.echo")) as log:
+            f1 = svc.submit(jnp.ones((2, 4)))
+            svc.worker.run_once()
+            with pytest.raises(faults.InjectedError):
+                f1.result(timeout=0)
+            f2 = svc.submit(jnp.ones((2, 4)))
+            svc.worker.run_once()               # second call passes
+            assert f2.exception(timeout=0) is None
+        assert len(log.injected) == 1
+        assert log.injected[0].verb == "serve.echo"
+        # key carries the padded bucket rows for assertions
+        verb, key = log.calls[0]
+        assert verb == "serve.echo"
+        assert key[1] in svc.policy.rungs
+        f3 = svc.submit(jnp.ones((1, 4)))       # seam restored
+        svc.worker.run_once()
+        assert f3.exception(timeout=0) is None
+        svc.close()
+
+    def test_random_fail_deterministic_per_seed(self):
+        clock = FakeClock()
+
+        def run(seed):
+            svc = _echo_service(clock, breaker=False)
+            outcomes = []
+            with inject_worker(svc.worker,
+                               faults.RandomFail(0.5, seed=seed)):
+                for _ in range(12):
+                    f = svc.submit(jnp.ones((1, 4)))
+                    svc.worker.run_once()
+                    outcomes.append(f.exception(timeout=0) is None)
+            svc.close()
+            return outcomes
+
+        assert run(SEED) == run(SEED)           # seeded: replays
+
+    def test_injection_sits_below_the_retry_layer(self):
+        from raft_tpu.comms.resilience import RetryPolicy
+
+        clock = FakeClock()
+        svc = _echo_service(clock, retry_policy=RetryPolicy(
+            max_retries=2, base_delay=0.0, sleep=lambda s: None))
+        with inject_worker(svc.worker, faults.FailNth(1)) as log:
+            f = svc.submit(jnp.ones((1, 4)))
+            svc.worker.run_once()
+        assert f.exception(timeout=0) is None   # retry won
+        assert len(log.injected) == 1
+        assert len(log.calls) == 2              # attempt + retry
+        svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# breaker wired through the worker: shed, hold, requeue-once
+# ---------------------------------------------------------------------- #
+class TestBreakerDispatch:
+    def _tripping_service(self, clock, **kw):
+        br = CircuitBreaker("echo", failure_threshold=1,
+                            cooldown_s=1.0, clock=clock)
+        return _echo_service(clock, breaker=br, **kw), br
+
+    def test_trip_requeues_riders_once_then_relays(self):
+        clock = FakeClock()
+        svc, br = self._tripping_service(clock)
+        with inject_worker(svc.worker,
+                           faults.FailNth(1, persistent=True)):
+            f = svc.submit(jnp.ones((2, 4)))
+            svc.worker.run_once()
+            # the tripping batch's riders are re-enqueued, not lost
+            assert br.state is BreakerState.OPEN
+            assert not f.done()
+            assert svc.batcher.depth() == 1
+            # dispatch held while open
+            assert not svc.worker.run_once()
+            clock.advance(1.1)                  # half-open probe
+            svc.worker.run_once()
+            # second strike: the error is relayed
+            with pytest.raises(faults.InjectedError):
+                f.result(timeout=0)
+        svc.close()
+
+    def test_trip_then_heal_serves_requeued_rider(self):
+        clock = FakeClock()
+        svc, br = self._tripping_service(clock)
+        with inject_worker(svc.worker, faults.FailNth(1)):
+            f = svc.submit(jnp.ones((2, 4)))
+            svc.worker.run_once()               # trips + requeues
+        assert not f.done()
+        clock.advance(1.1)
+        assert svc.worker.run_once()            # probe succeeds
+        assert np.asarray(f.result(timeout=0)).shape == (2, 4)
+        assert br.state is BreakerState.CLOSED
+        # exactly-once: the rider resolved with its real result
+        total = default_registry().family_total(
+            "raft_tpu_serve_requeued_total")
+        assert total >= 1
+        svc.close()
+
+    def test_open_breaker_sheds_admission_with_retry_after(self):
+        clock = FakeClock()
+        svc, br = self._tripping_service(clock)
+        br.trip()
+        with pytest.raises(ServiceUnavailableError) as ei:
+            svc.submit(jnp.ones((1, 4)))
+        assert ei.value.reason == "breaker_open"
+        assert ei.value.service == "echo"
+        assert ei.value.retry_after_s == pytest.approx(1.0)
+        svc.close()
+
+    def test_drain_overrides_the_hold(self):
+        clock = FakeClock()
+        svc, br = self._tripping_service(clock)
+        with inject_worker(svc.worker,
+                           faults.FailNth(1, persistent=True)):
+            f = svc.submit(jnp.ones((1, 4)))
+            svc.worker.run_once()               # trip + requeue
+            assert br.state is BreakerState.OPEN
+            # close must not hang behind an open breaker: drain
+            # dispatches anyway and the second strike relays
+            svc.close(timeout=5.0)
+        with pytest.raises(faults.InjectedError):
+            f.result(timeout=0)
+
+    def test_caller_bug_batch_does_not_trip(self):
+        clock = FakeClock()
+        svc, br = self._tripping_service(clock)
+        with inject_worker(
+                svc.worker,
+                _RaiseFault(LogicError("bad", collect_stack=False))):
+            f = svc.submit(jnp.ones((1, 4)))
+            svc.worker.run_once()
+        with pytest.raises(LogicError):
+            f.result(timeout=0)                 # relayed immediately
+        assert br.state is BreakerState.CLOSED  # classified out
+        svc.close()
+
+
+class _RaiseFault(faults.Fault):
+    """Raise a specific exception instance on every matching call."""
+
+    def __init__(self, exc, verb=None):
+        super().__init__(verb)
+        self.exc = exc
+
+    def apply(self, comms, verb, key, n_match):
+        raise self.exc
+
+
+# ---------------------------------------------------------------------- #
+# satellites: dead worker, maintenance error, future taxonomy
+# ---------------------------------------------------------------------- #
+class TestFailFastSatellites:
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_dead_worker_sheds_then_restart_serves(self):
+        state = {"die": True}
+
+        def exe(p):
+            if state["die"]:
+                raise SystemExit("loop killer")  # kills the thread
+            return p * 2.0
+
+        svc = Service("mort", exe, dim=4, max_batch_rows=8,
+                      max_wait_ms=0.5)
+        doomed = svc.submit(jnp.ones((1, 4)))
+        deadline = time.monotonic() + 10.0
+        while svc.worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not svc.worker.is_alive()
+        # even a worker-KILLING failure resolves its riders first (the
+        # exactly-once guarantee): the future carries the error
+        assert doomed.wait(timeout=5.0)
+        assert doomed.exception(timeout=0) is not None
+        with pytest.raises(ServiceUnavailableError) as ei:
+            svc.submit(jnp.ones((1, 4)))
+        assert ei.value.reason == "worker_dead"
+        state["die"] = False
+        assert svc.worker.restart()
+        assert not svc.worker.restart()          # alive: no-op
+        out = svc.submit(jnp.ones((1, 4))).result(timeout=10.0)
+        assert bool((np.asarray(out) == 2.0).all())
+        svc.close()
+
+    def test_restart_raises_once_closed(self, index):
+        svc = KNNService(index, k=3, start=False, max_batch_rows=8)
+        svc.close()
+        with pytest.raises(LogicError):
+            svc.worker.restart()
+
+    def test_maintenance_error_captured_and_cleared(self):
+        state = {"fail": True}
+
+        def maint():
+            if state["fail"]:
+                raise RuntimeError("compactor exploded")
+
+        clock = FakeClock(t=42.0)
+        svc = Service("m", lambda p: p, dim=4, start=False,
+                      maintenance=maint, clock=clock)
+        svc.worker.run_maintenance()
+        err = svc.stats()["last_maintenance_error"]
+        assert err["type"] == "RuntimeError"
+        assert "compactor exploded" in err["message"]
+        assert err["at"] == pytest.approx(42.0)
+        state["fail"] = False
+        svc.worker.run_maintenance()             # success clears it
+        assert svc.stats()["last_maintenance_error"] is None
+        svc.close()
+
+    def test_future_timeout_is_typed_and_names_service(self):
+        svc = Service("slowpoke", lambda p: p, dim=4, start=False)
+        fut = svc.submit(jnp.ones((1, 4)))
+        with pytest.raises(CommTimeoutError, match="slowpoke"):
+            fut.result(timeout=0.01)
+        with pytest.raises(CommTimeoutError, match="slowpoke"):
+            fut.exception(timeout=0.01)
+        svc.close(drain=False)
+
+    def test_breaker_knob_defaults_resolve(self):
+        clock = FakeClock()
+        svc = _echo_service(clock)
+        d = svc.breaker.describe()
+        assert d["state"] == "closed"
+        assert d["window"] == 16                 # serve_breaker_window
+        assert d["cooldown_s"] == pytest.approx(0.25)
+        assert svc.stats()["breaker"]["state"] == "closed"
+        svc.close()
+
+    def test_breaker_opt_out(self):
+        clock = FakeClock()
+        svc = _echo_service(clock, breaker=False)
+        assert svc.breaker is None
+        assert "breaker" not in svc.stats()
+        svc.close()
+
+    def test_breaker_knobs_both_zero_means_off(self):
+        """The env-level opt-out: both trip conditions knobbed to 0
+        disables the breaker instead of crashing construction."""
+        from raft_tpu import config
+
+        with config.override(serve_breaker_threshold="0",
+                             serve_breaker_window_failures="0"):
+            clock = FakeClock()
+            svc = _echo_service(clock)
+            assert svc.breaker is None
+            svc.close()
+
+    def test_half_open_exhausted_shed_reason_and_hint(self):
+        clock = FakeClock()
+        br = CircuitBreaker("echo", failure_threshold=1,
+                            cooldown_s=1.0, half_open_probes=1,
+                            clock=clock)
+        svc = _echo_service(clock, breaker=br)
+        br.record_failure(RuntimeError("x"))
+        clock.advance(1.1)                       # OPEN -> HALF_OPEN
+        svc.submit(jnp.ones((1, 4)))             # the one probe slot
+        with pytest.raises(ServiceUnavailableError) as ei:
+            svc.submit(jnp.ones((1, 4)))
+        assert ei.value.reason == "breaker_half_open"
+        assert ei.value.retry_after_s > 0.0      # budget refresh hint
+        svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# degraded-mode ANN dispatch (quality brownout)
+# ---------------------------------------------------------------------- #
+class TestDegradedDispatch:
+    @pytest.fixture
+    def ann(self, rng):
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+        ref = jnp.asarray(rng.standard_normal((2000, 16)), jnp.float32)
+        idx = ivf_flat_build(ref, IVFFlatParams(nlist=32, nprobe=8))
+        svc = ANNService(idx, k=5, nprobe=8, nprobe_ladder=(2, 4, 8),
+                         start=False, max_batch_rows=16,
+                         max_wait_ms=0.0, queue_cap=8,
+                         degrade_queue_frac=0.5, name="deg")
+        yield svc
+        svc.close()
+
+    def test_queue_pressure_steps_down_and_restores(self, ann):
+        assert ann._effective_nprobe() == (8, False)
+        for _ in range(4):                       # 4/8 >= 0.5: pressure
+            ann.submit(jnp.ones((1, 16)))
+        assert ann._effective_nprobe() == (4, True)
+        while ann.worker.run_once():
+            pass
+        assert ann._effective_nprobe() == (8, False)  # pressure cleared
+        # the formed batch drains the queue below the threshold before
+        # dispatch, so the batch itself is usually served at full
+        # quality — the live gauge family exists either way
+        assert default_registry().get(
+            "raft_tpu_serve_degraded_active") is not None
+
+    def test_half_open_breaker_degrades(self, ann):
+        ann.breaker.trip()
+        # force the cooldown elapsed via the breaker's own clock
+        ann.breaker._opened_t = -1e9
+        assert ann.breaker.state is BreakerState.HALF_OPEN
+        assert ann._effective_nprobe() == (4, True)
+        ann.breaker.reset()
+        assert ann._effective_nprobe() == (8, False)
+
+    def test_manual_hold_walks_the_ladder(self, ann):
+        ann.degrade(2)
+        assert ann._effective_nprobe() == (2, True)
+        ann.restore()
+        assert ann._effective_nprobe() == (8, False)
+        assert ann.stats()["degrade_queue_frac"] == pytest.approx(0.5)
+
+    def test_degraded_batch_counted_and_results_sane(self, ann, rng):
+        q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+        ann.degrade(2)                           # every batch browns out
+        fut = ann.submit(q)
+        ann.worker.run_once()
+        d, i = fut.result(timeout=0)
+        assert np.asarray(i).shape == (2, 5)
+        fam = default_registry().get(
+            "raft_tpu_serve_degraded_batches_total")
+        vals = {labels["service"]: series.value
+                for labels, series in fam.series()}
+        assert vals.get("deg", 0) >= 1
+        ann.restore()
+
+
+# ---------------------------------------------------------------------- #
+# recovery orchestration
+# ---------------------------------------------------------------------- #
+class TestRecoveryManager:
+    def test_recover_carries_ann_snapshot_and_readmits(self, rng):
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+        ref = jnp.asarray(rng.standard_normal((1500, 8)), jnp.float32)
+        idx = ivf_flat_build(ref, IVFFlatParams(nlist=16, nprobe=16))
+        svc = ANNService(idx, k=3, nprobe=16, nprobe_ladder=(4, 16),
+                         start=False, max_batch_rows=8,
+                         max_wait_ms=0.0, compact_rows=0, name="rec")
+        # streaming state that must survive the failure
+        new_vec = jnp.asarray(rng.standard_normal((1, 8)), jnp.float32)
+        svc.insert([99999], new_vec)
+        mgr = RecoveryManager(services=[svc])
+        report = mgr.recover()
+        assert report["services"] == ["rec"]
+        assert not report["comms_recovered"]
+        assert svc.delta_rows == 1               # snapshot carried
+        assert not svc.batcher.paused()          # re-admitted
+        fut = svc.submit(new_vec)
+        svc.worker.run_once()
+        d, i = fut.result(timeout=0)
+        assert 99999 in np.asarray(i)[0]         # inserted row found
+        total = default_registry().family_total(
+            "raft_tpu_serve_recoveries_total")
+        assert total >= 1
+        svc.close()
+
+    def test_pause_sheds_recovering(self, index):
+        svc = KNNService(index, k=3, start=False, max_batch_rows=8,
+                         name="pz")
+        svc.pause()
+        with pytest.raises(ServiceUnavailableError) as ei:
+            svc.submit(jnp.ones((1, 16)))
+        assert ei.value.reason == "recovering"
+        svc.resume()
+        svc.submit(jnp.ones((1, 16)))            # admits again
+        svc.close()
+
+    def test_session_self_heal_after_abort(self, index, rng):
+        from raft_tpu.session import Comms
+
+        s = Comms().init()
+        try:
+            svc = s.serve("knn", index=index, k=3, max_batch_rows=16,
+                          max_wait_ms=1.0, name="heal-knn")
+            svc.warmup()
+            q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+            ref = brute_force_knn(index, q, 3)
+            s.comms.abort()                      # the device-loss latch
+            healed = s.self_heal(devices=[0, 1, 2, 3])
+            assert healed["recovered"]
+            assert s.comms.get_size() == 4       # surviving sub-mesh
+            # post-recovery serving is bit-identical to unbatched
+            d, i = svc.submit(q).result(timeout=15.0)
+            np.testing.assert_array_equal(np.asarray(i),
+                                          np.asarray(ref[1]))
+            np.testing.assert_array_equal(np.asarray(d),
+                                          np.asarray(ref[0]))
+            report = s.health_check()
+            assert report["ok"]
+        finally:
+            s.destroy()
+
+    def test_self_heal_cheap_path_for_breaker_only_trip(self, index):
+        """A tripped breaker on a healthy mesh must NOT cost a
+        communicator rebuild or a re-warmup — re-admit only."""
+        from raft_tpu.session import Comms
+
+        s = Comms().init()
+        try:
+            # long cooldown: the trip must still be OPEN when
+            # health_check's battery (seconds) finishes
+            svc = s.serve("knn", index=index, k=3, max_batch_rows=16,
+                          max_wait_ms=1.0, name="cheap-knn",
+                          breaker=CircuitBreaker(
+                              "cheap-knn", failure_threshold=1,
+                              cooldown_s=60.0))
+            svc.warmup()
+            n_dev = s.comms.get_size()
+            svc.breaker.trip()
+            healed = s.self_heal()
+            assert healed["recovered"]
+            assert not healed["recovery"]["comms_recovered"]
+            assert s.comms.get_size() == n_dev   # no mesh rebuild
+            assert svc.breaker.state is BreakerState.CLOSED
+            assert s.health_check()["ok"]
+        finally:
+            s.destroy()
+
+    def test_self_heal_noop_when_healthy(self, index):
+        from raft_tpu.session import Comms
+
+        s = Comms().init()
+        try:
+            s.serve("knn", index=index, k=3, max_batch_rows=16,
+                    name="fine-knn")
+            healed = s.self_heal()
+            assert not healed["recovered"]
+            assert healed["report"]["ok"]
+        finally:
+            s.destroy()
+
+
+# ---------------------------------------------------------------------- #
+# the chaos acceptance scenario (ISSUE 7 acceptance criterion)
+# ---------------------------------------------------------------------- #
+class TestChaosAcceptance:
+    def test_chaos_exactly_once_with_recovery(self, rng):
+        """Seeded serve-seam faults + mid-run simulated device loss:
+        the service trips, recovers, re-admits; every submitted request
+        resolves exactly once with a result or typed error; the
+        recovery is visible in ``raft_tpu_serve_recoveries_total`` and
+        the breaker state metric; post-recovery results are
+        bit-identical to the unbatched call."""
+        from tools.loadgen import run_chaos
+
+        index = jnp.asarray(rng.standard_normal((1000, 16)),
+                            jnp.float32)
+        svc = KNNService(index, k=4, max_batch_rows=64,
+                         max_wait_ms=1.0, name="chaos-knn")
+        svc.warmup()
+        mgr = RecoveryManager(services=[svc])
+        report = run_chaos(svc, duration=2.5, concurrency=4, rows=2,
+                           seed=SEED, transient_p=0.05, outage_at=0.3,
+                           outage_s=0.5, manager=mgr)
+        try:
+            assert report["exactly_once"], report
+            assert report["typed_only"], report
+            assert report["lost"] == 0
+            assert report["recoveries"] >= 1     # visible in metrics
+            assert report["breaker_state"] is not None
+            fam = default_registry().get("raft_tpu_serve_breaker_state")
+            assert fam is not None
+            # post-recovery: breaker closed again, served results exact
+            assert svc.breaker.state is BreakerState.CLOSED
+        finally:
+            svc.close()
+
+    def test_chaos_self_heals_without_manager(self, rng):
+        """No RecoveryManager at all: the breaker's half-open probe
+        alone re-closes the service once the outage clears — the
+        transient-fault self-healing path."""
+        from tools.loadgen import run_chaos
+
+        index = jnp.asarray(rng.standard_normal((500, 8)), jnp.float32)
+        svc = KNNService(index, k=3, max_batch_rows=32,
+                         max_wait_ms=1.0, name="chaos-nomgr",
+                         breaker=CircuitBreaker(
+                             "chaos-nomgr", failure_threshold=2,
+                             cooldown_s=0.1))
+        svc.warmup()
+        report = run_chaos(svc, duration=2.0, concurrency=3, rows=2,
+                           seed=SEED + 1, transient_p=0.0,
+                           outage_at=0.3, outage_s=0.4, manager=None)
+        try:
+            assert report["exactly_once"], report
+            assert report["typed_only"], report
+            assert report["breaker_trips"] >= 1
+            assert report["recoveries"] == 0
+            assert report["breaker_state"] == "closed"  # self-healed
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# CI hygiene: the serve except-Exception audit
+# ---------------------------------------------------------------------- #
+class TestServeExceptAudit:
+    def _check(self, tmp_path, relpath, src, monkeypatch):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(os.path.dirname(__file__),
+                                        "..", "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(mod, "REPO", str(tmp_path))
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        return mod.check_file(str(path))
+
+    def test_silent_swallow_flagged(self, tmp_path, monkeypatch):
+        src = ("try:\n"
+               "    x = 1\n"
+               "except Exception:\n"
+               "    pass\n")
+        probs = self._check(tmp_path, "raft_tpu/serve/bad.py", src,
+                            monkeypatch)
+        assert any("except Exception" in p for p in probs)
+
+    def test_relay_and_counter_and_marker_pass(self, tmp_path,
+                                               monkeypatch):
+        src = ("def f(req, counter):\n"
+               "    try:\n"
+               "        x = 1\n"
+               "    except Exception as e:\n"
+               "        req.future._set_exception(e)\n"
+               "    try:\n"
+               "        x = 2\n"
+               "    except Exception:\n"
+               "        counter.inc()\n"
+               "    try:\n"
+               "        x = 3\n"
+               "    except Exception:  # serve-exc-ok: audited\n"
+               "        pass\n"
+               "    try:\n"
+               "        x = 4\n"
+               "    except Exception:\n"
+               "        raise\n")
+        probs = self._check(tmp_path, "raft_tpu/serve/good.py", src,
+                            monkeypatch)
+        assert probs == []
+
+    def test_outside_serve_not_audited(self, tmp_path, monkeypatch):
+        src = ("try:\n"
+               "    x = 1\n"
+               "except Exception:\n"
+               "    pass\n")
+        probs = self._check(tmp_path, "raft_tpu/spatial/ok.py", src,
+                            monkeypatch)
+        assert not any("except Exception" in p for p in probs)
+
+    def test_repo_is_clean(self):
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "ci",
+                          "style_check.py")],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr

@@ -34,8 +34,18 @@ where it doubles as an end-to-end correctness check: recall 1.0).
 ``--recall-target`` calibrates ``nprobe`` to the target before the
 measured run (recall-targeted dispatch, docs/SERVING.md).
 
-Importable: :func:`run_load` returns the report dict (bench.py's
-``serve`` rungs and tests reuse it).
+``--chaos`` runs the **seed-rotated chaos scenario** instead
+(docs/FAULT_MODEL.md "Serving failure model"): seeded transient faults
+at the serve seam for the whole run, a persistent serve-seam outage
+(the simulated device loss) injected mid-run, recovery via
+:class:`raft_tpu.serve.resilience.RecoveryManager`, and — the
+invariant the whole resilience layer exists for — **every submitted
+request resolves exactly once**, with a result or a *typed* error
+(``RaftError`` taxonomy).  Lost futures or untyped errors fail the run
+(exit 1).  ``stress.sh chaos N`` loops it with rotating seeds.
+
+Importable: :func:`run_load` / :func:`run_chaos` return the report
+dict (bench.py's ``serve`` rungs and tests reuse them).
 """
 
 from __future__ import annotations
@@ -354,6 +364,159 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
     return report
 
 
+def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
+              transient_p=0.05, outage_at=0.35, outage_s=0.8,
+              manager=None, query_pool=None):
+    """Chaos scenario: drive ``service`` closed-loop while injecting
+    seeded faults at the serve seam, with a simulated device loss
+    (persistent outage) mid-run; returns the report.
+
+    Timeline (fractions of ``duration``):
+
+    - ``[0, 1]``  — ``RandomFail(p=transient_p, seed=seed)`` at the
+      serve execute seam: every batch may fail transiently; the breaker
+      absorbs the noise (and may trip + self-heal through half-open
+      probes on an unlucky seed — that IS the scenario).
+    - ``[outage_at, outage_at + outage_s/duration]`` — a persistent
+      ``FailNth`` (every batch fails): the simulated device loss.  The
+      breaker trips, admission sheds ``ServiceUnavailableError``,
+      in-flight riders are re-enqueued once.
+    - outage end — the fault detaches ("surviving mesh works again");
+      ``manager.recover()`` runs if a
+      :class:`~raft_tpu.serve.resilience.RecoveryManager` was passed
+      (device-loss semantics: re-publish + re-warm + re-admit),
+      otherwise the breaker's half-open probe re-closes it alone.
+
+    The acceptance invariant, asserted into the report: **every
+    submitted request resolves exactly once** — ``ok + typed_errors +
+    untyped_errors == submitted`` and ``lost == 0`` — and every error
+    is typed (``RaftError`` taxonomy; ``untyped_errors == 0``).
+    Sheds at admission (overload / unavailable) are counted separately:
+    a shed request was never admitted, so it has no future to resolve.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.comms import faults
+    from raft_tpu.core.error import (RaftError, ServiceOverloadError,
+                                     ServiceUnavailableError)
+    from raft_tpu.core.metrics import default_registry
+    from raft_tpu.serve.resilience import ServeFaultInjector
+
+    rng = np.random.default_rng(seed)
+    if query_pool is not None:
+        pool = list(query_pool)
+        rows = int(pool[0].shape[0])
+    else:
+        pool = [jnp.asarray(rng.standard_normal((rows, service.dim)),
+                            jnp.float32) for _ in range(16)]
+    lock = threading.Lock()
+    admitted = []          # (future, submit_t) — every future must resolve
+    counts = {"submitted": 0, "rejected": 0, "unavailable": 0}
+    stop_t = time.monotonic() + duration
+
+    def client(tid):
+        i = tid
+        while time.monotonic() < stop_t:
+            q = pool[i % len(pool)]
+            i += concurrency
+            try:
+                fut = service.submit(q)
+            except ServiceUnavailableError:
+                with lock:
+                    counts["unavailable"] += 1
+                time.sleep(0.01)   # shed: back off, as a client would
+                continue
+            except ServiceOverloadError:
+                with lock:
+                    counts["rejected"] += 1
+                time.sleep(0.01)
+                continue
+            with lock:
+                counts["submitted"] += 1
+                admitted.append(fut)
+            # closed loop: wait (bounded) so concurrency stays fixed,
+            # but resolution is scored in the final sweep either way
+            fut.wait(timeout=5.0)
+
+    def reg_total(name):
+        return int(default_registry().family_total(name))
+
+    trips0 = reg_total("raft_tpu_serve_breaker_trips_total")
+    recov0 = reg_total("raft_tpu_serve_recoveries_total")
+    requeue0 = reg_total("raft_tpu_serve_requeued_total")
+
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    transient = ServeFaultInjector(
+        service.worker,
+        [faults.RandomFail(transient_p, seed=seed)] if transient_p > 0
+        else [])
+    transient.activate()
+    outage = None
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(max(0.0, duration * outage_at))
+        # the simulated device loss: every batch fails, persistently
+        outage = ServeFaultInjector(
+            service.worker, [faults.FailNth(1, persistent=True)])
+        outage.activate()
+        time.sleep(outage_s)
+        outage.deactivate()         # survivors work again
+        outage = None
+        if manager is not None:
+            manager.recover()       # orchestrated recovery
+        for t in threads:
+            t.join(timeout=duration + 30.0)
+    finally:
+        if outage is not None:
+            outage.deactivate()
+        transient.deactivate()
+    # final sweep: drain what is still queued, then score every future
+    service.drain(timeout=30.0)
+    results = {"ok": 0, "typed_errors": 0, "untyped_errors": 0,
+               "lost": 0}
+    for fut in admitted:
+        if not fut.wait(timeout=30.0):
+            results["lost"] += 1
+            continue
+        err = fut.exception(timeout=0)
+        if err is None:
+            results["ok"] += 1
+        elif isinstance(err, RaftError):
+            results["typed_errors"] += 1
+        else:
+            results["untyped_errors"] += 1
+
+    resolved = (results["ok"] + results["typed_errors"]
+                + results["untyped_errors"])
+    report = {
+        "seed": seed,
+        "duration_s": duration,
+        "outage_s": outage_s,
+        "transient_p": transient_p,
+        **counts,
+        **results,
+        "resolved": resolved,
+        "exactly_once": (results["lost"] == 0
+                         and resolved == counts["submitted"]),
+        "typed_only": results["untyped_errors"] == 0,
+        "breaker_trips": reg_total("raft_tpu_serve_breaker_trips_total")
+        - trips0,
+        "requeued": reg_total("raft_tpu_serve_requeued_total")
+        - requeue0,
+        "recoveries": reg_total("raft_tpu_serve_recoveries_total")
+        - recov0,
+        "breaker_state": (service.breaker.describe()["state"]
+                          if service.breaker is not None else None),
+        "chaos_ok": (results["lost"] == 0
+                     and results["untyped_errors"] == 0
+                     and resolved == counts["submitted"]),
+    }
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--service", choices=("knn", "pairwise", "ann"),
@@ -373,6 +536,16 @@ def main(argv=None) -> int:
     ap.add_argument("--recall-target", type=float, default=None,
                     help="ann: calibrate nprobe to this recall@k "
                          "before the load run")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the seed-rotated chaos scenario (serve-"
+                         "seam faults + simulated device loss + "
+                         "recovery) instead of a load run; exits 1 "
+                         "unless every submit resolved exactly once "
+                         "with a result or typed error")
+    ap.add_argument("--transient-p", type=float, default=0.05,
+                    help="chaos: per-batch transient fault probability")
+    ap.add_argument("--outage-s", type=float, default=0.8,
+                    help="chaos: simulated device-loss duration")
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--qps", type=float, default=100.0,
                     help="open-loop arrival rate")
@@ -408,6 +581,32 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     service.warmup()
     warmup_s = time.monotonic() - t0
+    if args.chaos:
+        from raft_tpu.serve.resilience import RecoveryManager
+
+        manager = RecoveryManager(services=[service])
+        try:
+            report = run_chaos(service, duration=args.duration,
+                               concurrency=args.concurrency,
+                               rows=args.rows, seed=args.seed,
+                               transient_p=args.transient_p,
+                               outage_s=args.outage_s, manager=manager)
+        finally:
+            service.close()
+        report["warmup_s"] = round(warmup_s, 3)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print("== loadgen: %s chaos (seed=%d) =="
+                  % (args.service, args.seed))
+            for key in ("duration_s", "outage_s", "transient_p",
+                        "submitted", "ok", "typed_errors",
+                        "untyped_errors", "lost", "rejected",
+                        "unavailable", "requeued", "breaker_trips",
+                        "recoveries", "breaker_state", "exactly_once",
+                        "typed_only", "chaos_ok"):
+                print("  %-20s %s" % (key, report[key]))
+        return 0 if report["chaos_ok"] else 1
     want_recall = args.recall or args.service == "ann"
     pool = None
     if want_recall:

@@ -158,7 +158,58 @@ def _serve_summary(metrics: dict) -> list:
                             _fmt_s(w["p95"]) if w else "-",
                             _fmt_s(e["p50"]) if e else "-",
                             _fmt_s(e["p95"]) if e else "-"))
+    lines.extend(_serve_resilience_summary(metrics))
     lines.extend(_serve_ann_summary(metrics))
+    return lines
+
+
+def _serve_resilience_summary(metrics: dict) -> list:
+    """Self-healing digest (docs/FAULT_MODEL.md "Serving failure
+    model"): live breaker state plus the outage ledger — trips,
+    unavailable sheds, requeued riders, worker restarts, recoveries,
+    degraded (browned-out) batches — per service."""
+    state = {}
+    for s in metrics.get("raft_tpu_serve_breaker_state",
+                         {}).get("series", []):
+        svc = s["labels"].get("service")
+        if svc is not None:
+            state[svc] = int(s["value"])
+    if not state:
+        return []
+    names = ("closed", "OPEN", "half-open")
+
+    def per_service(name):
+        out = {}
+        for s in metrics.get(name, {}).get("series", []):
+            svc = s["labels"].get("service")
+            if svc is not None:
+                out[svc] = int(s["value"])
+        return out
+
+    trips = per_service("raft_tpu_serve_breaker_trips_total")
+    unavail = per_service("raft_tpu_serve_unavailable_total")
+    requeued = per_service("raft_tpu_serve_requeued_total")
+    restarts = per_service("raft_tpu_serve_worker_restarts_total")
+    recoveries = per_service("raft_tpu_serve_recoveries_total")
+    degraded = per_service("raft_tpu_serve_degraded_batches_total")
+    maint = per_service("raft_tpu_serve_maintenance_errors_total")
+    lines = []
+    for svc in sorted(state):
+        lines.append(
+            "  %-24s breaker=%-9s trips=%-3d unavailable=%-5d "
+            "requeued=%-4d recoveries=%d"
+            % (svc, names[state[svc]], trips.get(svc, 0),
+               unavail.get(svc, 0), requeued.get(svc, 0),
+               recoveries.get(svc, 0)))
+        extra = []
+        if degraded.get(svc):
+            extra.append("degraded_batches=%d" % degraded[svc])
+        if restarts.get(svc):
+            extra.append("worker_restarts=%d" % restarts[svc])
+        if maint.get(svc):
+            extra.append("maintenance_errors=%d" % maint[svc])
+        if extra:
+            lines.append("  %-24s   %s" % ("", " ".join(extra)))
     return lines
 
 
