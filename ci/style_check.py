@@ -34,6 +34,13 @@ Checks, per Python source file:
   ``comms-host-ok`` marker comment is exempt (device *handles* like
   mesh construction, and the deliberately-counted ``staging="host"``
   baseline).
+- no direct ``jax.jit`` inside ``raft_tpu/spatial/mnmg_knn.py``: every
+  SPMD program the sharded serving layer dispatches must compile
+  through :func:`raft_tpu.core.profiler.profiled_jit` (and donating
+  twins), or serve ``warmup()``'s zero-steady-state-compiles proof and
+  loadgen's ``post_warmup_compiles`` check are blind to sharded
+  compiles (docs/SERVING.md "Sharded serving").  A deliberate
+  exception carries an ``mnmg-jit-ok`` marker comment on the line.
 - no silent ``except Exception`` inside ``raft_tpu/serve/``: a serving
   failure must go SOMEWHERE a rider or an operator can see it — the
   handler must relay to rider futures (``_set_exception``), feed the
@@ -83,6 +90,13 @@ COMMS_NP_ALLOWLIST = (
 COMMS_NP_ATTRS = ("asarray", "array")
 COMMS_NP_MARKER = "comms-host-ok"
 
+# direct-jax.jit ban (raft_tpu/spatial/mnmg_knn.py only): sharded SPMD
+# programs compile through profiled_jit so the serving layer's compile
+# accounting sees them (docs/SERVING.md); `mnmg-jit-ok` marks a
+# deliberate exception
+MNMG_JIT_FILES = (os.path.join("raft_tpu", "spatial", "mnmg_knn.py"),)
+MNMG_JIT_MARKER = "mnmg-jit-ok"
+
 # serve except-Exception audit (raft_tpu/serve/ only): a broad handler
 # must relay, count, or re-raise — see module doc
 SERVE_EXC_DIR = os.path.join("raft_tpu", "serve") + os.sep
@@ -131,6 +145,7 @@ def check_file(path):
     in_comms_np_scope = (rel.startswith(COMMS_NP_DIR)
                          and rel not in COMMS_NP_ALLOWLIST)
     in_serve_exc_scope = rel.startswith(SERVE_EXC_DIR)
+    in_mnmg_jit_scope = rel in MNMG_JIT_FILES
     src_lines = src.splitlines()
     # aliases the time/threading modules are bound to ("import time",
     # "import time as t") — attribute-call matching must follow them or
@@ -138,6 +153,7 @@ def check_file(path):
     time_aliases = {"time"}
     threading_aliases = {"threading"}
     numpy_aliases = {"numpy"}
+    jax_aliases = {"jax"}
     for node in ast.walk(tree):
         if (isinstance(node, ast.ImportFrom) and node.module
                 and node.module.startswith("raft_tpu")
@@ -180,6 +196,34 @@ def check_file(path):
                     "background work goes through raft_tpu/serve "
                     "(ServeWorker) or the resilience watchdog "
                     "(docs/SERVING.md)")
+        if in_mnmg_jit_scope:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        jax_aliases.add(a.asname or "jax")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "jax"
+                    and any(a.name == "jit" for a in node.names)
+                    and MNMG_JIT_MARKER
+                    not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: from-import of jax.jit — "
+                    "sharded SPMD programs compile through "
+                    "profiled_jit (docs/SERVING.md); mark deliberate "
+                    f"exceptions `{MNMG_JIT_MARKER}`")
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr == "jit"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in jax_aliases
+                    and MNMG_JIT_MARKER
+                    not in src_lines[node.lineno - 1]):
+                # Attribute (not Call) match: also catches the bare
+                # `@jax.jit` decorator and `f = jax.jit` aliasing
+                problems.append(
+                    f"{rel}:{node.lineno}: direct jax.jit — sharded "
+                    "SPMD programs compile through profiled_jit "
+                    "(docs/SERVING.md); mark deliberate exceptions "
+                    f"`{MNMG_JIT_MARKER}`")
         if in_comms_np_scope:
             if isinstance(node, ast.Import):
                 for a in node.names:

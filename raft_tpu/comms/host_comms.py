@@ -107,6 +107,47 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devs), (_AXIS,))  # comms-host-ok: device handles, not payload
 
 
+def axis_host_group_size(mesh: Mesh, axis: str) -> Optional[int]:
+    """Devices-per-host along ``axis`` when hosts are contiguous runs.
+
+    The hierarchical top-k merge (HiCCL's decomposition applied to
+    candidate merging, :func:`raft_tpu.spatial.mnmg_knn.mnmg_knn`)
+    wants its inner allgather to stay within a host's fast links and
+    its ring to cross the slow inter-host hops.  This resolves the
+    natural group size from device placement: if the axis's devices
+    fall into contiguous equal-length runs of the same
+    ``process_index`` and there is more than one process, that run
+    length IS the host group.  Returns None when no such structure
+    exists (single process — e.g. the virtual CPU mesh — or
+    interleaved placement), and the caller falls back to a divisor
+    heuristic.
+    """
+    expects(axis in mesh.axis_names,
+            "axis_host_group_size: axis %s not in mesh", axis)
+    ax = mesh.axis_names.index(axis)
+    # one representative line of devices along the axis (other axes at
+    # coordinate 0): host runs along the comms axis are what the merge
+    # topology cares about
+    sel = tuple(slice(None) if i == ax else 0
+                for i in range(mesh.devices.ndim))
+    line = list(mesh.devices[sel].ravel())
+    procs = [d.process_index for d in line]
+    if len(set(procs)) <= 1:
+        return None
+    run = 1
+    while run < len(procs) and procs[run] == procs[0]:
+        run += 1
+    if len(procs) % run != 0:
+        return None
+    for base in range(0, len(procs), run):
+        chunk = procs[base:base + run]
+        if len(set(chunk)) != 1:
+            return None
+        if base and chunk[0] == procs[base - 1]:
+            return None
+    return run
+
+
 class _Request:
     """Pending p2p operation (reference request_t, comms.hpp:46)."""
 

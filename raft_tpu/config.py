@@ -48,6 +48,16 @@ spmv_impl
     CSR SpMV (:func:`raft_tpu.sparse.linalg.csr_spmv`): ``segment``
     (gather + sorted segment-sum) | ``cumsum`` (prefix-sum form) |
     ``sortscan`` (gather-free: sort+scan formulation of the x read).
+mnmg_merge
+    Cross-shard top-k merge topology for the SPMD sharded searches
+    (:func:`raft_tpu.spatial.mnmg_knn.mnmg_knn` /
+    ``mnmg_ivf_flat_search`` and the sharded serve dispatch):
+    ``allgather`` (one wide collective + one re-selection) | ``ring``
+    (ppermute streaming, (nq, 2k) peak merge memory) |
+    ``hierarchical`` (allgather within a host group, ring across
+    groups — the HiCCL decomposition applied to top-k).  Consumed at
+    trace time (the executable-cache caveat applies); the serve layer
+    pins it per service at construction.
 serve_bucket_rungs
     Default shape-bucket ladder for :mod:`raft_tpu.serve` services:
     ``pow2`` (power-of-two rungs up to the service's max batch rows) or
@@ -128,6 +138,8 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "pq_adc": ("RAFT_TPU_PQ_ADC", "gather", ("gather", "onehot")),
     "spmv_impl": ("RAFT_TPU_SPMV_IMPL", "segment",
                   ("segment", "cumsum", "sortscan")),
+    "mnmg_merge": ("RAFT_TPU_MNMG_MERGE", "allgather",
+                   ("allgather", "ring", "hierarchical")),
     "serve_bucket_rungs": ("RAFT_TPU_SERVE_BUCKET_RUNGS", "pow2", None),
     "serve_max_wait_ms": ("RAFT_TPU_SERVE_MAX_WAIT_MS", "2", None),
     "serve_queue_cap": ("RAFT_TPU_SERVE_QUEUE_CAP", "1024", None),

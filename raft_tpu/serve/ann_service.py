@@ -75,13 +75,18 @@ __all__ = ["ANNService"]
 
 class _AnnState(NamedTuple):
     """One immutable serving snapshot: a dispatched batch reads exactly
-    one of these (index + delta travel together — the atomic-swap
-    unit), so an insert or compaction can never tear a batch."""
+    one of these (index + delta — and, when sharded, the slot-sharded
+    mirror — travel together: the atomic-swap unit), so an insert,
+    compaction, or re-partition can never tear a batch."""
 
     index: object           # IVFFlatIndex | IVFPQIndex | IVFSQIndex
     delta_vecs: jnp.ndarray  # (delta_cap, dim) device, zeros past count
     delta_ids: jnp.ndarray   # (delta_cap,) int32 device, -1 past count
     delta_rows: int
+    # slot-sharded mirror of ``index`` (ShardedIVFFlat committed to the
+    # mesh), None on single-device services — rebuilt only when the
+    # index object or the mesh changes, NOT on delta appends
+    sharded: object = None
 
 
 def _labeled(kind: str, name: str, help: str, service: str, **extra):
@@ -164,6 +169,9 @@ class ANNService(Service):
                  degrade_queue_frac: Optional[float] = None,
                  slot_multiple: int = 64,
                  select_impl: Optional[str] = None,
+                 mesh=None, axis: Optional[str] = None,
+                 merge: Optional[str] = None,
+                 group_size: Optional[int] = None,
                  name: Optional[str] = None, **opts):
         kinds = (_ann.IVFFlatIndex, _ann.IVFPQIndex, _ann.IVFSQIndex)
         expects(isinstance(index, kinds),
@@ -182,6 +190,31 @@ class ANNService(Service):
         # reaches the trace as a Python value and always takes effect);
         # "approx" is membership-exact and markedly faster at large k
         self._select_impl = select_impl
+
+        # slot-sharded SPMD dispatch (docs/SERVING.md "Sharded
+        # serving"): the IVF slot stores row-shard over a mesh axis,
+        # every batch runs one per-shard probe-scan + on-device top-k
+        # merge — the delta segment stays replicated (it is small by
+        # construction) and merges after the sharded program
+        self._sharded_cache = None       # ShardedIVFFlat for _sharded_for
+        self._sharded_for = None         # the index object it mirrors
+        self._group_size = group_size
+        self.merge = None
+        if mesh is not None or axis is not None:
+            expects(isinstance(index, _ann.IVFFlatIndex),
+                    "ANNService: sharded serving requires an "
+                    "IVFFlatIndex (PQ/SQ slot stores hold codes — no "
+                    "sharded scan; serve them single-device)")
+            # refine_ratio is a PQ-only knob and IVF-Flat ignores it on
+            # BOTH arms — reject the combination rather than let it
+            # look active in a sharded constructor
+            expects(refine_ratio is None,
+                    "ANNService: refine_ratio is PQ-only; sharded "
+                    "serving is IVF-Flat-only — drop it")
+            from raft_tpu.serve.service import _resolve_shard_spec
+
+            self.mesh, self.axis, self.merge = _resolve_shard_spec(
+                "ANNService", mesh, axis, merge)
 
         if nprobe is None:
             nprobe = _knob_int("serve_ann_nprobe")
@@ -257,27 +290,63 @@ class ANNService(Service):
             # donation routes the padded buffer into the last consuming
             # program's executable twin; self.donate is resolved by
             # Service.__init__ before any batch can run
-            return _ann.approx_knn_search(
-                st.index, padded, self.k, nprobe=nprobe_now,
-                refine_ratio=self._refine_ratio, delta=delta,
-                donate_queries=self.donate,
-                select_impl=self._select_impl)
+            return self._snapshot_search(st, padded, nprobe_now,
+                                         delta, self.donate)
 
         super().__init__(
             name, execute, dim=dim, dtype=dtype,
             maintenance=self._maintenance_tick, **opts)
+        if self.axis is not None:
+            _labeled("gauge", "raft_tpu_serve_shard_devices",
+                     "devices the service's sharded index spans "
+                     "(0/absent = single-device)", self.name).set(
+                         int(self.mesh.shape[self.axis]))
 
     # ------------------------------------------------------------------ #
     # snapshot plumbing
     # ------------------------------------------------------------------ #
+    def _snapshot_search(self, st: "_AnnState", q, nprobe, delta,
+                         donate):
+        """ONE search entry for dispatch / warmup / calibrate: the
+        slot-sharded SPMD program when the snapshot carries a sharded
+        mirror, the single-device quantizer search otherwise — so every
+        consumer measures/warms exactly what dispatch runs."""
+        if st.sharded is not None:
+            from raft_tpu.spatial.mnmg_knn import mnmg_ivf_flat_search
+
+            return mnmg_ivf_flat_search(
+                st.sharded, q, self.k, nprobe=nprobe,
+                select_impl=self._select_impl, merge=self.merge,
+                group_size=self._group_size, donate_queries=donate,
+                delta=delta)
+        return _ann.approx_knn_search(
+            st.index, q, self.k, nprobe=nprobe,
+            refine_ratio=self._refine_ratio, delta=delta,
+            donate_queries=donate, select_impl=self._select_impl)
+
     def _publish_state_locked(self) -> None:
         """Rebuild the immutable serving snapshot from the host mirror
-        (callers hold ``_delta_lock``, or are in ``__init__``)."""
+        (callers hold ``_delta_lock``, or are in ``__init__``).  The
+        slot-sharded mirror is cached by index identity: a delta append
+        republished here must NOT re-shard the whole index — only a
+        compaction swap or a re-partition does."""
+        sharded = None
+        if self.axis is not None:
+            if (self._sharded_cache is None
+                    or self._sharded_for is not self._index):
+                from raft_tpu.spatial.mnmg_knn import \
+                    shard_ivf_flat_index
+
+                self._sharded_cache = shard_ivf_flat_index(
+                    self._index, self.mesh, self.axis)
+                self._sharded_for = self._index
+            sharded = self._sharded_cache
         self._ann_state = _AnnState(
             self._index,
             jnp.asarray(self._delta_vecs_np),
             jnp.asarray(self._delta_ids_np),
-            self._delta_count)
+            self._delta_count,
+            sharded)
         _labeled("gauge", "raft_tpu_serve_ann_delta_rows",
                  "rows in the append-only delta segment",
                  self.name).set(self._delta_count)
@@ -368,14 +437,43 @@ class ANNService(Service):
                      self.name).set(0)
 
     # ------------------------------------------------------------------ #
+    def repartition(self, mesh=None) -> bool:
+        """Re-partition the slot shards over ``mesh`` (default: the
+        owning session's current mesh) — the shard-loss lever: the
+        lost shard's slots redistribute exactly across the surviving
+        sub-mesh (the full index object is the re-shard source, so
+        nothing is lost), and the delta segment re-materializes with
+        them.  Call ``warmup()`` after.  True when the mesh changed."""
+        expects(self.axis is not None,
+                "%s.repartition: service is not sharded", self.name)
+        mesh = self._recovery_mesh() if mesh is None else mesh
+        expects(self.axis in mesh.axis_names,
+                "%s.repartition: replacement mesh lacks axis %r",
+                self.name, self.axis)
+        changed = mesh is not self.mesh
+        if changed:
+            self._drop_stale_group_size(mesh)
+        with self._delta_lock:
+            self.mesh = mesh
+            self._sharded_cache = None       # force the re-shard
+            self._publish_state_locked()     # THE atomic swap
+        if changed:
+            self._record_repartition(mesh)
+        return changed
+
     def post_recover(self) -> None:
         """Carry the serving snapshot across a mesh rebuild
         (:class:`~raft_tpu.serve.resilience.RecoveryManager` step 4):
         re-materialize the device-resident delta segment from the host
-        mirror and re-publish the immutable ``(index, delta)`` snapshot
-        — every row inserted before the failure is still queryable.
-        The index's own arrays are device-committed by the next search
-        the rebuilt executables run (``warmup()`` follows this hook)."""
+        mirror, re-partition the slot shards onto the rebuilt session
+        mesh (sharded services), and re-publish the immutable
+        ``(index, delta)`` snapshot — every row inserted before the
+        failure is still queryable.  The index's own arrays are
+        device-committed by the next search the rebuilt executables
+        run (``warmup()`` follows this hook)."""
+        if self.axis is not None:
+            self.repartition()   # republishes the snapshot
+            return
         with self._delta_lock:
             self._publish_state_locked()
 
@@ -394,20 +492,13 @@ class ANNService(Service):
         for rung in self.policy.rungs:
             for cell in self._nprobe_ladder:
                 # fresh zeros per call: the donating arms consume them
-                out = _ann.approx_knn_search(
-                    st.index, jnp.zeros((rung, self.dim), self.dtype),
-                    self.k, nprobe=cell,
-                    refine_ratio=self._refine_ratio,
-                    donate_queries=self.donate,
-                    select_impl=self._select_impl)
+                out = self._snapshot_search(
+                    st, jnp.zeros((rung, self.dim), self.dtype),
+                    cell, None, self.donate)
                 jax.block_until_ready(out)
-                out = _ann.approx_knn_search(
-                    st.index, jnp.zeros((rung, self.dim), self.dtype),
-                    self.k, nprobe=cell,
-                    refine_ratio=self._refine_ratio,
-                    delta=(blank_vecs, blank_ids),
-                    donate_queries=self.donate,
-                    select_impl=self._select_impl)
+                out = self._snapshot_search(
+                    st, jnp.zeros((rung, self.dim), self.dtype),
+                    cell, (blank_vecs, blank_ids), self.donate)
                 jax.block_until_ready(out)
         self._warmed = self.policy.rungs
         return self
@@ -582,10 +673,7 @@ class ANNService(Service):
         chosen = None
         for cell in self._nprobe_ladder:
             t0 = self._clock()
-            out = _ann.approx_knn_search(
-                st.index, q, self.k, nprobe=cell,
-                refine_ratio=self._refine_ratio, delta=delta,
-                select_impl=self._select_impl)
+            out = self._snapshot_search(st, q, cell, delta, False)
             jax.block_until_ready(out)
             dt = self._clock() - t0
             got = np.asarray(out[1])

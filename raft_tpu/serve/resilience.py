@@ -420,9 +420,12 @@ class RecoveryManager:
        communicator on the survivors, re-injected on every handle.
     4. **re-publish + warmup** — per service: ``post_recover()``
        (ANNService re-materializes its immutable ``(index, delta)``
-       snapshot — inserted rows survive the failure), then
+       snapshot — inserted rows survive the failure; sharded services
+       additionally **re-partition** the lost shard's rows/slots
+       across the surviving sub-mesh via ``repartition()``, exactly —
+       the pinned full index is the re-shard source), then
        ``warmup()`` rebuilds every bucketed executable (donating twins
-       included) on the new mesh.
+       and per-rung sharded SPMD programs included) on the new mesh.
     5. **re-admit** — restart a dead worker thread
        (:meth:`ServeWorker.restart`), resume batch formation, reset the
        breaker.  The queued backlog (including the riders re-enqueued

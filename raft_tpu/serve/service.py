@@ -6,7 +6,13 @@ k, the metric) and serves the light, shape-varying half (query rows)
 through the micro-batching engine:
 
 - :class:`KNNService`  — ``submit((n_i, d) queries) -> (dists, ids)``
-  over :func:`raft_tpu.spatial.brute_force_knn`;
+  over :func:`raft_tpu.spatial.brute_force_knn` — or, with ``axis=``,
+  over the mesh-sharded SPMD search
+  :func:`raft_tpu.spatial.mnmg_knn` (docs/SERVING.md "Sharded
+  serving": the index is row-sharded over a mesh axis ONCE at
+  construction, every padded batch runs one pjit'd per-shard search +
+  on-device top-k merge, and QPS scales with the mesh instead of one
+  device's FLOPs);
 - :class:`PairwiseService` — ``submit((n_i, d) x) -> (n_i, n_y)`` over
   :func:`raft_tpu.distance.pairwise_distance`.
 
@@ -64,6 +70,8 @@ from raft_tpu.core.error import (
 from raft_tpu.core.profiler import profiled_jit
 from raft_tpu.distance.distance_type import DistanceType
 from raft_tpu.distance.pairwise import pairwise_distance
+from typing import NamedTuple
+
 from raft_tpu.serve.batcher import MicroBatcher, ServeFuture
 from raft_tpu.serve.bucketing import BucketPolicy, resolve_rungs
 from raft_tpu.serve.resilience import BreakerState, CircuitBreaker
@@ -158,6 +166,15 @@ class Service:
         Spawn the worker thread now (False = threadless: tests drive
         :attr:`worker` ``.run_once()`` under an injected ``clock``).
     """
+
+    # sharded-serving contract surface (docs/SERVING.md "Sharded
+    # serving"): non-None on services dispatching into a mesh-sharded
+    # SPMD program.  Session ``health_check`` reads these to validate a
+    # service's mesh assumptions against the (possibly rebuilt) session
+    # mesh, and ``RecoveryManager`` triggers ``post_recover``
+    # re-partitioning through them.
+    axis: Optional[str] = None
+    mesh = None
 
     def __init__(self, name: str, execute: Callable, dim: int,
                  dtype=jnp.float32, *,
@@ -284,7 +301,40 @@ class Service:
         after a communicator/mesh rebuild, before ``warmup()``.  The
         base services pin only immutable operands — nothing to redo;
         :class:`~raft_tpu.serve.ann_service.ANNService` re-publishes
-        its ``(index, delta)`` snapshot here."""
+        its ``(index, delta)`` snapshot here, and the sharded services
+        re-partition onto the rebuilt mesh (``repartition()``)."""
+
+    # -- shared sharded-recovery plumbing (one copy for KNN and ANN;
+    #    docs/SERVING.md "Sharded serving") -------------------------- #
+    def _recovery_mesh(self):
+        """The mesh ``repartition()`` should re-cut onto when none is
+        given: the owning session's rebuilt mesh when it still carries
+        our axis (``Comms.serve`` binds ``_session``), else the
+        current one (standalone services recover in place)."""
+        session = getattr(self, "_session", None)
+        comms = getattr(session, "comms", None)
+        if comms is not None and self.axis in comms.mesh.axis_names:
+            return comms.mesh
+        return self.mesh
+
+    def _drop_stale_group_size(self, mesh) -> None:
+        """A constructor-pinned hierarchical ``group_size`` that does
+        not divide the survivor mesh's axis size must not brick
+        recovery (every post-repartition dispatch would raise): drop
+        the pin and let ``resolve_group_size`` re-derive the group
+        from placement per mesh."""
+        g = getattr(self, "_group_size", None)
+        if g and int(mesh.shape[self.axis]) % int(g):
+            self._group_size = None
+
+    def _record_repartition(self, mesh) -> None:
+        _counter("raft_tpu_serve_repartitions_total",
+                 "sharded-index re-partitions (shard-loss recovery)",
+                 self.name).inc()
+        _gauge("raft_tpu_serve_shard_devices",
+               "devices the service's sharded index spans (0/absent = "
+               "single-device)", self.name).set(
+                   int(mesh.shape[self.axis]))
 
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> None:
@@ -464,22 +514,93 @@ class Service:
         }
         if self.breaker is not None:
             out["breaker"] = self.breaker.describe()
+        if self.axis is not None:
+            out.update({
+                "sharded": True,
+                "axis": self.axis,
+                "shard_devices": int(self.mesh.shape[self.axis]),
+                "merge": getattr(self, "merge", None),
+            })
         return out
+
+
+def _resolve_shard_spec(cls_name: str, mesh, axis, merge):
+    """Shared sharded-constructor resolution (KNNService and
+    ANNService): default the mesh, default the axis to the mesh's
+    first, validate, resolve the merge-topology knob.  One copy of the
+    dance — the two services must never skew on it."""
+    from raft_tpu.spatial.mnmg_knn import resolve_merge
+
+    if mesh is None:
+        from raft_tpu.comms.host_comms import default_mesh
+
+        mesh = default_mesh()
+    if axis is None:
+        axis = mesh.axis_names[0]
+    expects(axis in mesh.axis_names,
+            "%s: axis %r not in mesh axes %r", cls_name, axis,
+            tuple(mesh.axis_names))
+    return mesh, axis, resolve_merge(merge)
+
+
+class _ShardState(NamedTuple):
+    """One immutable sharded-dispatch snapshot: the committed index
+    shards and the mesh geometry they were cut for travel TOGETHER —
+    a batch reads exactly one of these, so a concurrent
+    :meth:`KNNService.repartition` can never pair new shards with the
+    old mesh mid-dispatch (the ANNService ``_AnnState`` argument,
+    applied to the kNN shard)."""
+
+    index: object       # (rows*size, d) committed NamedSharding array
+    n_rows: int         # real rows (the mask bound)
+    mesh: object
+    axis: str
 
 
 class KNNService(Service):
     """Micro-batched :func:`brute_force_knn` over one pinned index
-    partition.
+    partition — or, with ``axis=``, one pjit'd SPMD search over a
+    mesh-sharded index (docs/SERVING.md "Sharded serving").
 
     ``submit((n_i, d))`` futures resolve to ``(distances, indices)`` of
-    shape ``(n_i, k)`` — bit-identical to the unbatched
+    shape ``(n_i, k)``.  Single-device: bit-identical to the unbatched
     ``brute_force_knn(index, queries, k)`` call (pad rows are zeros and
-    every row's result depends only on its own query row).
+    every row's result depends only on its own query row).  Sharded:
+    bit-identical to :func:`~raft_tpu.spatial.mnmg_knn.mnmg_knn` at
+    the same topology, and index-identical to the single-device call
+    up to distance-tie order (the merge re-selects across shard-local
+    selections; on exact distance ties the survivor may differ).
+
+    Sharded parameters
+    ------------------
+    mesh / axis:
+        Shard the index rows over ``axis`` of ``mesh``
+        (:func:`~raft_tpu.spatial.mnmg_knn.shard_knn_index` commits
+        the shards ONCE at construction; batches reuse them with no
+        per-call resharding).  ``axis`` alone resolves the default
+        mesh; session-registered services
+        (``Comms.serve(kind="knn", axis=...)``) default to the
+        session mesh.
+    merge:
+        Cross-shard top-k merge topology (``allgather`` | ``ring`` |
+        ``hierarchical``); None resolves the ``mnmg_merge`` knob.
+    group_size:
+        Hierarchical host-group size; None auto-resolves from device
+        placement per mesh.
+
+    On shard loss, :meth:`repartition` (driven by ``post_recover``
+    during the :class:`~raft_tpu.serve.resilience.RecoveryManager`
+    sequence) re-partitions the full index over the surviving
+    sub-mesh and the follow-up ``warmup()`` rebuilds every per-rung
+    sharded executable.
     """
 
     def __init__(self, index, k: int,
                  metric: DistanceType = DistanceType.L2Expanded,
                  tile_n: int = 8192, precision: str = "highest",
+                 mesh=None, axis: Optional[str] = None,
+                 merge: Optional[str] = None,
+                 group_size: Optional[int] = None,
                  name: Optional[str] = None, **opts):
         index = jnp.asarray(index)
         expects(index.ndim == 2, "KNNService: (n, d) index required")
@@ -489,8 +610,32 @@ class KNNService(Service):
         self.index = index
         self.k = int(k)
         self.metric = metric
+        self._tile_n = int(tile_n)
+        self._precision = precision
+        self._group_size = group_size
+        self._spmd: Optional[_ShardState] = None
+        if mesh is not None or axis is not None:
+            mesh, axis, self.merge = _resolve_shard_spec(
+                "KNNService", mesh, axis, merge)
+            self._shard_to(mesh, axis)
 
         def execute(padded):
+            spmd = self._spmd          # ONE snapshot per batch
+            if spmd is not None:
+                # ONE SPMD program per bucket rung: per-shard search,
+                # on-device id translation, on-device top-k merge —
+                # 0 host-staged bytes (docs/ZERO_COPY.md), donation
+                # routed into the sharded donating twin
+                from raft_tpu.spatial.mnmg_knn import mnmg_knn
+
+                return mnmg_knn(spmd.index, padded, self.k,
+                                metric=self.metric, mesh=spmd.mesh,
+                                axis=spmd.axis, n_rows=spmd.n_rows,
+                                tile_n=self._tile_n,
+                                precision=self._precision,
+                                merge=self.merge,
+                                group_size=self._group_size,
+                                donate_queries=self.donate)
             # eager on purpose: bit-identical to the unbatched call
             # (module doc); the scan inside is the per-bucket cached
             # program.  donate_queries routes the padded buffer into
@@ -505,6 +650,63 @@ class KNNService(Service):
         super().__init__(
             name or "knn%d" % next(_service_seq), execute,
             dim=index.shape[1], dtype=index.dtype, **opts)
+        if self.axis is not None:   # gauge deferred until named
+            _gauge("raft_tpu_serve_shard_devices",
+                   "devices the service's sharded index spans "
+                   "(0/absent = single-device)", self.name).set(
+                       int(self.mesh.shape[self.axis]))
+
+    # -- sharded serving (docs/SERVING.md "Sharded serving") ----------- #
+    @property
+    def mesh(self):
+        return self._spmd.mesh if self._spmd is not None else None
+
+    @property
+    def axis(self) -> Optional[str]:
+        return self._spmd.axis if self._spmd is not None else None
+
+    def _shard_to(self, mesh, axis: str) -> None:
+        """(Re-)partition the pinned index rows over ``axis`` and
+        commit the shards to the mesh.  The swap is ONE reference
+        assignment of an immutable :class:`_ShardState` — a batch
+        dispatching concurrently reads either the old or the new
+        snapshot whole, never new shards with the old mesh."""
+        from raft_tpu.spatial.mnmg_knn import shard_knn_index
+
+        index_p, n_rows = shard_knn_index(self.index, mesh, axis)
+        self._spmd = _ShardState(index_p, n_rows, mesh, axis)
+        if "name" in self.__dict__:   # first call precedes naming
+            _gauge("raft_tpu_serve_shard_devices",
+                   "devices the service's sharded index spans "
+                   "(0/absent = single-device)", self.name).set(
+                       int(mesh.shape[axis]))
+
+    def repartition(self, mesh=None) -> bool:
+        """Re-partition the index rows over ``mesh`` (default: the
+        owning session's current mesh) — the shard-loss recovery lever:
+        the lost shard's rows redistribute across the surviving
+        sub-mesh, exactly (the full index is re-sharded from the
+        pinned source array).  Call ``warmup()`` after — the sharded
+        executables are mesh-specific.  True when the mesh changed."""
+        expects(self.axis is not None,
+                "%s.repartition: service is not sharded", self.name)
+        mesh = self._recovery_mesh() if mesh is None else mesh
+        expects(self.axis in mesh.axis_names,
+                "%s.repartition: replacement mesh lacks axis %r",
+                self.name, self.axis)
+        if mesh is self.mesh:
+            return False
+        self._drop_stale_group_size(mesh)
+        self._shard_to(mesh, self.axis)
+        self._record_repartition(mesh)
+        return True
+
+    def post_recover(self) -> None:
+        """Re-partition onto the rebuilt session mesh after a
+        communicator recovery (RecoveryManager step 4; the follow-up
+        ``warmup()`` rebuilds the sharded executables)."""
+        if self.axis is not None:
+            self.repartition()
 
 
 class PairwiseService(Service):

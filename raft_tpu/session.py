@@ -330,18 +330,39 @@ class Comms:
         ok = all(tests.values()) and all(devices.values())
         out = {"ok": ok, "tests": tests, "devices": devices}
         if self._services:
-            services = {name: svc.stats()
-                        for name, svc in self._services.items()}
+            mesh_devices = set(
+                int(d.id) for d in self.comms.mesh.devices.ravel())
+            services = {}
+            for name, svc in self._services.items():
+                s = svc.stats()
+                if getattr(svc, "axis", None) is not None:
+                    # validate the sharded service's mesh assumptions
+                    # against the CURRENT session mesh: after recover()
+                    # rebuilt the communicator on a sub-mesh, a service
+                    # still sharded over the old mesh (axis gone, or
+                    # spanning devices the session no longer has) would
+                    # only fail at its next dispatch — flag it here so
+                    # the repair lever (post_recover re-partitioning)
+                    # runs before traffic does
+                    s["mesh_ok"] = (
+                        svc.axis in self.comms.mesh.axis_names
+                        and set(int(d.id) for d in
+                                svc.mesh.devices.ravel())
+                        <= mesh_devices)
+                services[name] = s
             out["services"] = services
 
             # fail health only for a service that SHOULD be serving: a
-            # started worker that died, or a breaker tripped open,
-            # while the service is still open (threadless test-mode
-            # services and closed services pass)
+            # started worker that died, a breaker tripped open, or a
+            # sharded service whose mesh no longer matches the
+            # session's, while the service is still open (threadless
+            # test-mode services and closed services pass)
             def _service_ok(s):
                 if not s["open"]:
                     return True
                 if s["worker_started"] and not s["worker_alive"]:
+                    return False
+                if s.get("mesh_ok") is False:
                     return False
                 br = s.get("breaker")
                 return not (br and br.get("state") == "open")
@@ -473,7 +494,17 @@ class Comms:
         expects(name is None or name not in self._services,
                 "serve: a service named %r is already registered", name)
         kwargs.setdefault("retry_policy", self.retry_policy)
+        if (kwargs.get("axis") is not None
+                and kwargs.get("mesh") is None):
+            # sharded service on the session: shard over THE session
+            # mesh (docs/SERVING.md "Sharded serving") so recover() /
+            # post_recover re-partitioning and health_check mesh
+            # validation all talk about the same mesh
+            kwargs["mesh"] = self.comms.mesh
         svc = kinds[kind](name=name, **kwargs)
+        # bind the owning session: sharded services re-partition onto
+        # the session's (possibly rebuilt) mesh in post_recover
+        svc._session = self
         if svc.name in self._services:
             # auto-generated name collided: stop the just-started
             # worker before raising or it leaks, unregistered and

@@ -1,4 +1,4 @@
-"""Multi-node multi-device brute-force kNN over a mesh axis.
+"""Multi-node multi-device kNN over a mesh axis — the sharded SPMD search.
 
 Reference: the MNMG mode of ``brute_force_knn`` — each rank searches its
 row partition of the index locally, then results are merged through the
@@ -17,17 +17,47 @@ a ``jax.sharding.Mesh`` axis:
   reference's ``handle.set_subcomm`` (handle.hpp:237);
 - each shard runs the local fused distance + top-k;
 - local ids are translated to global ids with the shard offset
-  (reference id_ranges, knn_brute_force_faiss.cuh:241-255);
-- candidates ride ICI via ``all_gather`` along the axis and are
-  re-selected to the global top-k (the ``knn_merge_parts`` heap-merge
-  becomes one wide re-selection) — so the merge compiles to a single
-  XLA collective instead of eager NCCL calls;
-- ``merge="ring"`` instead streams candidate blocks around the axis
-  with ``ppermute`` and keeps a running top-k: peak merge memory is
-  (nq, 2k) regardless of axis size (vs (nq, size*k) for the allgather),
-  the same total ICI traffic — the distance-matrix instance of the ring
-  pattern (SURVEY §5), and the closest TPU shape to the reference's
-  streaming heap-merge (knn_merge_parts, knn_brute_force_faiss.cuh:55).
+  (reference id_ranges, knn_brute_force_faiss.cuh:241-255) ON device;
+- the cross-shard merge is a selectable **topology**
+  (:func:`_merge_topk`):
+
+  * ``"allgather"`` (default): candidates ride ICI via ``all_gather``
+    and are re-selected to the global top-k in one wide selection (the
+    ``knn_merge_parts`` heap-merge as a single XLA collective);
+  * ``"ring"``: ``ppermute`` streams candidate blocks around the axis
+    with a running top-k — (nq, 2k) peak merge memory regardless of
+    axis size, same total ICI traffic (the distance-matrix instance of
+    the ring pattern, SURVEY §5);
+  * ``"hierarchical"``: allgather *within* a host group, ring *across*
+    groups, with a distance-sorted k-way re-selection at each level —
+    HiCCL's hierarchical decomposition (PAPERS.md) applied to top-k
+    merging instead of raw collectives.  Group size resolves from
+    device placement (:func:`raft_tpu.comms.host_comms.
+    axis_host_group_size`: contiguous same-process runs = a host) and
+    falls back to the divisor nearest sqrt(axis size) on single-host
+    meshes.
+
+Every SPMD program here compiles through
+:func:`~raft_tpu.core.profiler.profiled_jit` — never a bare
+``jax.jit`` (``ci/style_check.py`` enforces it) — so the serving
+layer's warmup proof and loadgen's ``post_warmup_compiles=0`` check
+see sharded compiles like every other served primitive, and each
+program has a donating executable twin that consumes the (replicated)
+query batch, honoring the zero-copy serve contract
+(docs/ZERO_COPY.md).
+
+Besides the brute-force search this module owns the *serving-facing*
+sharded machinery (docs/SERVING.md "Sharded serving"):
+
+- :func:`shard_knn_index` — commit a row-sharded padded index to the
+  mesh once, so every serve batch reuses resident shards instead of
+  re-sharding per call;
+- :func:`shard_ivf_flat_index` / :func:`mnmg_ivf_flat_search` — the
+  slot-sharded IVF-Flat analog: slots (inverted lists) are row-sharded
+  over the axis, each shard probes the replicated centroids and scans
+  only the probed slots it owns (``slot_ids`` already carry global row
+  ids, so no translation step is even needed), and the same merge
+  topologies produce the global top-k.
 
 The communicator is resolved from (in order) an explicit ``comms``, the
 ``handle``'s injected comms (reference ``handle.get_comms()`` idiom),
@@ -36,15 +66,19 @@ an explicit ``mesh``/``axis`` pair, or the handle's mesh.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.host_comms import shard_map
+from raft_tpu import config
+from raft_tpu.comms.host_comms import axis_host_group_size, shard_map
 from raft_tpu.core.error import expects
+from raft_tpu.core.profiler import profiled_jit
 from raft_tpu.core.utils import ceildiv
 from raft_tpu.mr.buffer import zeros_cached
 from raft_tpu.distance.distance_type import DistanceType
@@ -52,6 +86,8 @@ from raft_tpu.spatial.knn import _IP_FAMILY, _search_one_partition
 from raft_tpu.spatial.select_k import select_k
 
 D = DistanceType
+
+MERGE_TOPOLOGIES = ("allgather", "ring", "hierarchical")
 
 
 def _resolve_comms(handle, comms, mesh, axis):
@@ -81,6 +117,207 @@ def _resolve_comms(handle, comms, mesh, axis):
     return m, m.axis_names[0]
 
 
+def resolve_merge(merge: Optional[str]) -> str:
+    """Resolve the merge-topology knob: explicit argument first, then
+    the ``mnmg_merge`` config knob (env ``RAFT_TPU_MNMG_MERGE``)."""
+    if merge is None:
+        merge = config.get("mnmg_merge")
+    expects(merge in MERGE_TOPOLOGIES,
+            "mnmg: unknown merge topology %r (have: %s)", merge,
+            ", ".join(MERGE_TOPOLOGIES))
+    return merge
+
+
+def resolve_group_size(mesh, axis: str,
+                       group_size: Optional[int] = None) -> int:
+    """Host-group size for the hierarchical merge.
+
+    Explicit ``group_size`` must divide the axis size.  None resolves
+    from device placement (:func:`axis_host_group_size` — devices per
+    host when hosts are contiguous along the axis) and falls back to
+    the divisor of the axis size nearest its square root, the balanced
+    two-level decomposition (equal fan-in per level) when placement
+    carries no host structure — e.g. the single-process virtual mesh.
+    """
+    size = int(mesh.shape[axis])
+    if group_size is not None:
+        g = int(group_size)
+        expects(1 <= g <= size and size % g == 0,
+                "mnmg: group_size=%d must divide the axis size %d",
+                g, size)
+        return g
+    g = axis_host_group_size(mesh, axis)
+    if g is not None and size % g == 0:
+        return g
+    root = size ** 0.5
+    divisors = [d for d in range(1, size + 1) if size % d == 0]
+    return min(divisors, key=lambda d: (abs(d - root), d))
+
+
+# --------------------------------------------------------------------- #
+# the cross-shard top-k merge (shared by the brute-force and IVF paths)
+# --------------------------------------------------------------------- #
+def _ring_steps(best_d, best_i, blk_d, blk_i, k, axis, perm, steps,
+                select_min, worst):
+    """Stream candidate blocks along ``perm`` for ``steps`` hops with a
+    running top-k re-selection (the reference's streaming heap-merge,
+    knn_merge_parts, knn_brute_force_faiss.cuh:55, as ppermute + one
+    selection per hop)."""
+    # tiny shards: pad the running block to the carry width
+    best_d, best_i = _pad_to_k(best_d, best_i, k, worst)
+    if steps <= 0:
+        return best_d, best_i
+
+    def body(_, carry):
+        bd, bi, rd, ri = carry
+        rd = lax.ppermute(rd, axis, perm)
+        ri = lax.ppermute(ri, axis, perm)
+        cd = jnp.concatenate([bd, rd], axis=1)
+        ci = jnp.concatenate([bi, ri], axis=1)
+        nd, ni = select_k(cd, k, select_min=select_min, values=ci)
+        return nd, ni, rd, ri
+
+    best_d, best_i, _, _ = lax.fori_loop(
+        0, steps, body, (best_d, best_i, blk_d, blk_i))
+    return best_d, best_i
+
+
+def _pad_to_k(d, i, k, worst):
+    """Widen a candidate block to k columns with (worst, -1) fillers —
+    a shard set whose total candidate width is below k (tiny probed
+    lists) must still produce (nq, k) outputs, like the single-device
+    running select's inf-initialized carry."""
+    if d.shape[1] >= k:
+        return d, i
+    pad = k - d.shape[1]
+    return (jnp.pad(d, ((0, 0), (0, pad)), constant_values=worst),
+            jnp.pad(i, ((0, 0), (0, pad)), constant_values=-1))
+
+
+def _merge_topk(d_loc, gid, k, axis, size, select_min, worst, merge,
+                group_size):
+    """Merge each shard's masked local candidates ``(d_loc, gid)`` into
+    the replicated global top-k, by the selected topology (module doc).
+    Runs INSIDE the shard_map body; invalid candidates carry ``worst``
+    distance and id -1."""
+    if merge == "allgather":
+        # one wide collective + one re-selection; the gathered width
+        # can undershoot k when every shard's candidate list is narrow
+        # (small probed lists) — select what exists, pad the rest
+        all_d = lax.all_gather(d_loc, axis, axis=1, tiled=True)
+        all_i = lax.all_gather(gid, axis, axis=1, tiled=True)
+        kk = min(k, all_d.shape[1])
+        out_d, out_i = select_k(all_d, kk, select_min=select_min,
+                                values=all_i)
+        return _pad_to_k(out_d, out_i, k, worst)
+    # both streaming topologies narrow the local block first: every
+    # global top-k member on this shard survives its local top-k
+    kb = min(k, d_loc.shape[1])
+    blk_d, blk_i = select_k(d_loc, kb, select_min=select_min, values=gid)
+    if merge == "ring":
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        return _ring_steps(blk_d, blk_i, blk_d, blk_i, k, axis, perm,
+                           size - 1, select_min, worst)
+    # hierarchical: allgather within each host group, re-select, then
+    # ring the group blocks across groups (HiCCL's decomposition on
+    # top-k candidates) — each level ends in a distance-sorted k-way
+    # re-selection (select_k over the concatenated candidate lists)
+    g = group_size
+    n_groups = size // g
+    if g > 1:
+        groups = [[b * g + i for i in range(g)]
+                  for b in range(n_groups)]
+        grp_d = lax.all_gather(blk_d, axis, axis=1, tiled=True,
+                               axis_index_groups=groups)
+        grp_i = lax.all_gather(blk_i, axis, axis=1, tiled=True,
+                               axis_index_groups=groups)
+        kg = min(k, grp_d.shape[1])
+        blk_d, blk_i = select_k(grp_d, kg, select_min=select_min,
+                                values=grp_i)
+    if n_groups == 1:
+        return _ring_steps(blk_d, blk_i, blk_d, blk_i, k, axis, [],
+                           0, select_min, worst)
+    # ring across groups: every device forwards its group's block to
+    # the same in-group rank of the next group, so all g members of a
+    # group run the inter-group merge in lockstep (replicated within
+    # the group — no leader bottleneck)
+    perm = [(i, (i + g) % size) for i in range(size)]
+    return _ring_steps(blk_d, blk_i, blk_d, blk_i, k, axis, perm,
+                       n_groups - 1, select_min, worst)
+
+
+# --------------------------------------------------------------------- #
+# the brute-force SPMD program (profiled_jit + donating twin)
+# --------------------------------------------------------------------- #
+def _mnmg_search_impl(index_p, queries, mesh, axis, query_axis, k,
+                      k_local, n, rows, metric, metric_arg, tile_n,
+                      precision, merge, group_size):
+    size = mesh.shape[axis]
+    select_min = metric not in _IP_FAMILY
+    worst = jnp.inf if select_min else -jnp.inf
+
+    def shard_fn(ix, q):
+        # local partition search (reference per-partition stream search)
+        d_loc, i_loc = _search_one_partition(ix, q, k_local, metric,
+                                             metric_arg, tile_n,
+                                             precision)
+        # translate to global ids; mask this shard's padding rows
+        base = lax.axis_index(axis) * rows
+        gid = (i_loc + base).astype(jnp.int32)
+        invalid = gid >= n
+        d_loc = jnp.where(invalid, worst, d_loc)
+        gid = jnp.where(invalid, -1, gid)
+        return _merge_topk(d_loc, gid, k, axis, size, select_min,
+                           worst, merge, group_size)
+
+    q_spec = (P(query_axis, None) if query_axis is not None
+              else P(None, None))
+    fn = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis, None), q_spec),
+        out_specs=(q_spec, q_spec),
+        check_rep=False)
+    return fn(index_p, queries)
+
+
+_MNMG_STATICS = ("mesh", "axis", "query_axis", "k", "k_local", "n",
+                 "rows", "metric", "metric_arg", "tile_n", "precision",
+                 "merge", "group_size")
+_mnmg_search_jit = profiled_jit(
+    name="mnmg_knn_search",
+    static_argnames=_MNMG_STATICS)(_mnmg_search_impl)
+# donating twin (docs/ZERO_COPY.md): a separate wrapper, not a flag — a
+# donating and a non-donating executable must never share a cache slot.
+# The padded serve batch is the intended donor; donation of a
+# replicated input is best-effort recycling (XLA keeps a copy when the
+# output cannot alias), never a behavior change.
+_mnmg_search_jit_donated = profiled_jit(
+    name="mnmg_knn_search_donated", static_argnames=_MNMG_STATICS,
+    donate_argnames=("queries",))(_mnmg_search_impl)
+
+
+def shard_knn_index(index, mesh, axis: str):
+    """Commit a row-sharded padded index to the mesh ONCE.
+
+    Returns ``(index_p, n)``: the zero-padded ``(rows*size, d)`` array
+    committed with ``NamedSharding(mesh, P(axis, None))`` — every
+    subsequent :func:`mnmg_knn` / serve batch at this geometry reuses
+    the resident shards with no per-call resharding — and the real row
+    count ``n`` the program masks against.
+    """
+    index = jnp.asarray(index)
+    expects(index.ndim == 2, "shard_knn_index: (n, d) index required")
+    n, d = index.shape
+    size = int(mesh.shape[axis])
+    rows = ceildiv(n, size)
+    n_pad = rows * size
+    if n_pad > n:
+        index = jnp.concatenate(
+            [index, zeros_cached((n_pad - n, d), index.dtype)], axis=0)
+    sharding = NamedSharding(mesh, P(axis, None))
+    return jax.device_put(index, sharding), n
+
+
 def mnmg_knn(
     index: jnp.ndarray,
     queries: jnp.ndarray,
@@ -94,14 +331,19 @@ def mnmg_knn(
     query_axis: Optional[str] = None,
     tile_n: int = 8192,
     precision: str = "highest",
-    merge: str = "allgather",
+    merge: Optional[str] = None,
+    group_size: Optional[int] = None,
+    donate_queries: bool = False,
+    n_rows: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact kNN with the index row-sharded across a mesh axis.
 
     Parameters
     ----------
     index:
-        (n, d) global index rows (sharded over ``axis`` by the program).
+        (n, d) global index rows (sharded over ``axis`` by the
+        program), or a pre-committed padded array from
+        :func:`shard_knn_index` together with ``n_rows``.
     queries:
         (nq, d) queries, replicated (or sharded over ``query_axis``).
     k:
@@ -117,10 +359,20 @@ def mnmg_knn(
         MXU matmul precision for the local searches ("highest" default;
         "default" = single-pass bf16, see ``brute_force_knn``).
     merge:
-        "allgather" (default): one wide collective + one re-selection.
-        "ring": ppermute candidate blocks around the axis with a running
-        top-k — (nq, 2k) peak merge memory regardless of axis size
-        (module doc).  Identical results up to distance-tie order.
+        Cross-shard merge topology: ``"allgather"`` | ``"ring"`` |
+        ``"hierarchical"`` (module doc).  None resolves the
+        ``mnmg_merge`` config knob.  Identical results up to
+        distance-tie order.
+    group_size:
+        Hierarchical host-group size (must divide the axis size); None
+        auto-resolves (:func:`resolve_group_size`).
+    donate_queries:
+        Consume the queries buffer — routes into the donating
+        executable twin (docs/ZERO_COPY.md; the serve layer's padded
+        batch is the intended donor).
+    n_rows:
+        Real row count when ``index`` is already the padded sharded
+        array from :func:`shard_knn_index` (skips the per-call pad).
 
     Returns
     -------
@@ -132,10 +384,18 @@ def mnmg_knn(
     expects(index.ndim == 2 and queries.ndim == 2
             and index.shape[1] == queries.shape[1],
             "mnmg_knn: index/query dimensionality mismatch")
-    n, d = index.shape
+    size = int(mesh_.shape[axis_])
+    if n_rows is not None:
+        n = int(n_rows)
+        expects(index.shape[0] % size == 0,
+                "mnmg_knn: pre-sharded index rows %d not divisible by "
+                "axis size %d", index.shape[0], size)
+        index_p = index
+    else:
+        n = index.shape[0]
+        index_p, _ = shard_knn_index(index, mesh_, axis_)
     nq = queries.shape[0]
     expects(0 < k <= n, "mnmg_knn: k=%d out of range for n=%d", k, n)
-    size = mesh_.shape[axis_]
     if query_axis is not None:
         expects(query_axis in mesh_.axis_names,
                 "mnmg_knn: query_axis %s not in mesh", query_axis)
@@ -143,79 +403,235 @@ def mnmg_knn(
                 "mnmg_knn: nq=%d not divisible by query_axis size %d",
                 nq, mesh_.shape[query_axis])
 
-    rows = ceildiv(n, size)
+    rows = index_p.shape[0] // size
     n_pad = rows * size
-    if n_pad > n:
-        # pad tail from the shared zeros cache (docs/ZERO_COPY.md):
-        # repeated mnmg searches at a geometry re-pad the same (pad, d)
-        # tail every call, and jnp.pad would materialize a fresh device
-        # zeros block each time — the cached block makes the eager pad
-        # a concatenate against an existing device buffer
-        index_p = jnp.concatenate(
-            [index, zeros_cached((n_pad - n, d), index.dtype)], axis=0)
-    else:
-        index_p = index
-    select_min = metric not in _IP_FAMILY
-    worst = jnp.inf if select_min else -jnp.inf
     # widen the local k by the pad count: a zero pad row can *beat* real
     # rows under any metric (its L2 distance is just ||q||^2), so pads may
     # occupy local top-k slots — the widening guarantees >= k real
     # candidates survive the post-search mask
     k_local = min(k + (n_pad - n), rows)
+    merge = resolve_merge(merge)
+    group_size = (resolve_group_size(mesh_, axis_, group_size)
+                  if merge == "hierarchical" else 1)
 
-    expects(merge in ("allgather", "ring"),
-            "mnmg_knn: unknown merge %s", merge)
-
-    def shard_fn(ix, q):
-        # local partition search (reference per-partition stream search)
-        d_loc, i_loc = _search_one_partition(ix, q, k_local, metric,
-                                             metric_arg, tile_n, precision)
-        # translate to global ids; mask this shard's padding rows
-        base = lax.axis_index(axis_) * rows
-        gid = (i_loc + base).astype(jnp.int32)
-        invalid = gid >= n
-        d_loc = jnp.where(invalid, worst, d_loc)
-        gid = jnp.where(invalid, -1, gid)
-        if merge == "ring":
-            # narrow the masked local candidates to k (every global
-            # top-k member on this shard survives its local top-k), then
-            # stream blocks around the ring with a running re-selection
-            blk_d, blk_i = select_k(d_loc, min(k, k_local),
-                                    select_min=select_min, values=gid)
-            best_d, best_i = blk_d, blk_i
-            perm = [(i, (i + 1) % size) for i in range(size)]
-
-            def body(_, carry):
-                bd, bi, rd, ri = carry
-                rd = lax.ppermute(rd, axis_, perm)
-                ri = lax.ppermute(ri, axis_, perm)
-                cd = jnp.concatenate([bd, rd], axis=1)
-                ci = jnp.concatenate([bi, ri], axis=1)
-                nd, ni = select_k(cd, k, select_min=select_min, values=ci)
-                return nd, ni, rd, ri
-
-            if blk_d.shape[1] < k:  # tiny shards: pad the running block
-                pad = k - blk_d.shape[1]
-                best_d = jnp.pad(blk_d, ((0, 0), (0, pad)),
-                                 constant_values=worst)
-                best_i = jnp.pad(blk_i, ((0, 0), (0, pad)),
-                                 constant_values=-1)
-            best_d, best_i, _, _ = lax.fori_loop(
-                0, size - 1, body, (best_d, best_i, blk_d, blk_i))
-            return best_d, best_i
-        # merge across the axis: allgather candidates, one re-selection
-        all_d = lax.all_gather(d_loc, axis_, axis=1, tiled=True)
-        all_i = lax.all_gather(gid, axis_, axis=1, tiled=True)
-        return select_k(all_d, k, select_min=select_min, values=all_i)
-
-    q_spec = P(query_axis, None) if query_axis is not None else P(None, None)
-    fn = shard_map(
-        shard_fn, mesh=mesh_,
-        in_specs=(P(axis_, None), q_spec),
-        out_specs=(q_spec, q_spec),
-        check_rep=False)
-    dist, idx = jax.jit(fn)(index_p, queries)
+    fn = _mnmg_search_jit_donated if donate_queries else _mnmg_search_jit
+    dist, idx = fn(index_p, queries, mesh=mesh_, axis=axis_,
+                   query_axis=query_axis, k=k, k_local=k_local, n=n,
+                   rows=rows, metric=metric, metric_arg=metric_arg,
+                   tile_n=tile_n, precision=precision, merge=merge,
+                   group_size=group_size)
 
     if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
         dist = jnp.sqrt(jnp.maximum(dist, 0.0))
     return dist, idx
+
+
+# --------------------------------------------------------------------- #
+# slot-sharded IVF-Flat (the ANN serving shard, docs/SERVING.md)
+# --------------------------------------------------------------------- #
+class ShardedIVFFlat(NamedTuple):
+    """An IVF-Flat index with its slot stores row-sharded over a mesh
+    axis — the serving shard :class:`~raft_tpu.serve.ANNService` owns
+    when constructed with ``axis=``.
+
+    Centroids are replicated (every shard probes the same coarse
+    quantizer — identical probe selection on every device, no
+    collective needed); ``slot_vecs`` / ``slot_norms`` / ``slot_ids``
+    are sharded over the (padded) slot dimension, and
+    ``cent_slots_local`` maps each centroid's global slot list into
+    per-shard LOCAL slot ids (-1 = not owned by that shard), so a
+    shard scans exactly the probed slots it holds.  ``slot_ids``
+    already carry global row ids — the id-translation step of the
+    brute-force path falls away entirely.
+    """
+
+    mesh: object
+    axis: str
+    centroids: jnp.ndarray         # (nlist, d) replicated
+    slot_vecs: jnp.ndarray         # (slots_pad, cap, d) sharded
+    slot_norms: jnp.ndarray        # (slots_pad, cap) sharded
+    slot_ids: jnp.ndarray          # (slots_pad, cap) sharded, -1 pad
+    cent_slots_local: jnp.ndarray  # (size, nlist, max_slots) sharded
+    metric: DistanceType
+    nprobe: int
+
+    @property
+    def nlist(self) -> int:
+        return int(self.centroids.shape[0])
+
+
+def shard_ivf_flat_index(index, mesh, axis: str) -> ShardedIVFFlat:
+    """Slot-shard an :class:`~raft_tpu.spatial.ann.IVFFlatIndex` over
+    ``axis`` and commit the shards to the mesh (class doc above)."""
+    from raft_tpu.spatial.ann import IVFFlatIndex
+
+    expects(isinstance(index, IVFFlatIndex),
+            "shard_ivf_flat_index: IVFFlatIndex required, got %r",
+            type(index).__name__)
+    size = int(mesh.shape[axis])
+    n_slots, cap, d = index.slot_vecs.shape
+    rows = ceildiv(n_slots, size)
+    pad = rows * size - n_slots
+    slot_vecs = index.slot_vecs
+    norms = index.slot_norms
+    if norms is None:   # hand-built legacy tuple
+        norms = jnp.sum(slot_vecs * slot_vecs, -1)
+    slot_ids = index.slot_ids
+    if pad:
+        slot_vecs = jnp.concatenate(
+            [slot_vecs, zeros_cached((pad, cap, d), slot_vecs.dtype)],
+            axis=0)
+        norms = jnp.concatenate(
+            [norms, zeros_cached((pad, cap), norms.dtype)], axis=0)
+        slot_ids = jnp.concatenate(
+            [slot_ids, jnp.full((pad, cap), -1, slot_ids.dtype)],
+            axis=0)
+    # per-shard local slot map: shard r owns global slots
+    # [r*rows, (r+1)*rows); everything else reads -1 ("not mine"), the
+    # same not-a-slot sentinel the probe scan already compacts away
+    cs = np.asarray(index.cent_slots)                # (nlist, max_slots)
+    bases = (np.arange(size) * rows)[:, None, None]
+    owned = (cs[None] >= bases) & (cs[None] < bases + rows)
+    local = np.where(owned, cs[None] - bases, -1).astype(np.int32)
+    shard = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    return ShardedIVFFlat(
+        mesh=mesh, axis=axis,
+        centroids=jax.device_put(index.centroids, rep),
+        slot_vecs=jax.device_put(slot_vecs, NamedSharding(
+            mesh, P(axis, None, None))),
+        slot_norms=jax.device_put(norms, NamedSharding(
+            mesh, P(axis, None))),
+        slot_ids=jax.device_put(slot_ids, NamedSharding(
+            mesh, P(axis, None))),
+        cent_slots_local=jax.device_put(jnp.asarray(local), NamedSharding(
+            mesh, P(axis, None, None))),
+        metric=DistanceType(int(index.metric)),
+        nprobe=int(index.nprobe))
+
+
+def _mnmg_ivf_search_impl(centroids, slot_vecs, slot_norms, slot_ids,
+                          cent_slots_local, q, mesh, axis, k, nprobe,
+                          metric, select_impl, merge, group_size):
+    from raft_tpu.distance.pairwise import expanded_sq_dists
+
+    size = mesh.shape[axis]
+
+    def shard_fn(cent, sv, sn, si, cs, qq):
+        cs = cs[0]                       # (nlist, max_slots) local map
+        nq = qq.shape[0]
+        qn = jnp.sum(qq * qq, axis=1)
+        # identical probe selection on every shard (replicated
+        # centroids — no collective needed)
+        qc = expanded_sq_dists(qq, cent)
+        _, probes = select_k(qc, min(nprobe, cent.shape[0]),
+                             select_min=True, impl=select_impl)
+        slots = cs[probes].reshape(nq, -1)     # local slot ids, -1 pad
+        # valid-first compaction (one stable sort, the _probe_scan_
+        # search idiom), then a STATIC truncation: a shard cannot own
+        # more live probed slots than it holds slots at all
+        _, slots = lax.sort(((slots < 0).astype(jnp.int32), slots),
+                            dimension=1, num_keys=1, is_stable=True)
+        slots = slots[:, :min(slots.shape[1], sv.shape[0])]
+        # ONE-SHOT scan of every probed owned slot — deliberately not
+        # the single-device running-select fori_loop: a while loop
+        # whose shape/trip structure is fed by per-shard data
+        # mis-executes inside a manually partitioned (shard_map) jitted
+        # program on the CPU backend (observed: per-row slot/query
+        # misalignment; only straight-line bodies are safe), and
+        # uniform straight-line control flow across shards is the
+        # conservative SPMD stance anyway.  The gathered (nq, S, cap,
+        # d) block feeds ONLY the einsum, which fuses the gather away
+        # (the slot_norms finding, spatial/ann.py) — peak memory is the
+        # (nq, S, cap) distance block, bounded by the static probe
+        # budget S <= min(nprobe * max_slots, local slots).
+        valid = slots >= 0
+        slx = jnp.where(valid, slots, 0)
+        vecs = sv[slx]                               # (nq, S, cap, d)
+        dist = (qn[:, None, None] + sn[slx]
+                - 2.0 * jnp.einsum("nd,nscd->nsc", qq, vecs,
+                                   precision="highest"))
+        ids = jnp.where(valid[:, :, None], si[slx], -1)
+        ids = ids.reshape(nq, -1).astype(jnp.int32)
+        dist = jnp.where(ids >= 0,
+                         jnp.maximum(dist.reshape(nq, -1), 0.0),
+                         jnp.inf).astype(
+                             jnp.result_type(qq.dtype, jnp.float32))
+        kk = min(k, dist.shape[1])
+        d_loc, i_loc = select_k(dist, kk, select_min=True, values=ids,
+                                impl=select_impl)
+        d_merged, i_merged = _merge_topk(d_loc, i_loc, k, axis, size,
+                                         True, jnp.inf, merge,
+                                         group_size)
+        if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+            d_merged = jnp.sqrt(d_merged)
+        return d_merged, i_merged
+
+    fn = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, None), P(axis, None, None), P(axis, None),
+                  P(axis, None), P(axis, None, None), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_rep=False)
+    return fn(centroids, slot_vecs, slot_norms, slot_ids,
+              cent_slots_local, q)
+
+
+_MNMG_IVF_STATICS = ("mesh", "axis", "k", "nprobe", "metric",
+                     "select_impl", "merge", "group_size")
+_mnmg_ivf_search_jit = profiled_jit(
+    name="mnmg_ivf_flat_search",
+    static_argnames=_MNMG_IVF_STATICS)(_mnmg_ivf_search_impl)
+_mnmg_ivf_search_jit_donated = profiled_jit(
+    name="mnmg_ivf_flat_search_donated",
+    static_argnames=_MNMG_IVF_STATICS,
+    donate_argnames=("q",))(_mnmg_ivf_search_impl)
+
+
+def mnmg_ivf_flat_search(sharded: ShardedIVFFlat, queries, k: int,
+                         nprobe: Optional[int] = None, *,
+                         select_impl: Optional[str] = None,
+                         merge: Optional[str] = None,
+                         group_size: Optional[int] = None,
+                         donate_queries: bool = False,
+                         delta=None
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search a slot-sharded IVF-Flat index (one SPMD program: probe →
+    per-shard slot scan → cross-shard top-k merge by the selected
+    topology).  Results match the single-device
+    :func:`~raft_tpu.spatial.ann.ivf_flat_search` at the same
+    ``nprobe`` up to distance-tie order.
+
+    ``delta=(vectors, ids)`` merges the append-only (replicated) delta
+    segment into the result stream after the sharded program, through
+    the same :func:`~raft_tpu.spatial.ann._delta_merge_impl` programs
+    the single-device path uses; with ``donate_queries`` the query
+    buffer is donated to the LAST consuming program (the delta merge
+    when present, the sharded search otherwise — the
+    ``tiled_knn_donated`` contract, docs/ZERO_COPY.md).
+    """
+    from raft_tpu.spatial.ann import _merge_delta, _validate_nprobe
+
+    q = jnp.asarray(queries)
+    expects(q.ndim == 2 and q.shape[1] == sharded.centroids.shape[1],
+            "mnmg_ivf_flat_search: (nq, %d) queries required, got %r",
+            sharded.centroids.shape[1], tuple(q.shape))
+    nprobe = sharded.nprobe if nprobe is None else nprobe
+    nprobe = _validate_nprobe("mnmg_ivf_flat_search", nprobe,
+                              sharded.nlist)
+    merge = resolve_merge(merge)
+    group_size = (resolve_group_size(sharded.mesh, sharded.axis,
+                                     group_size)
+                  if merge == "hierarchical" else 1)
+    donate_base = donate_queries and delta is None
+    fn = (_mnmg_ivf_search_jit_donated if donate_base
+          else _mnmg_ivf_search_jit)
+    out = fn(sharded.centroids, sharded.slot_vecs, sharded.slot_norms,
+             sharded.slot_ids, sharded.cent_slots_local, q,
+             mesh=sharded.mesh, axis=sharded.axis, k=k, nprobe=nprobe,
+             metric=sharded.metric, select_impl=select_impl,
+             merge=merge, group_size=group_size)
+    if delta is not None:
+        out = _merge_delta(out, delta, q, k, sharded.metric,
+                           donate_queries)
+    return out

@@ -44,6 +44,16 @@ if [[ "${1:-}" == "chaos" ]]; then
         python tools/loadgen.py --chaos --seed "$i" --duration 3 \
             --concurrency 4 --index-rows 3000 --dim 16 --k 5 \
             --max-batch-rows 64 --max-wait-ms 1
+        # every other round runs the SHARDED variant with a permanent
+        # shard kill: recovery must re-partition over the survivors
+        # with exactly-once resolution and exact post-heal results
+        if (( i % 2 == 0 )); then
+            echo "== serve chaos shard-kill $i/$n (seed=$i) =="
+            python tools/loadgen.py --chaos --kill-shard --mesh 4 \
+                --seed "$i" --duration 3 --concurrency 4 \
+                --index-rows 3000 --dim 16 --k 5 \
+                --max-batch-rows 64 --max-wait-ms 1
+        fi
     done
     exit 0
 fi

@@ -112,3 +112,175 @@ def test_mnmg_ring_2d_mesh(data):
     np.testing.assert_allclose(np.asarray(d_got), np.asarray(d_ref),
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+
+
+# ---------------------------------------------------------------------- #
+# hierarchical merge (intra-group allgather + inter-group ring; the
+# HiCCL decomposition applied to top-k candidates)
+# ---------------------------------------------------------------------- #
+@pytest.mark.parametrize("group_size", [1, 2, 4, 8, None])
+def test_mnmg_hierarchical_merge(data, group_size):
+    """Hierarchical merge == single device at every legal group size
+    (1 = pure ring, 8 = pure intra-group allgather, None = auto)."""
+    index, queries = data
+    d_ref, i_ref = brute_force_knn([index], queries, 10)
+    d_got, i_got = mnmg_knn(index, queries, 10, merge="hierarchical",
+                            group_size=group_size)
+    np.testing.assert_allclose(np.asarray(d_got), np.asarray(d_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+
+
+def test_mnmg_hierarchical_bad_group_size(data):
+    from raft_tpu.core.error import RaftError
+
+    index, queries = data
+    with pytest.raises(RaftError):
+        mnmg_knn(index, queries, 5, merge="hierarchical", group_size=3)
+
+
+def test_mnmg_merge_knob_resolution(data):
+    """merge=None resolves the mnmg_merge config knob."""
+    import warnings
+
+    from raft_tpu import config
+
+    index, queries = data
+    d_ref, i_ref = brute_force_knn([index], queries, 6)
+    with warnings.catch_warnings():
+        # the knob IS trace-consumed; the deliberate test override
+        # triggers the (correct) staleness caveat
+        warnings.simplefilter("ignore", UserWarning)
+        with config.override(mnmg_merge="hierarchical"):
+            _, i_got = mnmg_knn(index, queries, 6)
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+    with pytest.raises(Exception):
+        mnmg_knn(index, queries, 6, merge="bogus")
+
+
+def test_mnmg_presharded_index_and_donating_twin(data):
+    """shard_knn_index commits resident shards once; mnmg_knn(n_rows=)
+    reuses them, and donate_queries routes into the donating twin."""
+    from raft_tpu.comms.host_comms import default_mesh
+    from raft_tpu.spatial.mnmg_knn import shard_knn_index
+
+    index, queries = data
+    mesh = default_mesh()
+    index_p, n = shard_knn_index(index, mesh, mesh.axis_names[0])
+    assert index_p.shape[0] % 8 == 0 and n == index.shape[0]
+    d_ref, i_ref = brute_force_knn([index], queries, 10)
+    d_got, i_got = mnmg_knn(index_p, jnp.copy(queries), 10, mesh=mesh,
+                            axis=mesh.axis_names[0], n_rows=n,
+                            donate_queries=True, merge="hierarchical")
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+
+
+def test_resolve_group_size_auto_and_explicit():
+    from raft_tpu.comms.host_comms import default_mesh
+    from raft_tpu.spatial.mnmg_knn import resolve_group_size
+
+    mesh = default_mesh()
+    g = resolve_group_size(mesh, mesh.axis_names[0])
+    assert 8 % g == 0  # auto picks a divisor
+    assert resolve_group_size(mesh, mesh.axis_names[0], 4) == 4
+
+
+def test_axis_host_group_size_single_process():
+    """The virtual mesh is one process: no host structure -> None."""
+    from raft_tpu.comms.host_comms import axis_host_group_size, \
+        default_mesh
+
+    mesh = default_mesh()
+    assert axis_host_group_size(mesh, mesh.axis_names[0]) is None
+
+
+# ---------------------------------------------------------------------- #
+# slot-sharded IVF-Flat (the ANN serving shard)
+# ---------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def ivf_sharded():
+    from raft_tpu.comms.host_comms import default_mesh
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+    from raft_tpu.spatial.mnmg_knn import shard_ivf_flat_index
+
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((1500, 16)).astype(np.float32)
+    index = ivf_flat_build(jnp.asarray(X), IVFFlatParams(nlist=24,
+                                                         nprobe=6))
+    mesh = default_mesh()
+    return X, index, shard_ivf_flat_index(index, mesh,
+                                          mesh.axis_names[0])
+
+
+@pytest.mark.parametrize("merge", ["allgather", "ring", "hierarchical"])
+def test_mnmg_ivf_matches_single_device(ivf_sharded, rng, merge):
+    """Slot-sharded IVF search == single-device ivf_flat_search at the
+    same nprobe, per merge topology."""
+    from raft_tpu.spatial.ann import ivf_flat_search
+    from raft_tpu.spatial.mnmg_knn import mnmg_ivf_flat_search
+
+    X, index, sharded = ivf_sharded
+    q = jnp.asarray(rng.standard_normal((9, 16)).astype(np.float32))
+    d_ref, i_ref = ivf_flat_search(index, q, 5, nprobe=6)
+    d_got, i_got = mnmg_ivf_flat_search(sharded, q, 5, nprobe=6,
+                                        merge=merge)
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+    np.testing.assert_allclose(np.asarray(d_got), np.asarray(d_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_mnmg_ivf_full_probe_is_exact(ivf_sharded, rng):
+    """nprobe=nlist scans everything: sharded ANN == brute force."""
+    from raft_tpu.spatial.mnmg_knn import mnmg_ivf_flat_search
+
+    X, index, sharded = ivf_sharded
+    q = jnp.asarray(rng.standard_normal((6, 16)).astype(np.float32))
+    _, i_ref = brute_force_knn([jnp.asarray(X)], q, 4)
+    _, i_got = mnmg_ivf_flat_search(sharded, q, 4, nprobe=24)
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+
+
+@pytest.mark.parametrize("merge", ["allgather", "ring", "hierarchical"])
+def test_mnmg_ivf_narrow_candidates_pad_to_k(rng, merge):
+    """k wider than the whole gathered candidate set (tiny probed
+    lists): every topology must pad with (inf, -1) like the
+    single-device running select, not crash in the merge re-selection
+    (regression: the allgather arm used to select_k(k) over a
+    narrower gather)."""
+    from raft_tpu.comms.host_comms import default_mesh
+    from raft_tpu.spatial.ann import (IVFFlatParams, ivf_flat_build,
+                                      ivf_flat_search)
+    from raft_tpu.spatial.mnmg_knn import (mnmg_ivf_flat_search,
+                                           shard_ivf_flat_index)
+
+    X = rng.standard_normal((120, 8)).astype(np.float32)
+    index = ivf_flat_build(jnp.asarray(X), IVFFlatParams(nlist=64,
+                                                         nprobe=1))
+    mesh = default_mesh()
+    sharded = shard_ivf_flat_index(index, mesh, mesh.axis_names[0])
+    q = jnp.asarray(rng.standard_normal((5, 8)).astype(np.float32))
+    d_ref, i_ref = ivf_flat_search(index, q, 64, nprobe=1)
+    d_got, i_got = mnmg_ivf_flat_search(sharded, q, 64, nprobe=1,
+                                        merge=merge)
+    assert d_got.shape == (5, 64) and i_got.shape == (5, 64)
+    np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+
+
+def test_mnmg_ivf_delta_merge(ivf_sharded, rng):
+    """The replicated delta segment merges into the sharded result
+    stream (ids disjoint from the base index)."""
+    from raft_tpu.spatial.mnmg_knn import mnmg_ivf_flat_search
+
+    X, index, sharded = ivf_sharded
+    q = jnp.asarray(rng.standard_normal((5, 16)).astype(np.float32))
+    dv = rng.standard_normal((32, 16)).astype(np.float32)
+    dids = np.arange(9000, 9032, dtype=np.int32)
+    _, i_got = mnmg_ivf_flat_search(
+        sharded, q, 4, nprobe=24,
+        delta=(jnp.asarray(dv), jnp.asarray(dids)))
+    _, i_ref = brute_force_knn(
+        [jnp.concatenate([jnp.asarray(X), jnp.asarray(dv)])], q, 4)
+    i_ref = np.asarray(i_ref)
+    want = np.where(i_ref >= X.shape[0],
+                    i_ref - X.shape[0] + 9000, i_ref)
+    np.testing.assert_array_equal(np.asarray(i_got), want)

@@ -150,7 +150,8 @@ def make_query_pool(ref, rows, n=32, seed=1, noise=0.1):
 
 
 def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
-                  nlist=None, nprobe=None, train_rows=None, **opts):
+                  nlist=None, nprobe=None, train_rows=None,
+                  mesh_devices=None, **opts):
     """A ready (not yet warmed) service over a synthetic index.
 
     ``kind="ann"`` builds an IVF-Flat index over the data first
@@ -159,11 +160,25 @@ def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
     :class:`~raft_tpu.serve.ANNService`.  The generated reference
     matrix is attached as ``service.loadgen_ref`` so recall ground
     truth and query pools can reuse it without regeneration.
+
+    ``mesh_devices=N`` serves SHARDED (docs/SERVING.md "Sharded
+    serving"): the index row-/slot-shards over a 1-D mesh spanning the
+    first N local devices, and every batch dispatches into the pjit'd
+    SPMD search (``merge=`` in ``opts`` picks the topology).  kNN and
+    ANN only.
     """
     import jax.numpy as jnp
 
     from raft_tpu.serve import ANNService, KNNService, PairwiseService
 
+    if mesh_devices is not None:
+        from raft_tpu.comms.host_comms import default_mesh
+
+        if kind not in ("knn", "ann"):
+            raise SystemExit(
+                "--mesh applies to the sharded services (knn/ann)")
+        mesh = default_mesh(int(mesh_devices))
+        opts = dict(opts, mesh=mesh, axis=mesh.axis_names[0])
     ref = jnp.asarray(synth_data(index_rows, dim, seed=seed,
                                  clusters=clusters))
     if kind == "knn":
@@ -366,7 +381,7 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
 
 def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
               transient_p=0.05, outage_at=0.35, outage_s=0.8,
-              manager=None, query_pool=None):
+              manager=None, query_pool=None, kill_shard=False):
     """Chaos scenario: drive ``service`` closed-loop while injecting
     seeded faults at the serve seam, with a simulated device loss
     (persistent outage) mid-run; returns the report.
@@ -386,6 +401,12 @@ def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
       :class:`~raft_tpu.serve.resilience.RecoveryManager` was passed
       (device-loss semantics: re-publish + re-warm + re-admit),
       otherwise the breaker's half-open probe re-closes it alone.
+      With ``kill_shard`` (sharded services only) the outage IS a
+      shard loss: the serving mesh permanently loses its last device,
+      and recovery re-partitions the index over the survivors
+      (``service.repartition``) before re-warming — the report then
+      carries ``post_recovery_exact``: post-heal results checked
+      exactly against a single-device brute-force ground truth.
 
     The acceptance invariant, asserted into the report: **every
     submitted request resolves exactly once** — ``ok + typed_errors +
@@ -465,14 +486,59 @@ def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
         time.sleep(outage_s)
         outage.deactivate()         # survivors work again
         outage = None
+        if kill_shard:
+            # the outage WAS a shard loss: drop the serving mesh's
+            # last device and re-partition its rows/slots across the
+            # survivors (quiesced — a swap must never tear a batch)
+            if getattr(service, "axis", None) is None:
+                raise SystemExit(
+                    "--kill-shard needs a sharded service (--mesh N)")
+            from jax.sharding import Mesh
+
+            devs = list(service.mesh.devices.ravel())
+            if len(devs) < 2:
+                raise SystemExit("--kill-shard: nothing to kill on a "
+                                 "1-device mesh")
+            survivors = Mesh(np.asarray(devs[:-1]),
+                             service.mesh.axis_names)
+            service.pause()
+            service.worker.quiesce(timeout=15.0)
+            service.repartition(mesh=survivors)
+            service.resume()
         if manager is not None:
-            manager.recover()       # orchestrated recovery
+            manager.recover()       # orchestrated recovery (+ warmup)
         for t in threads:
             t.join(timeout=duration + 30.0)
     finally:
         if outage is not None:
             outage.deactivate()
         transient.deactivate()
+    post_exact = None
+    if kill_shard:
+        from raft_tpu.serve import KNNService
+        from raft_tpu.spatial.knn import brute_force_knn
+
+        if isinstance(service, KNNService):
+            # exact post-recovery results: the re-partitioned service
+            # must answer identically to single-device brute force
+            # over the SAME full index (no rows lost with the shard).
+            # A still-cooling breaker (no manager passed) may shed the
+            # first probe — wait out the hint and retry once.
+            probe_q = pool[0]
+            for _attempt in range(2):
+                try:
+                    out = service.submit(probe_q).result(timeout=30.0)
+                    break
+                except RaftError:
+                    time.sleep(
+                        max(0.05, service.breaker.retry_after())
+                        if service.breaker is not None else 0.3)
+            else:
+                out = service.submit(probe_q).result(timeout=30.0)
+            _, i_ref = brute_force_knn(service.index, probe_q,
+                                       service.k)
+            post_exact = bool(
+                (np.asarray(out[1]) == np.asarray(i_ref)).all())
     # final sweep: drain what is still queued, then score every future
     service.drain(timeout=30.0)
     results = {"ok": 0, "typed_errors": 0, "untyped_errors": 0,
@@ -512,8 +578,15 @@ def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
                           if service.breaker is not None else None),
         "chaos_ok": (results["lost"] == 0
                      and results["untyped_errors"] == 0
-                     and resolved == counts["submitted"]),
+                     and resolved == counts["submitted"]
+                     and post_exact is not False),
     }
+    if kill_shard:
+        report["kill_shard"] = True
+        report["post_recovery_exact"] = post_exact
+        if getattr(service, "axis", None) is not None:
+            report["shard_devices"] = int(
+                service.mesh.shape[service.axis])
     return report
 
 
@@ -546,6 +619,17 @@ def main(argv=None) -> int:
                     help="chaos: per-batch transient fault probability")
     ap.add_argument("--outage-s", type=float, default=0.8,
                     help="chaos: simulated device-loss duration")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="serve SHARDED over the first N local devices "
+                         "(docs/SERVING.md sharded serving; knn/ann)")
+    ap.add_argument("--merge", default=None,
+                    choices=("allgather", "ring", "hierarchical"),
+                    help="sharded cross-shard top-k merge topology "
+                         "(default: the mnmg_merge knob)")
+    ap.add_argument("--kill-shard", action="store_true",
+                    help="chaos: the outage permanently kills one "
+                         "shard device; recovery re-partitions over "
+                         "the survivors (requires --mesh >= 2)")
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--qps", type=float, default=100.0,
                     help="open-loop arrival rate")
@@ -575,9 +659,17 @@ def main(argv=None) -> int:
     if args.service == "ann":
         opts.update(nlist=args.nlist, nprobe=args.nprobe,
                     train_rows=args.train_rows)
+    if args.merge is not None:
+        if args.mesh is None:
+            raise SystemExit("--merge is the sharded cross-shard merge "
+                             "topology — it requires --mesh N")
+        opts["merge"] = args.merge
+    if args.kill_shard and (args.mesh is None or args.mesh < 2):
+        raise SystemExit("--kill-shard requires --mesh >= 2")
     service = build_service(args.service, args.index_rows, args.dim,
                             args.k, seed=args.seed,
-                            clusters=args.clusters, **opts)
+                            clusters=args.clusters,
+                            mesh_devices=args.mesh, **opts)
     t0 = time.monotonic()
     service.warmup()
     warmup_s = time.monotonic() - t0
@@ -590,7 +682,8 @@ def main(argv=None) -> int:
                                concurrency=args.concurrency,
                                rows=args.rows, seed=args.seed,
                                transient_p=args.transient_p,
-                               outage_s=args.outage_s, manager=manager)
+                               outage_s=args.outage_s, manager=manager,
+                               kill_shard=args.kill_shard)
         finally:
             service.close()
         report["warmup_s"] = round(warmup_s, 3)
@@ -604,8 +697,10 @@ def main(argv=None) -> int:
                         "untyped_errors", "lost", "rejected",
                         "unavailable", "requeued", "breaker_trips",
                         "recoveries", "breaker_state", "exactly_once",
-                        "typed_only", "chaos_ok"):
-                print("  %-20s %s" % (key, report[key]))
+                        "typed_only", "kill_shard", "shard_devices",
+                        "post_recovery_exact", "chaos_ok"):
+                if key in report:
+                    print("  %-20s %s" % (key, report[key]))
         return 0 if report["chaos_ok"] else 1
     want_recall = args.recall or args.service == "ann"
     pool = None
@@ -631,6 +726,9 @@ def main(argv=None) -> int:
         service.close()
     report["warmup_s"] = round(warmup_s, 3)
     report["buckets"] = list(service.policy.rungs)
+    if getattr(service, "axis", None) is not None:
+        report["n_devices"] = int(service.mesh.shape[service.axis])
+        report["merge"] = service.merge
     if args.service == "ann":
         report["nprobe"] = service.nprobe
         report["delta_rows"] = service.delta_rows
@@ -642,6 +740,7 @@ def main(argv=None) -> int:
         return 0
     print("== loadgen: %s %s ==" % (args.service, args.mode))
     for key in ("duration_s", "requests_ok", "rejected", "errors", "qps",
+                "query_qps", "n_devices", "merge",
                 "recall_at_k", "recall_k", "nprobe", "delta_rows",
                 "p50_ms", "p95_ms", "p99_ms", "queue_wait_p50_ms",
                 "queue_wait_p95_ms", "batches", "mean_batch_rows",

@@ -135,20 +135,28 @@ def _serve_summary(metrics: dict) -> list:
     expired = per_service("raft_tpu_serve_expired_total")
     waits = per_service("raft_tpu_serve_wait_seconds")
     execs = per_service("raft_tpu_serve_exec_seconds")
+    shard_devs = per_service("raft_tpu_serve_shard_devices")
+    reparts = per_service("raft_tpu_serve_repartitions_total")
     lines = []
     for svc in sorted(requests):
         nb = batches.get(svc, {}).get("value", 0)
         pay = payload.get(svc, {}).get("value", 0)
         pad = padded.get(svc, {}).get("value", 0)
         total = pay + pad
+        sharded = ""
+        if svc in shard_devs and shard_devs[svc].get("value", 0):
+            sharded = "  shards=%d" % int(shard_devs[svc]["value"])
+            nrep = reparts.get(svc, {}).get("value", 0)
+            if nrep:
+                sharded += " repartitions=%d" % int(nrep)
         lines.append(
             "  %-24s requests=%-8d batches=%-7d mean_fill=%-7.1f "
-            "waste=%.1f%%  rejected=%d expired=%d"
+            "waste=%.1f%%  rejected=%d expired=%d%s"
             % (svc, requests[svc]["value"], nb,
                (pay / nb) if nb else 0.0,
                (100.0 * pad / total) if total else 0.0,
                rejected.get(svc, {}).get("value", 0),
-               expired.get(svc, {}).get("value", 0)))
+               expired.get(svc, {}).get("value", 0), sharded))
         w, e = waits.get(svc), execs.get(svc)
         if w or e:
             lines.append(
