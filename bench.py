@@ -1007,6 +1007,74 @@ def _bench_serve_sharded(index_rows, dim, k, duration, concurrency,
     return out
 
 
+def _bench_serve_mixed_tenant(index_rows, dim, k, duration,
+                              interactive_conc, bulk_qps,
+                              bulk_rows=16, queue_cap=64,
+                              max_batch_rows=64):
+    """Traffic-shaping rung (docs/SERVING.md "Traffic shaping"): the
+    multi-tenant isolation claim, measured.  One weighted-fair
+    KNNService (interactive:4, bulk:1) takes closed-loop interactive
+    clients and an open-loop bulk flood AT ONCE; the rung first runs
+    the interactive class SOLO for its baseline p99, then the mixed
+    scenario, and reports the ratio — ``isolation_ok`` asserts the
+    interactive p99 stayed within 2x of its solo run while the bulk
+    tenant saturated its quota (sheds > 0 proves saturation, and every
+    shed is typed with a retry_after_s hint).  Without weighted-fair
+    admission the bulk flood owns the whole queue cap and the
+    interactive class starves — the single global cap this rung
+    replaces."""
+    from tools.loadgen import build_service, run_load, run_mixed_tenants
+
+    # window sized so a mixed batch (interactive rows + bulk's DRR
+    # quota) lands on a rung NEAR the solo batch's rung: exec time
+    # scales with the padded rung, and the quota — not backfill — is
+    # what bounds the mixed rung (docs/SERVING.md "Traffic shaping")
+    svc = build_service("knn", index_rows, dim, k,
+                        max_batch_rows=max_batch_rows, max_wait_ms=1.0,
+                        queue_cap=queue_cap,
+                        tenant_weights={"interactive": 4, "bulk": 1})
+    t0 = time.time()
+    svc.warmup()
+    warmup_s = time.time() - t0
+    try:
+        solo = run_load(svc, mode="closed", duration=max(1.5,
+                                                         duration / 2),
+                        concurrency=interactive_conc, rows=4,
+                        tenant="interactive")
+        mixed = run_mixed_tenants(
+            svc, duration=duration,
+            interactive_concurrency=interactive_conc,
+            bulk_qps=bulk_qps, interactive_rows=4, bulk_rows=bulk_rows)
+    finally:
+        svc.close()
+    inter = mixed["tenants"]["interactive"]
+    bulk = mixed["tenants"]["bulk"]
+    solo_p99 = max(solo["p99_ms"], 1e-3)
+    ratio = inter["p99_ms"] / solo_p99
+    return {
+        "interactive_solo_p99_ms": solo["p99_ms"],
+        "interactive_mixed_p99_ms": inter["p99_ms"],
+        "interactive_p99_ratio": round(ratio, 2),
+        "interactive_qps": inter["qps"],
+        "bulk_qps": bulk["qps"],
+        "bulk_sheds": bulk["rejected"],
+        "bulk_saturated": bulk["rejected"] > 0,
+        "untyped_sheds": mixed["untyped_sheds"],
+        # the acceptance statement: interactive p99 within 2x solo
+        # while the bulk tenant saturates its quota, all sheds typed
+        "isolation_ok": (ratio <= 2.0 and bulk["rejected"] > 0
+                         and mixed["untyped_sheds"] == 0),
+        "post_warmup_compiles": mixed["post_warmup_compiles"],
+        "warmup_s": round(warmup_s, 3),
+        "config": {"index_rows": index_rows, "dim": dim, "k": k,
+                   "interactive_concurrency": interactive_conc,
+                   "bulk_qps": bulk_qps, "bulk_rows": bulk_rows,
+                   "queue_cap": queue_cap,
+                   "max_batch_rows": max_batch_rows,
+                   "tenant_weights": {"interactive": 4, "bulk": 1}},
+    }
+
+
 def _bench_serve_ann(index_rows, dim, k, duration, concurrency, nlist,
                      train_rows, target_recall, state=None, rows=16):
     """ANN serving rung (docs/SERVING.md): the whole request path
@@ -1440,6 +1508,16 @@ def child_main():
             # scaled index, whole-request-path QPS + latency percentiles
             ("serve_knn", 45,
              lambda: _bench_serve(20_000, 64, 10, 3.0, 8)),
+            # multi-tenant isolation (DRR weighted-fair admission):
+            # interactive p99 must hold within 2x its solo baseline
+            # while an open-loop bulk flood saturates its quota.  Bulk
+            # arrival rate is sized for this box: the open-loop
+            # generator's own thread churn shares the 2 cores with the
+            # virtual devices, so a crushing arrival rate measures
+            # loadgen contention, not admission isolation
+            ("serve_mixed_tenant", 70,
+             lambda: _bench_serve_mixed_tenant(20_000, 64, 10, 4.0,
+                                               4, 60.0)),
             # sharded SPMD serving scaling table (1/2/4/8 virtual
             # devices over the forced 8-device CPU mesh): the capacity
             # axis with its zero-copy/zero-compile proof riding along.
@@ -1561,6 +1639,11 @@ def child_main():
             # warmed service; est covers the per-bucket warmup compiles
             ("serve_knn", 90,
              lambda: _bench_serve(100_000, 64, 10, 5.0, 16)),
+            # multi-tenant isolation at hardware scale: interactive
+            # p99 within 2x solo while the bulk flood saturates
+            ("serve_mixed_tenant", 90,
+             lambda: _bench_serve_mixed_tenant(100_000, 64, 10, 5.0,
+                                               8, 150.0)),
             # sharded SPMD serving over the real mesh: the QPS-scales-
             # with-mesh-size claim measured on hardware (1/2/4/8-device
             # scaling table + merge-topology A/B)

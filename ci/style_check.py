@@ -49,6 +49,13 @@ Checks, per Python source file:
   marker comment on the ``except`` line (docs/FAULT_MODEL.md "Serving
   failure model" — the self-healing story dies the day a failure is
   swallowed invisibly).
+- every ``ServiceOverloadError(...)`` raised inside ``raft_tpu/serve/``
+  must carry an explicit ``retry_after_s=`` keyword: the overload/
+  unavailable taxonomy promises callers a uniform back-off hint
+  (docs/SERVING.md "Traffic shaping"), and a bare
+  ``ServiceOverloadError(msg, depth, cap)`` silently hands back the
+  0.0 default — a shed site with genuinely no estimate marks the line
+  ``shed-hint-ok``.
 
 Exit code 0 when clean; prints one line per violation otherwise.
 """
@@ -103,6 +110,14 @@ SERVE_EXC_DIR = os.path.join("raft_tpu", "serve") + os.sep
 SERVE_EXC_MARKER = "serve-exc-ok"
 SERVE_EXC_RELAY_ATTRS = ("_set_exception", "inc", "observe",
                          "record_failure", "_fail_batch")
+
+# shed-hint audit (raft_tpu/serve/ only): every ServiceOverloadError a
+# shed site constructs must carry the retry_after_s back-off hint; a
+# site with genuinely no estimate marks the line `shed-hint-ok`
+SERVE_SHED_DIR = SERVE_EXC_DIR
+SERVE_SHED_MARKER = "shed-hint-ok"
+SERVE_SHED_NAME = "ServiceOverloadError"
+SERVE_SHED_HINT_KW = "retry_after_s"
 
 
 def _serve_handler_visible(handler):
@@ -159,6 +174,20 @@ def check_file(path):
                 and node.module.startswith("raft_tpu")
                 and any(a.name == "*" for a in node.names)):
             problems.append(f"{rel}:{node.lineno}: wildcard raft_tpu import")
+        if (in_serve_exc_scope and isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Name)
+                      and node.func.id == SERVE_SHED_NAME)
+                     or (isinstance(node.func, ast.Attribute)
+                         and node.func.attr == SERVE_SHED_NAME))
+                and not any(kw.arg == SERVE_SHED_HINT_KW
+                            for kw in node.keywords)
+                and SERVE_SHED_MARKER
+                not in src_lines[node.lineno - 1]):
+            problems.append(
+                f"{rel}:{node.lineno}: {SERVE_SHED_NAME} without "
+                f"{SERVE_SHED_HINT_KW}= — every shed must hand the "
+                "caller a back-off hint (docs/SERVING.md); mark "
+                f"hint-less sites `{SERVE_SHED_MARKER}`")
         if (in_serve_exc_scope and isinstance(node, ast.ExceptHandler)
                 and (node.type is None
                      or (isinstance(node.type, ast.Name)

@@ -111,6 +111,28 @@ serve_ann_degrade_frac
     lower latency — instead of shedding, and restores the calibrated
     cell when pressure clears.  ``0`` disables the brownout.  Free-form
     float in (0, 1].
+serve_tenant_weights
+    Default multi-tenant traffic-shaping spec for serve services
+    (docs/SERVING.md "Traffic shaping"): a comma-separated
+    ``name:weight`` list (``"interactive:4,bulk:1"``) naming the
+    tenants and their weighted-fair share of each coalesce window and
+    of the admission cap.  Empty (the default) = single-queue serving
+    (every request rides one implicit default tenant).  Free-form;
+    runtime-resolved at service construction.
+serve_hedge_ms
+    Fixed hedge threshold for replicated services
+    (``KNNService(replicas=...)``): a batch whose execution exceeds
+    this many milliseconds is re-dispatched to a second replica with
+    first-result-wins resolution.  ``0`` (the default) = adaptive: the
+    threshold is ``serve_hedge_factor`` × the tracked per-bucket-rung
+    p99, floored at ``serve_hedge_min_ms``.  Free-form float ms.
+serve_hedge_factor
+    Multiplier on the per-rung p99 execution latency that sets the
+    adaptive hedge threshold (only consulted when ``serve_hedge_ms`` is
+    0).  Free-form float.
+serve_hedge_min_ms
+    Floor for the adaptive hedge threshold — hedging below it would
+    duplicate healthy work on latency noise.  Free-form float ms.
 """
 
 from __future__ import annotations
@@ -159,6 +181,10 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
                                   "250", None),
     "serve_ann_degrade_frac": ("RAFT_TPU_SERVE_ANN_DEGRADE_FRAC",
                                "0.75", None),
+    "serve_tenant_weights": ("RAFT_TPU_SERVE_TENANT_WEIGHTS", "", None),
+    "serve_hedge_ms": ("RAFT_TPU_SERVE_HEDGE_MS", "0", None),
+    "serve_hedge_factor": ("RAFT_TPU_SERVE_HEDGE_FACTOR", "1.5", None),
+    "serve_hedge_min_ms": ("RAFT_TPU_SERVE_HEDGE_MIN_MS", "10", None),
 }
 
 # knobs resolved at *runtime* (service/object construction), never baked
@@ -170,7 +196,8 @@ _RUNTIME_KNOBS = frozenset(
      "serve_ann_delta_cap", "serve_ann_compact_rows",
      "serve_breaker_threshold", "serve_breaker_window",
      "serve_breaker_window_failures", "serve_breaker_cooldown_ms",
-     "serve_ann_degrade_frac"))
+     "serve_ann_degrade_frac", "serve_tenant_weights",
+     "serve_hedge_ms", "serve_hedge_factor", "serve_hedge_min_ms"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

@@ -59,26 +59,44 @@ class AllocationError(RaftError):
 
 
 class ServiceOverloadError(RaftError):
-    """Admission control rejected a request: the serving queue is at its
-    configured depth cap (:mod:`raft_tpu.serve` — the analog of a
-    load-balancer shedding rather than queueing unboundedly; see
-    docs/SERVING.md).  Callers should back off and resubmit, or raise
-    capacity (``serve_queue_cap``).
+    """Admission control rejected a request: the serving queue (or the
+    shedding tenant's share of it) is at its configured depth cap
+    (:mod:`raft_tpu.serve` — the analog of a load-balancer shedding
+    rather than queueing unboundedly; see docs/SERVING.md).  Callers
+    should back off ``retry_after_s`` and resubmit, or raise capacity
+    (``serve_queue_cap``).
+
+    Matches the :class:`ServiceUnavailableError` taxonomy — both carry
+    ``retry_after_s`` so callers back off uniformly whether the service
+    is *full* (this error) or *broken/healing* (that one).
 
     Attributes
     ----------
     queue_depth:
-        Requests queued at rejection time.
+        Requests queued at rejection time (the shedding tenant's depth
+        when a per-tenant cap shed).
     queue_cap:
-        The configured admission cap.
+        The cap that shed (the tenant's share when tenancy is active).
+    tenant:
+        Name of the tenant whose quota shed the request, or None for a
+        shed with no tenant dimension (e.g. a full ANN delta segment).
+    retry_after_s:
+        Hint: estimated seconds until the queue drains enough to admit
+        again (0.0 when unknown).
     """
 
-    def __init__(self, message: str, queue_depth: int, queue_cap: int):
+    def __init__(self, message: str, queue_depth: int, queue_cap: int,
+                 tenant: "str | None" = None,
+                 retry_after_s: float = 0.0):
         self.queue_depth = int(queue_depth)
         self.queue_cap = int(queue_cap)
+        self.tenant = None if tenant is None else str(tenant)
+        self.retry_after_s = float(retry_after_s)
         super().__init__(
-            "%s (queue depth %d at cap %d)"
-            % (message, self.queue_depth, self.queue_cap))
+            "%s (queue depth %d at cap %d%s retry_after_s=%.3f)"
+            % (message, self.queue_depth, self.queue_cap,
+               "" if self.tenant is None else " tenant=%s" % self.tenant,
+               self.retry_after_s))
 
 
 class ServiceUnavailableError(RaftError):

@@ -18,7 +18,12 @@ padded device call per shape bucket, so
 - the serving failure contract — serve-seam fault injection, per-
   service circuit breaker, recovery orchestration, degraded-mode
   dispatch — lives in :mod:`~raft_tpu.serve.resilience`
-  (docs/FAULT_MODEL.md "Serving failure model").
+  (docs/FAULT_MODEL.md "Serving failure model"),
+- traffic shaping — multi-tenant weighted-fair admission and EDF
+  ordering live in :mod:`~raft_tpu.serve.batcher`; replica groups over
+  disjoint sub-meshes with hedged re-dispatch of straggling batches
+  live in :mod:`~raft_tpu.serve.replicas` (docs/SERVING.md "Traffic
+  shaping").
 
 Session integration: ``Comms.serve(...)`` constructs and registers a
 service; ``health_check()`` reports live services (breaker state and
@@ -34,6 +39,12 @@ from raft_tpu.serve.bucketing import (  # noqa: F401
     pad_rows,
     resolve_rungs,
     split_rows,
+)
+from raft_tpu.serve.replicas import (  # noqa: F401
+    ReplicaFaultInjector,
+    ReplicaSet,
+    inject_replica,
+    split_mesh,
 )
 from raft_tpu.serve.resilience import (  # noqa: F401
     BreakerState,
@@ -55,4 +66,5 @@ __all__ = [
     "Service", "KNNService", "PairwiseService", "ANNService",
     "BreakerState", "CircuitBreaker", "RecoveryManager",
     "ServeFaultInjector", "inject_worker",
+    "ReplicaSet", "ReplicaFaultInjector", "inject_replica", "split_mesh",
 ]

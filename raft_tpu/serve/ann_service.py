@@ -266,6 +266,9 @@ class ANNService(Service):
                                        np.dtype(dtype))
         self._delta_ids_np = np.full(self._delta_cap, -1, np.int32)
         self._delta_count = 0
+        # last observed compaction duration — the retry_after_s hint a
+        # full-delta shed hands back ("wait one compaction out")
+        self._last_compact_s = 0.0
         self._index = index
         self._publish_state_locked()
 
@@ -538,7 +541,8 @@ class ANNService(Service):
                     "%s.insert: delta segment full (%d + %d > cap %d); "
                     "wait for compaction and retry" % (
                         self.name, at, n, self._delta_cap), at,
-                    self._delta_cap)
+                    self._delta_cap,
+                    retry_after_s=max(self._last_compact_s, 0.05))
             self._delta_vecs_np[at:at + n] = np.asarray(v)
             self._delta_ids_np[at:at + n] = key
             self._delta_count = at + n
@@ -594,9 +598,10 @@ class ANNService(Service):
         _labeled("counter", "raft_tpu_serve_ann_compacted_rows_total",
                  "rows folded into IVF slots by compaction",
                  self.name).inc(n0)
+        self._last_compact_s = self._clock() - t0
         _labeled("timer", "raft_tpu_serve_ann_compact_seconds",
                  "compaction latency (re-cluster + swap)",
-                 self.name).observe(self._clock() - t0)
+                 self.name).observe(self._last_compact_s)
         return True
 
     # ------------------------------------------------------------------ #

@@ -97,6 +97,12 @@ def _bucket_counter(service: str, bucket: int):
                                              bucket=bucket)
 
 
+def _tenant_counter(name: str, help: str, service: str, tenant: str):
+    return _metrics.default_registry().counter(
+        name, help=help, labels=("service", "tenant")).labels(
+            service=service, tenant=tenant)
+
+
 class ServeWorker:
     """Single-consumer dispatch loop over a :class:`MicroBatcher`.
 
@@ -627,10 +633,25 @@ class ServeWorker:
         # accounting only after a successful dispatch
         if self.breaker is not None:
             self.breaker.record_success()
+        # feed the admission layer's queue-drain estimate (the
+        # ServiceOverloadError.retry_after_s hint)
+        self._batcher.note_batch_seconds(
+            max(1e-6, t_ready - inflight.t_launch))
         _counter("raft_tpu_serve_batches_total", "dispatched batches",
                  self.name).inc()
         _counter("raft_tpu_serve_requests_total", "served requests",
                  self.name).inc(len(live))
+        per_tenant: dict = {}
+        for req in live:
+            rows_n, reqs_n = per_tenant.get(req.tenant, (0, 0))
+            per_tenant[req.tenant] = (rows_n + req.rows, reqs_n + 1)
+        for tenant, (rows_n, reqs_n) in per_tenant.items():
+            _tenant_counter("raft_tpu_serve_tenant_rows_total",
+                            "payload rows served, per tenant",
+                            self.name, tenant).inc(rows_n)
+            _tenant_counter("raft_tpu_serve_tenant_requests_total",
+                            "requests served, per tenant",
+                            self.name, tenant).inc(reqs_n)
         _counter("raft_tpu_serve_payload_rows_total",
                  "real (caller) rows dispatched", self.name).inc(
                      payload_rows)

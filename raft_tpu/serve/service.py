@@ -75,7 +75,8 @@ from typing import NamedTuple
 from raft_tpu.serve.batcher import MicroBatcher, ServeFuture
 from raft_tpu.serve.bucketing import BucketPolicy, resolve_rungs
 from raft_tpu.serve.resilience import BreakerState, CircuitBreaker
-from raft_tpu.serve.scheduler import ServeWorker, _counter, _gauge
+from raft_tpu.serve.scheduler import (ServeWorker, _counter, _gauge,
+                                      _tenant_counter)
 from raft_tpu.spatial.knn import brute_force_knn
 
 __all__ = ["Service", "KNNService", "PairwiseService"]
@@ -99,6 +100,47 @@ def _knob_int(name: str) -> int:
     except (TypeError, ValueError):
         raise ValueError("raft_tpu.config: %s=%r is not an integer"
                          % (name, raw)) from None
+
+
+def _parse_tenant_weights(spec) -> Optional[dict]:
+    """Resolve a tenant-weight spec — ``{name: weight}`` dict, or the
+    ``serve_tenant_weights`` knob's ``"name:weight,name:weight"``
+    string — into a dict (None/empty = tenancy off)."""
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        return {str(k): float(v) for k, v in spec.items()} or None
+    out = {}
+    for tok in str(spec).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, w = tok.partition(":")
+        try:
+            out[name.strip()] = float(w) if sep else 1.0
+        except ValueError:
+            raise ValueError(
+                "serve_tenant_weights: %r is not name:weight" % tok
+            ) from None
+    return out or None
+
+
+def _breaker_from_knobs(name: str, clock) -> Optional[CircuitBreaker]:
+    """One breaker per the ``serve_breaker_*`` knobs, or None when both
+    trip conditions are knobbed off (the env-level opt-out — a breaker
+    that can never open is just overhead).  Shared by the service-level
+    breaker and the per-replica breakers."""
+    threshold = _knob_int("serve_breaker_threshold")
+    window_failures = _knob_int("serve_breaker_window_failures")
+    if threshold == 0 and window_failures == 0:
+        return None
+    return CircuitBreaker(
+        name,
+        failure_threshold=threshold,
+        window=_knob_int("serve_breaker_window"),
+        window_failures=window_failures,
+        cooldown_s=_knob_float("serve_breaker_cooldown_ms") / 1e3,
+        clock=clock)
 
 
 # -- device functions -------------------------------------------------- #
@@ -155,6 +197,15 @@ class Service:
         every service is breaker-protected out of the box.  Pass a
         configured instance to tune it, or ``False`` to opt out
         entirely (PR 3's relay-every-failure behavior).
+    tenant_weights:
+        Multi-tenant traffic shaping (docs/SERVING.md "Traffic
+        shaping"): a ``{tenant: weight}`` dict or the knob's
+        ``"name:weight,..."`` string.  Each coalesce window is formed
+        as a weighted-fair share of the batch across tenants with
+        queued work, and each tenant's admission cap is its weight's
+        share of ``queue_cap`` — a flooding bulk tenant sheds itself,
+        not everyone.  Default: the ``serve_tenant_weights`` knob
+        (empty = single-queue serving).
     query_cache_size:
         > 0 enables the :class:`VecCache` query-vector cache
         (:meth:`cache_put` / :meth:`submit_keys`).
@@ -185,6 +236,7 @@ class Service:
                  retry_policy=None,
                  donate: Optional[bool] = None,
                  breaker=None,
+                 tenant_weights=None,
                  query_cache_size: int = 0,
                  maintenance: Optional[Callable[[], None]] = None,
                  maintenance_interval_s: float = 0.05,
@@ -207,29 +259,19 @@ class Service:
             max_wait_ms = _knob_float("serve_max_wait_ms")
         if queue_cap is None:
             queue_cap = _knob_int("serve_queue_cap")
+        if tenant_weights is None:
+            tenant_weights = config.get("serve_tenant_weights")
+        tenant_weights = _parse_tenant_weights(tenant_weights)
+        self.tenant_weights = tenant_weights
         self.policy = BucketPolicy(
             resolve_rungs(bucket_rungs, int(max_batch_rows)))
         self.batcher = MicroBatcher(
             max_batch_rows=self.policy.max_rows,
             max_wait_s=float(max_wait_ms) / 1e3,
-            queue_cap=int(queue_cap), clock=clock, name=name)
+            queue_cap=int(queue_cap), clock=clock, name=name,
+            tenant_weights=tenant_weights)
         if breaker is None:
-            threshold = _knob_int("serve_breaker_threshold")
-            window_failures = _knob_int("serve_breaker_window_failures")
-            if threshold == 0 and window_failures == 0:
-                # both trip conditions knobbed off == breaker off (the
-                # env-level opt-out; breaker=False is the code-level
-                # one) — a breaker that can never open is just overhead
-                breaker = None
-            else:
-                breaker = CircuitBreaker(
-                    name,
-                    failure_threshold=threshold,
-                    window=_knob_int("serve_breaker_window"),
-                    window_failures=window_failures,
-                    cooldown_s=_knob_float("serve_breaker_cooldown_ms")
-                    / 1e3,
-                    clock=clock)
+            breaker = _breaker_from_knobs(name, clock)
         elif breaker is False:
             breaker = None
         self.breaker = breaker
@@ -362,8 +404,9 @@ class Service:
                 self.name, self.dim, tuple(q.shape))
         return q.astype(self.dtype)
 
-    def submit(self, queries, timeout: Optional[float] = None
-               ) -> ServeFuture:
+    def submit(self, queries, timeout: Optional[float] = None, *,
+               tenant: Optional[str] = None,
+               tier: int = 0) -> ServeFuture:
         """Enqueue a query block; returns a future resolving to this
         service's result slice for exactly those rows.
 
@@ -371,6 +414,12 @@ class Service:
         it expires while the request is still queued, the future fails
         with :class:`~raft_tpu.core.error.CommTimeoutError` instead of
         occupying a batch (deadline-aware shedding).
+
+        ``tenant`` tags the request for weighted-fair traffic shaping
+        (None = the default tenant) and ``tier`` is the priority
+        override applied before earliest-deadline-first ordering within
+        the tenant's share (lower = more urgent; docs/SERVING.md
+        "Traffic shaping").
 
         Unavailability sheds FAST with
         :class:`~raft_tpu.core.error.ServiceUnavailableError` before
@@ -387,11 +436,16 @@ class Service:
         self._check_available()
         deadline_t = None if timeout is None else self._clock() + timeout
         try:
-            fut = self.batcher.submit(q, int(q.shape[0]), deadline_t)
-        except ServiceOverloadError:
+            fut = self.batcher.submit(q, int(q.shape[0]), deadline_t,
+                                      tenant=tenant, tier=tier)
+        except ServiceOverloadError as e:
             _counter("raft_tpu_serve_rejected_total",
                      "requests shed by admission control",
                      self.name).inc()
+            if e.tenant is not None:
+                _tenant_counter("raft_tpu_serve_tenant_rejected_total",
+                                "requests shed by admission control, "
+                                "per tenant", self.name, e.tenant).inc()
             raise
         _counter("raft_tpu_serve_submitted_total",
                  "admitted requests", self.name).inc()
@@ -433,9 +487,13 @@ class Service:
                 self.breaker.retry_after())
 
     def submit_many(self, blocks: Sequence,
-                    timeout: Optional[float] = None) -> List[ServeFuture]:
-        """Submit several query blocks; one future each, same deadline."""
-        return [self.submit(b, timeout=timeout) for b in blocks]
+                    timeout: Optional[float] = None, *,
+                    tenant: Optional[str] = None,
+                    tier: int = 0) -> List[ServeFuture]:
+        """Submit several query blocks; one future each, same deadline
+        (and the same tenant/tier tags)."""
+        return [self.submit(b, timeout=timeout, tenant=tenant,
+                            tier=tier) for b in blocks]
 
     # ------------------------------------------------------------------ #
     # query-vector cache (the dormant cache/VecCache, wired in)
@@ -514,6 +572,16 @@ class Service:
         }
         if self.breaker is not None:
             out["breaker"] = self.breaker.describe()
+        if self.tenant_weights:
+            depths = self.batcher.tenant_depths()   # one lock pass
+            out["tenants"] = {
+                name: {"weight": w,
+                       "depth": depths.get(name, 0),
+                       "cap": self.batcher.tenant_cap(name)}
+                for name, w in self.batcher.tenants().items()}
+        rs = getattr(self, "_replica_set", None)
+        if rs is not None:
+            out["replicas"] = rs.describe()
         if self.axis is not None:
             out.update({
                 "sharded": True,
@@ -593,6 +661,25 @@ class KNNService(Service):
     sequence) re-partitions the full index over the surviving
     sub-mesh and the follow-up ``warmup()`` rebuilds every per-rung
     sharded executable.
+
+    Replica parameters (docs/SERVING.md "Traffic shaping")
+    ------------------------------------------------------
+    replicas:
+        Build this many replicas of the index over **disjoint**
+        sub-meshes of ``mesh`` (each replica itself sharded over its
+        group when the group holds more than one device), dispatched
+        through a :class:`~raft_tpu.serve.replicas.ReplicaSet`:
+        rotation with per-replica circuit breakers (a tripped replica
+        drops out instead of tripping the service) and **hedged
+        re-dispatch** of straggling batches with first-result-wins
+        resolution and loser cancellation.  Forces ``donate=False``
+        (a hedge must be able to re-dispatch the padded buffer).
+        Mutually composes with ``mesh``/``axis``/``merge``: they
+        describe the parent span the replicas are cut from.
+    hedge_ms:
+        Fixed hedge threshold in milliseconds; None resolves the
+        ``serve_hedge_ms`` knob (0 = adaptive per-rung p99 ×
+        ``serve_hedge_factor``, floored at ``serve_hedge_min_ms``).
     """
 
     def __init__(self, index, k: int,
@@ -601,6 +688,8 @@ class KNNService(Service):
                  mesh=None, axis: Optional[str] = None,
                  merge: Optional[str] = None,
                  group_size: Optional[int] = None,
+                 replicas: Optional[int] = None,
+                 hedge_ms: Optional[float] = None,
                  name: Optional[str] = None, **opts):
         index = jnp.asarray(index)
         expects(index.ndim == 2, "KNNService: (n, d) index required")
@@ -614,12 +703,44 @@ class KNNService(Service):
         self._precision = precision
         self._group_size = group_size
         self._spmd: Optional[_ShardState] = None
-        if mesh is not None or axis is not None:
+        self._replica_set = None
+        # resolved early (ANNService precedent): replica breakers and
+        # metric labels need the name before Service.__init__ runs
+        name = name or "knn%d" % next(_service_seq)
+        self.name = name
+        if replicas is not None:
+            expects(int(replicas) >= 2,
+                    "KNNService: replicas=%d (need >= 2; one replica "
+                    "is just a [sharded] service)", int(replicas))
+            mesh, axis, self.merge = _resolve_shard_spec(
+                "KNNService", mesh, axis, merge)
+            if hedge_ms is None:
+                hedge_ms = _knob_float("serve_hedge_ms")
+            self._hedge_s = (None if float(hedge_ms) <= 0.0
+                             else float(hedge_ms) / 1e3)
+            self._hedge_factor = _knob_float("serve_hedge_factor")
+            self._hedge_min_s = _knob_float("serve_hedge_min_ms") / 1e3
+            self._n_replicas = int(replicas)
+            self._replica_axis = axis
+            self._replica_parent = mesh
+            # hedged re-dispatch must be able to replay the padded
+            # buffer on a second replica — same rule as a RetryPolicy
+            opts["donate"] = False
+            self._replica_set = self._build_replica_set(
+                mesh, axis, self._n_replicas,
+                opts.get("clock", time.monotonic))
+        elif mesh is not None or axis is not None:
             mesh, axis, self.merge = _resolve_shard_spec(
                 "KNNService", mesh, axis, merge)
             self._shard_to(mesh, axis)
 
         def execute(padded):
+            rs = self._replica_set     # ONE snapshot per batch
+            if rs is not None:
+                # rotation + per-replica breakers + hedged dispatch
+                # (raft_tpu/serve/replicas.py); the returned result is
+                # already device-ready (the winning arm blocked)
+                return rs.run(padded)
             spmd = self._spmd          # ONE snapshot per batch
             if spmd is not None:
                 # ONE SPMD program per bucket rung: per-shard search,
@@ -648,7 +769,7 @@ class KNNService(Service):
                                    donate_queries=self.donate)
 
         super().__init__(
-            name or "knn%d" % next(_service_seq), execute,
+            name, execute,
             dim=index.shape[1], dtype=index.dtype, **opts)
         if self.axis is not None:   # gauge deferred until named
             _gauge("raft_tpu_serve_shard_devices",
@@ -664,6 +785,118 @@ class KNNService(Service):
     @property
     def axis(self) -> Optional[str]:
         return self._spmd.axis if self._spmd is not None else None
+
+    # -- replica groups + hedged dispatch (docs/SERVING.md "Traffic
+    #    shaping"; raft_tpu/serve/replicas.py) ----------------------- #
+    def _replica_group_size(self, mesh) -> Optional[int]:
+        """The pinned hierarchical group size, dropped when it does not
+        divide a replica sub-mesh's axis (the `_drop_stale_group_size`
+        rule applied per group)."""
+        g = self._group_size
+        if g and int(mesh.shape[self._replica_axis]) % int(g):
+            return None
+        return g
+
+    def _build_replica_set(self, parent_mesh, axis: str, n: int, clock):
+        """Cut ``parent_mesh`` into ``n`` disjoint sub-meshes, commit a
+        full copy of the index (row-sharded) to each, and wrap them in
+        a :class:`~raft_tpu.serve.replicas.ReplicaSet` with fresh
+        per-replica breakers."""
+        from raft_tpu.serve.replicas import ReplicaSet, split_mesh
+        from raft_tpu.spatial.mnmg_knn import mnmg_knn, shard_knn_index
+
+        members = []
+        for m in split_mesh(parent_mesh, axis, n):
+            index_p, n_rows = shard_knn_index(self.index, m, axis)
+            state = _ShardState(index_p, n_rows, m, axis)
+
+            def exec_replica(padded, st=state):
+                # donation stays off: a hedge re-dispatches the SAME
+                # padded buffer on another replica
+                return mnmg_knn(st.index, padded, self.k,
+                                metric=self.metric, mesh=st.mesh,
+                                axis=st.axis, n_rows=st.n_rows,
+                                tile_n=self._tile_n,
+                                precision=self._precision,
+                                merge=self.merge,
+                                group_size=self._replica_group_size(
+                                    st.mesh),
+                                donate_queries=False)
+
+            members.append((m, exec_replica))
+        breakers = [_breaker_from_knobs("%s/r%d" % (self.name, i),
+                                        clock)
+                    for i in range(len(members))]
+        return ReplicaSet(self.name, members,
+                          hedge_s=self._hedge_s,
+                          hedge_factor=self._hedge_factor,
+                          hedge_min_s=self._hedge_min_s,
+                          breakers=breakers, clock=clock)
+
+    def replica_device_ids(self) -> Optional[set]:
+        """Device ids the replica set spans (None when not replicated);
+        session ``health_check`` validates them against the current
+        mesh."""
+        rs = self._replica_set
+        return rs.device_ids() if rs is not None else None
+
+    def rebuild_replicas(self, mesh=None) -> bool:
+        """Re-cut the replica groups over ``mesh`` (default: the owning
+        session's current mesh) — the replica-loss recovery lever.  A
+        survivor mesh too small for 2 replicas degrades to plain
+        sharded serving over the whole mesh (capacity over redundancy;
+        a later rebuild on a grown mesh restores the replicas).  Fresh
+        per-replica breakers — the old failure history described the
+        pre-recovery world.  Call ``warmup()`` after.  True when the
+        mesh changed."""
+        expects(self._replica_set is not None or self._spmd is not None,
+                "%s.rebuild_replicas: service was not built with "
+                "replicas", self.name)
+        if mesh is None:
+            session = getattr(self, "_session", None)
+            comms = getattr(session, "comms", None)
+            if (comms is not None
+                    and self._replica_axis in comms.mesh.axis_names):
+                mesh = comms.mesh
+            else:
+                mesh = self._replica_parent
+        changed = mesh is not self._replica_parent
+        n = min(self._n_replicas, int(mesh.devices.size))
+        if n >= 2:
+            self._replica_parent = mesh
+            self._spmd = None
+            self._replica_set = self._build_replica_set(
+                mesh, self._replica_axis, n, self._clock)
+        else:
+            # survivors cannot host two disjoint replicas: serve the
+            # whole (1-device) mesh sharded, un-replicated
+            self._replica_set = None
+            self._replica_parent = mesh
+            self._shard_to(mesh, self._replica_axis)
+        if changed:
+            self._record_repartition_replicas(mesh)
+        return changed
+
+    def _record_repartition_replicas(self, mesh) -> None:
+        _counter("raft_tpu_serve_repartitions_total",
+                 "sharded-index re-partitions (shard-loss recovery)",
+                 self.name).inc()
+        _gauge("raft_tpu_serve_shard_devices",
+               "devices the service's sharded index spans (0/absent = "
+               "single-device)", self.name).set(
+                   int(mesh.devices.size))
+
+    def warmup(self) -> "Service":
+        rs = self._replica_set
+        if rs is None:
+            return super().warmup()
+        # every replica sub-mesh compiles its own per-rung executables
+        # — hedged dispatch may route any rung to any replica, so the
+        # zero-steady-state-compiles proof needs the full product
+        for rung in self.policy.rungs:
+            rs.warm(jnp.zeros((rung, self.dim), self.dtype))
+        self._warmed = self.policy.rungs
+        return self
 
     def _shard_to(self, mesh, axis: str) -> None:
         """(Re-)partition the pinned index rows over ``axis`` and
@@ -704,8 +937,14 @@ class KNNService(Service):
     def post_recover(self) -> None:
         """Re-partition onto the rebuilt session mesh after a
         communicator recovery (RecoveryManager step 4; the follow-up
-        ``warmup()`` rebuilds the sharded executables)."""
-        if self.axis is not None:
+        ``warmup()`` rebuilds the sharded/replicated executables).
+        Keyed off the CONSTRUCTOR's replica intent, not the current
+        replica set: a service degraded to unreplicated by a tiny
+        survivor mesh must regain its replicas when a later recovery
+        regrows the mesh."""
+        if getattr(self, "_n_replicas", 0):
+            self.rebuild_replicas()
+        elif self.axis is not None:
             self.repartition()
 
 

@@ -335,7 +335,17 @@ class Comms:
             services = {}
             for name, svc in self._services.items():
                 s = svc.stats()
-                if getattr(svc, "axis", None) is not None:
+                replica_ids = None
+                if callable(getattr(svc, "replica_device_ids", None)):
+                    replica_ids = svc.replica_device_ids()
+                if replica_ids is not None:
+                    # replicated service: every replica sub-mesh must
+                    # still be carried by the (possibly rebuilt)
+                    # session mesh — flag a stale replica span before
+                    # its next dispatch breaks (rebuild_replicas via
+                    # post_recover is the repair lever)
+                    s["mesh_ok"] = replica_ids <= mesh_devices
+                elif getattr(svc, "axis", None) is not None:
                     # validate the sharded service's mesh assumptions
                     # against the CURRENT session mesh: after recover()
                     # rebuilt the communicator on a sub-mesh, a service
@@ -471,9 +481,16 @@ class Comms:
         ``index``, ``k``, ``nprobe``, ``delta_cap``, ...), plus the
         shared service options (``max_batch_rows``, ``bucket_rungs``,
         ``max_wait_ms``, ``queue_cap``, ``retry_policy``,
-        ``query_cache_size``).  The session defaults ``retry_policy``
-        to its own verb policy so per-batch watchdog/retry semantics
-        match the communicator's.
+        ``tenant_weights``, ``query_cache_size``).  The session
+        defaults ``retry_policy`` to its own verb policy so per-batch
+        watchdog/retry semantics match the communicator's.
+
+        ``serve(kind="knn", replicas=R, ...)`` builds R replicas of
+        the service over disjoint sub-meshes of the session mesh with
+        hedged dispatch of straggling batches (docs/SERVING.md
+        "Traffic shaping"); ``health_check`` validates every replica's
+        devices against the session mesh and ``post_recover`` re-cuts
+        the groups after a mesh rebuild.
 
         Registration is what buys the lifecycle guarantees:
         :meth:`health_check` reports the service and :meth:`destroy`
@@ -494,12 +511,13 @@ class Comms:
         expects(name is None or name not in self._services,
                 "serve: a service named %r is already registered", name)
         kwargs.setdefault("retry_policy", self.retry_policy)
-        if (kwargs.get("axis") is not None
+        if ((kwargs.get("axis") is not None
+             or kwargs.get("replicas") is not None)
                 and kwargs.get("mesh") is None):
-            # sharded service on the session: shard over THE session
-            # mesh (docs/SERVING.md "Sharded serving") so recover() /
-            # post_recover re-partitioning and health_check mesh
-            # validation all talk about the same mesh
+            # sharded/replicated service on the session: span THE
+            # session mesh (docs/SERVING.md "Sharded serving"/"Traffic
+            # shaping") so recover() / post_recover re-partitioning and
+            # health_check mesh validation all talk about the same mesh
             kwargs["mesh"] = self.comms.mesh
         svc = kinds[kind](name=name, **kwargs)
         # bind the owning session: sharded services re-partition onto

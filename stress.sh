@@ -15,7 +15,15 @@
 # seeded serve-seam faults plus a mid-run simulated device loss and
 # asserts every submitted request resolves exactly once with a result
 # or typed error (docs/FAULT_MODEL.md "Serving failure model"); a
-# failure reproduces with the printed seed.
+# failure reproduces with the printed seed.  Every other round also
+# runs the sharded shard-kill variant and the hedged-dispatch variant
+# (--hedge-chaos: one replica straggles under a persistent Delay;
+# hedges must fire and win with exactly-once resolution).
+#
+# `./stress.sh tenants [N]` loops the mixed-tenant traffic-shaping
+# scenario N times with rotating seeds: closed-loop interactive
+# clients + an open-loop bulk flood through weighted-fair admission;
+# exits non-zero if any shed was untyped (missing retry_after_s).
 #
 # `./stress.sh serve [N]` loops the serving-layer suite N times
 # (default 10) with a rotating data/submit-order seed
@@ -53,7 +61,27 @@ if [[ "${1:-}" == "chaos" ]]; then
                 --seed "$i" --duration 3 --concurrency 4 \
                 --index-rows 3000 --dim 16 --k 5 \
                 --max-batch-rows 64 --max-wait-ms 1
+        else
+            # hedged-dispatch variant: one replica straggles under a
+            # persistent Delay; hedges fire+win, losers cancel, every
+            # admitted request resolves exactly once, 0 compiles
+            echo "== serve chaos hedge $i/$n (seed=$i) =="
+            python tools/loadgen.py --hedge-chaos --replicas 2 \
+                --hedge-ms 60 --seed "$i" --duration 3 \
+                --concurrency 4 --index-rows 3000 --dim 16 --k 5 \
+                --max-batch-rows 64 --max-wait-ms 1
         fi
+    done
+    exit 0
+fi
+if [[ "${1:-}" == "tenants" ]]; then
+    n="${2:-10}"
+    for i in $(seq 1 "$n"); do
+        echo "== mixed-tenant stress $i/$n (seed=$i) =="
+        python tools/loadgen.py --tenants --seed "$i" --duration 3 \
+            --concurrency 4 --bulk-qps 150 --bulk-rows 16 \
+            --index-rows 5000 --dim 32 --k 10 --max-batch-rows 64 \
+            --max-wait-ms 1 --queue-cap 64
     done
     exit 0
 fi

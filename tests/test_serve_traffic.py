@@ -1,0 +1,677 @@
+"""Traffic shaping (docs/SERVING.md "Traffic shaping"): multi-tenant
+weighted-fair admission, EDF/tier ordering, replica groups with hedged
+dispatch and loser cancellation.
+
+Deterministic halves drive a FakeClock through the injectable-clock
+seam and step the worker manually; the hedging halves use real worker
+threads (the hedge race is inherently concurrent) with fixed hedge
+thresholds so the straggler/winner roles are scripted by injected
+``Delay``/``FailNth`` faults, not timing luck.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import config
+from raft_tpu.comms import faults
+from raft_tpu.core.error import (
+    LogicError,
+    RaftError,
+    ServiceOverloadError,
+    ServiceUnavailableError,
+)
+from raft_tpu.core.metrics import default_registry
+from raft_tpu.core.profiler import compile_cache_stats
+from raft_tpu.serve import (
+    KNNService,
+    MicroBatcher,
+    inject_replica,
+    split_mesh,
+)
+from raft_tpu.spatial.knn import brute_force_knn
+
+pytestmark = pytest.mark.serve
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _misses():
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
+
+
+def _reg_total(name):
+    return int(default_registry().family_total(name))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def index(rng):
+    return jnp.asarray(rng.standard_normal((400, 16)), jnp.float32)
+
+
+# ---------------------------------------------------------------------- #
+# weighted-fair share math (fake clock, no threads)
+# ---------------------------------------------------------------------- #
+class TestWeightedFair:
+    def make(self, weights, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_batch_rows", 16)
+        kw.setdefault("max_wait_s", 0.010)
+        kw.setdefault("queue_cap", 16)
+        return MicroBatcher(clock=clock, tenant_weights=weights,
+                            **kw), clock
+
+    def test_shares_split_by_weight(self):
+        """Both tenants saturated: a 3:1 weight split of a 16-row
+        window is 12 rows vs 4 rows, every window."""
+        b, clock = self.make({"a": 3, "b": 1}, queue_cap=64)
+        for i in range(14):
+            b.submit(("a", i), 1, tenant="a")
+        for i in range(4):
+            b.submit(("b", i), 1, tenant="b")
+        clock.advance(0.02)
+        batch = b.take()
+        tenants = [r.tenant for r in batch]
+        assert tenants.count("a") == 12
+        assert tenants.count("b") == 4
+
+    def test_unused_share_redistributed_to_busy_tenant(self):
+        """Only bulk queued: it gets the WHOLE window — an idle
+        tenant's share is never wasted."""
+        b, clock = self.make({"a": 3, "b": 1}, queue_cap=64)
+        for i in range(16):
+            b.submit(("b", i), 1, tenant="b")
+        clock.advance(0.02)
+        batch = b.take()
+        assert len(batch) == 16
+        assert all(r.tenant == "b" for r in batch)
+
+    def test_active_backlog_bounded_by_quota(self):
+        """THE isolation property: an active tenant's backlog cannot
+        stuff the shared window past its quota — a's 2 rows ride with
+        at most b's 4-row share, NOT a 14-row bulk backfill (backfill
+        would inflate every batch's exec time and hand the bulk
+        backlog to the interactive class as latency)."""
+        b, clock = self.make({"a": 3, "b": 1}, queue_cap=64)
+        for i in range(2):
+            b.submit(("a", i), 1, tenant="a")
+        for i in range(16):
+            b.submit(("b", i), 1, tenant="b")
+        clock.advance(0.02)
+        batch = b.take()
+        tenants = [r.tenant for r in batch]
+        assert tenants.count("a") == 2
+        assert tenants.count("b") == 4       # b's quota, no backfill
+
+    def test_deficit_carries_big_request_across_windows(self):
+        """A request bigger than one window's share accumulates
+        deficit instead of starving — and requests never split."""
+        b, clock = self.make({"a": 1, "b": 1})
+        b.submit("a-big", 10, tenant="a")    # share is 8: waits once
+        b.submit("b-ok", 6, tenant="b")
+        clock.advance(0.02)
+        assert [r.payload for r in b.take()] == ["b-ok"]
+        b.submit("b-late", 6, tenant="b")
+        clock.advance(0.02)
+        # a's carried deficit (8 + 8 = 16 >= 10) admits the big
+        # request; b serves its own share alongside
+        payloads = [r.payload for r in b.take()]
+        assert "a-big" in payloads and "b-late" in payloads
+        b2, clock2 = self.make({"a": 1, "b": 1})
+        b2.submit("a-big", 12, tenant="a")
+        b2.submit("b1", 6, tenant="b")
+        b2.submit("b2", 2, tenant="b")
+        clock2.advance(0.02)
+        payloads = [r.payload for r in b2.take()]
+        # a's 12-row request exceeds its first-window share: it waits
+        assert payloads == ["b1", "b2"]
+        payloads = [r.payload for r in b2.take()]
+        assert payloads == ["a-big"]
+
+    def test_per_tenant_cap_sheds_typed_with_hint(self):
+        b, _ = self.make({"a": 3, "b": 1}, queue_cap=8)
+        assert b.tenant_cap("a") == 6
+        assert b.tenant_cap("b") == 2
+        for i in range(2):
+            b.submit(("b", i), 1, tenant="b")
+        with pytest.raises(ServiceOverloadError) as ei:
+            b.submit(("b", 9), 1, tenant="b")
+        assert ei.value.tenant == "b"
+        assert ei.value.queue_cap == 2
+        assert ei.value.retry_after_s > 0.0
+        # the other tenant still admits: shed isolation
+        b.submit(("a", 0), 1, tenant="a")
+
+    def test_unknown_tenant_autoregisters_at_weight_one(self):
+        b, clock = self.make({"a": 3})
+        b.submit("x", 1, tenant="surprise")
+        assert b.tenants()["surprise"] == 1.0
+        clock.advance(0.02)
+        assert len(b.take()) == 1
+
+    def test_single_queue_service_unchanged(self):
+        """No tenant_weights: one implicit default tenant with the
+        full cap and the full window — the pre-tenancy behavior."""
+        clock = FakeClock()
+        b = MicroBatcher(max_batch_rows=16, max_wait_s=0.01,
+                         queue_cap=4, clock=clock)
+        assert b.tenant_cap("default") == 4
+        for i in range(4):
+            b.submit(i, 1)
+        with pytest.raises(ServiceOverloadError) as ei:
+            b.submit("over", 1)
+        assert ei.value.queue_cap == 4
+        assert ei.value.retry_after_s > 0.0
+
+    def test_drain_estimate_tracks_batch_time(self):
+        b, clock = self.make({"a": 1}, queue_cap=4)
+        for i in range(4):
+            b.submit(i, 1, tenant="a")
+        with pytest.raises(ServiceOverloadError) as e1:
+            b.submit("x", 1, tenant="a")
+        b.note_batch_seconds(2.0)
+        with pytest.raises(ServiceOverloadError) as e2:
+            b.submit("x", 1, tenant="a")
+        assert e2.value.retry_after_s > e1.value.retry_after_s
+
+
+# ---------------------------------------------------------------------- #
+# EDF + tiers (fake clock, no threads)
+# ---------------------------------------------------------------------- #
+class TestEDF:
+    def make(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_batch_rows", 4)
+        kw.setdefault("max_wait_s", 0.010)
+        kw.setdefault("queue_cap", 16)
+        return MicroBatcher(clock=clock, **kw), clock
+
+    def test_edf_beats_fifo_within_tenant(self):
+        b, clock = self.make()
+        b.submit("late", 1, deadline_t=10.0)
+        b.submit("soon", 1, deadline_t=1.0)
+        b.submit("mid", 1, deadline_t=5.0)
+        clock.advance(0.02)
+        assert [r.payload for r in b.take()] == ["soon", "mid", "late"]
+
+    def test_no_deadline_sorts_after_deadlines_fifo(self):
+        b, clock = self.make()
+        b.submit("n1", 1)
+        b.submit("d", 1, deadline_t=99.0)
+        b.submit("n2", 1)
+        clock.advance(0.02)
+        assert [r.payload for r in b.take()] == ["d", "n1", "n2"]
+
+    def test_tier_overrides_deadline(self):
+        b, clock = self.make()
+        b.submit("urgent-far", 1, deadline_t=100.0, tier=-1)
+        b.submit("normal-soon", 1, deadline_t=1.0)
+        clock.advance(0.02)
+        assert [r.payload for r in b.take()] == ["urgent-far",
+                                                 "normal-soon"]
+
+    def test_fifo_preserved_without_deadlines(self):
+        """Determinism regression: equal keys dispatch in submission
+        order (the seq tie-break)."""
+        b, clock = self.make()
+        for name in ("a", "b", "c", "d"):
+            b.submit(name, 1)
+        clock.advance(0.02)
+        assert [r.payload for r in b.take()] == ["a", "b", "c", "d"]
+
+    def test_requeued_request_listed_once_at_shutdown(self):
+        """A popped-then-requeued request leaves a stale entry in the
+        arrival view; shutdown must list (and fail) it exactly once."""
+        b, clock = self.make()
+        b.submit("keep", 1)
+        b.submit("ride", 1)
+        clock.advance(0.02)
+        batch = b.take()
+        assert len(batch) == 2
+        assert b.requeue(batch)
+        leftovers = b.shutdown()
+        assert [r.payload for r in leftovers] == ["keep", "ride"]
+
+    def test_requeue_served_before_everything(self):
+        b, clock = self.make()
+        b.submit("fresh-soon", 1, deadline_t=0.5)
+        clock.advance(0.02)
+        batch = b.take()
+        assert [r.payload for r in batch] == ["fresh-soon"]
+        b.submit("newer", 1, deadline_t=0.1)
+        assert b.requeue(batch)
+        clock.advance(0.02)
+        assert [r.payload for r in b.take()] == ["fresh-soon", "newer"]
+
+    def test_service_submit_threads_tenant_and_tier(self, index, rng):
+        clock = FakeClock()
+        svc = KNNService(index, k=3, start=False, clock=clock,
+                         max_batch_rows=32, max_wait_ms=10.0,
+                         tenant_weights={"i": 2, "b": 1},
+                         name="traffic%d" % SEED)
+        q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+        svc.submit(q, tenant="i", tier=1)
+        svc.submit(q, tenant="b")
+        assert svc.batcher.tenant_depths() == {"i": 1, "b": 1}
+        st = svc.stats()
+        assert st["tenants"]["i"]["weight"] == 2.0
+        assert st["tenants"]["b"]["depth"] == 1
+        clock.advance(0.5)
+        assert svc.worker.run_once()
+        # per-tenant served counters flowed
+        fam = default_registry().get("raft_tpu_serve_tenant_rows_total")
+        vals = {(lbl["service"], lbl["tenant"]): s.value
+                for lbl, s in fam.series()}
+        assert vals[(svc.name, "i")] == 2
+        assert vals[(svc.name, "b")] == 2
+        svc.close()
+
+    def test_tenant_weights_knob_resolves(self, index):
+        config.configure(serve_tenant_weights="x:5,y:1")
+        try:
+            svc = KNNService(index, k=3, start=False, max_batch_rows=16)
+            assert svc.batcher.tenants() == {"x": 5.0, "y": 1.0}
+            svc.close()
+        finally:
+            config.configure(serve_tenant_weights=None)
+
+
+# ---------------------------------------------------------------------- #
+# replica groups: identity, rotation, warmup
+# ---------------------------------------------------------------------- #
+class TestReplicas:
+    def test_split_mesh_disjoint(self):
+        from raft_tpu.comms.host_comms import default_mesh
+
+        mesh = default_mesh()
+        groups = split_mesh(mesh, mesh.axis_names[0], 2)
+        ids = [set(int(d.id) for d in g.devices.ravel())
+               for g in groups]
+        assert ids[0] & ids[1] == set()
+        assert len(ids[0] | ids[1]) == mesh.devices.size
+        with pytest.raises(RaftError):
+            split_mesh(mesh, mesh.axis_names[0], 1)
+        with pytest.raises(RaftError):
+            split_mesh(mesh, mesh.axis_names[0], 99)
+
+    def test_replicated_matches_unbatched(self, index, rng):
+        q = jnp.asarray(rng.standard_normal((6, 16)), jnp.float32)
+        d0, i0 = brute_force_knn(index, q, 5)
+        svc = KNNService(index, k=5, replicas=2, hedge_ms=5000.0,
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         max_wait_ms=1.0)
+        try:
+            assert svc.donate is False   # hedging forces donation off
+            for _ in range(3):           # rotation covers both replicas
+                out = svc.submit(jnp.copy(q)).result(timeout=60)
+                np.testing.assert_array_equal(np.asarray(out[1]),
+                                              np.asarray(i0))
+                np.testing.assert_allclose(np.asarray(out[0]),
+                                           np.asarray(d0),
+                                           rtol=1e-4, atol=1e-4)
+            st = svc.stats()["replicas"]
+            assert len(st["replicas"]) == 2
+            devs = [set(r["devices"]) for r in st["replicas"]]
+            assert devs[0] & devs[1] == set()
+        finally:
+            svc.close()
+
+    def test_zero_postwarmup_compiles_with_hedging(self, index, rng):
+        svc = KNNService(index, k=5, replicas=2, hedge_ms=50.0,
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            m0 = _misses()
+            # a hedged batch (replica 0 straggles) must hit only warmed
+            # executables on the OTHER replica too
+            with inject_replica(svc, 0, faults.Delay(0.6)):
+                q = jnp.asarray(rng.standard_normal((4, 16)),
+                                jnp.float32)
+                for _ in range(3):
+                    svc.submit(jnp.copy(q)).result(timeout=60)
+            time.sleep(0.8)          # abandoned losers wake and bail
+            assert _misses() == m0
+            assert _reg_total("raft_tpu_comms_host_staged_bytes") == 0
+        finally:
+            svc.close()
+
+    def test_hedge_fires_and_loser_cancels_exactly_once(self, index,
+                                                        rng):
+        """THE hedging acceptance: Delay on one replica -> the hedge
+        resolves every future exactly once with the exact result, the
+        win/cancel counters move, and the delayed loser never
+        resolves anything."""
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        _, i0 = brute_force_knn(index, q, 5)
+        svc = KNNService(index, k=5, replicas=2, hedge_ms=60.0,
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            h0 = _reg_total("raft_tpu_serve_hedges_total")
+            w0 = _reg_total("raft_tpu_serve_hedge_wins_total")
+            c0 = _reg_total("raft_tpu_serve_hedge_cancelled_total")
+            with inject_replica(svc, 0, faults.Delay(0.8)):
+                futs = [svc.submit(jnp.copy(q)) for _ in range(4)]
+                outs = [f.result(timeout=60) for f in futs]
+            for out in outs:
+                np.testing.assert_array_equal(np.asarray(out[1]),
+                                              np.asarray(i0))
+            fired = _reg_total("raft_tpu_serve_hedges_total") - h0
+            wins = _reg_total("raft_tpu_serve_hedge_wins_total") - w0
+            cancelled = _reg_total(
+                "raft_tpu_serve_hedge_cancelled_total") - c0
+            assert fired > 0 and wins > 0
+            assert cancelled == fired   # exactly one loser per hedge
+            # the loser wakes, sees the abandon mark, and bails; every
+            # future is already resolved exactly once (result() above)
+            time.sleep(1.0)
+            for f in futs:
+                assert f.done() and f.exception(timeout=0) is None
+        finally:
+            svc.close()
+
+    def test_tripped_replica_drops_out_and_heals(self, index, rng):
+        """Persistent failure on replica 0: its OWN breaker trips it
+        out of rotation (failover keeps batches succeeding, the
+        service breaker stays closed); after the fault clears a
+        half-open probe re-closes it."""
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        _, i0 = brute_force_knn(index, q, 5)
+        with config.override(serve_breaker_threshold="1",
+                             serve_breaker_cooldown_ms="1000"):
+            svc = KNNService(index, k=5, replicas=2, hedge_ms=5000.0,
+                             max_batch_rows=32, bucket_rungs=(8, 32),
+                             max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            f0 = _reg_total("raft_tpu_serve_replica_failovers_total")
+            with inject_replica(svc, 0,
+                                faults.FailNth(1, persistent=True)):
+                for _ in range(4):
+                    out = svc.submit(jnp.copy(q)).result(timeout=60)
+                    np.testing.assert_array_equal(np.asarray(out[1]),
+                                                  np.asarray(i0))
+                states = {r["idx"]: r["state"] for r in
+                          svc.stats()["replicas"]["replicas"]}
+                # tripped OUT of rotation (a slow run may already have
+                # cooled into the half-open probe window — still out
+                # of closed rotation, which is the contract)
+                assert states[0] in ("open", "half_open")
+                assert states[1] == "closed"
+            assert (_reg_total("raft_tpu_serve_replica_failovers_total")
+                    - f0) >= 1
+            # the service-level breaker never saw a failure: every
+            # batch succeeded via failover/rotation
+            assert svc.breaker.describe()["state"] == "closed"
+            time.sleep(1.05)         # cooldown: replica 0 half-opens
+            for _ in range(4):
+                svc.submit(jnp.copy(q)).result(timeout=60)
+            states = {r["idx"]: r["state"] for r in
+                      svc.stats()["replicas"]["replicas"]}
+            assert states[0] == "closed"
+        finally:
+            svc.close()
+
+    def test_all_replicas_tripped_sheds_typed(self, index, rng):
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        with config.override(serve_breaker_threshold="1",
+                             serve_breaker_cooldown_ms="60000"):
+            svc = KNNService(index, k=5, replicas=2, hedge_ms=5000.0,
+                             max_batch_rows=32, bucket_rungs=(8, 32),
+                             max_wait_ms=0.5, breaker=False)
+        try:
+            with inject_replica(svc, 0,
+                                faults.FailNth(1, persistent=True)):
+                with inject_replica(svc, 1,
+                                    faults.FailNth(1, persistent=True)):
+                    errs = []
+                    for _ in range(4):
+                        fut = svc.submit(jnp.copy(q))
+                        errs.append(fut.exception(timeout=60))
+            # first failures relay the injected error; once both
+            # breakers trip, batches shed replicas_exhausted — every
+            # future resolves exactly once with a TYPED error
+            assert all(isinstance(e, RaftError) for e in errs)
+            assert any(isinstance(e, ServiceUnavailableError)
+                       and e.reason == "replicas_exhausted"
+                       for e in errs)
+        finally:
+            svc.close()
+
+    def test_session_serve_replicas_and_health(self, index):
+        from raft_tpu.session import Comms
+
+        s = Comms().init()
+        try:
+            svc = s.serve("knn", index=index, k=3, replicas=2,
+                          max_batch_rows=32, bucket_rungs=(8, 32),
+                          name="rep-knn", retry_policy=None)
+            assert svc.replica_device_ids() == set(
+                int(d.id) for d in s.comms.mesh.devices.ravel())
+            report = s.health_check()
+            assert report["services"]["rep-knn"]["mesh_ok"] is True
+            assert report["ok"]
+        finally:
+            s.destroy()
+
+    def test_rebuild_replicas_on_shrunk_mesh(self, index, rng):
+        """Replica-loss recovery: rebuild over a smaller mesh re-cuts
+        the groups; a 1-device survivor degrades to plain sharded
+        serving but keeps answering exactly."""
+        from raft_tpu.comms.host_comms import default_mesh
+
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        _, i0 = brute_force_knn(index, q, 5)
+        svc = KNNService(index, k=5, replicas=2, hedge_ms=5000.0,
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         max_wait_ms=0.5)
+        try:
+            assert svc.rebuild_replicas(default_mesh(4)) is True
+            svc.warmup()
+            st = svc.stats()["replicas"]
+            assert len(st["replicas"]) == 2
+            assert svc.replica_device_ids() == {0, 1, 2, 3}
+            out = svc.submit(jnp.copy(q)).result(timeout=60)
+            np.testing.assert_array_equal(np.asarray(out[1]),
+                                          np.asarray(i0))
+            # degrade path: 1 device cannot host 2 disjoint replicas
+            assert svc.rebuild_replicas(default_mesh(1)) is True
+            svc.warmup()
+            assert svc._replica_set is None
+            assert svc.axis is not None      # plain sharded fallback
+            out = svc.submit(jnp.copy(q)).result(timeout=60)
+            np.testing.assert_array_equal(np.asarray(out[1]),
+                                          np.asarray(i0))
+            # a regrown mesh RESTORES replication (post_recover keys
+            # off the constructor's intent, not the degraded state)
+            assert svc.rebuild_replicas(default_mesh(8)) is True
+            svc.warmup()
+            assert svc._replica_set is not None
+            assert len(svc.stats()["replicas"]["replicas"]) == 2
+            out = svc.submit(jnp.copy(q)).result(timeout=60)
+            np.testing.assert_array_equal(np.asarray(out[1]),
+                                          np.asarray(i0))
+        finally:
+            svc.close()
+
+    def test_replicas_reject_bad_config(self, index):
+        with pytest.raises(RaftError):
+            KNNService(index, k=3, replicas=1, start=False)
+
+    def test_adaptive_threshold_needs_samples(self, index):
+        svc = KNNService(index, k=3, replicas=2, hedge_ms=0.0,
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         start=False)
+        try:
+            rs = svc._replica_set
+            assert rs.hedge_s is None
+            assert rs.hedge_after(8) is None     # cold: never hedge
+            for _ in range(5):
+                rs.tracker.observe(8, 0.010)
+            # adaptive: max(factor * p99, floor) with defaults
+            # factor=1.5, min=10ms -> 15ms
+            assert rs.hedge_after(8) == pytest.approx(0.015)
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# overload-taxonomy satellites
+# ---------------------------------------------------------------------- #
+class TestOverloadTaxonomy:
+    def test_error_carries_tenant_and_hint(self):
+        e = ServiceOverloadError("m", 4, 4, tenant="bulk",
+                                 retry_after_s=1.5)
+        assert e.tenant == "bulk"
+        assert e.retry_after_s == 1.5
+        assert "tenant=bulk" in str(e)
+        e2 = ServiceOverloadError("m", 4, 4)
+        assert e2.tenant is None and e2.retry_after_s == 0.0
+
+    def test_ann_delta_shed_carries_hint(self, rng):
+        from raft_tpu.serve import ANNService
+        from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+        ref = jnp.asarray(rng.standard_normal((300, 8)), jnp.float32)
+        idx = ivf_flat_build(ref, IVFFlatParams(nlist=8, nprobe=2))
+        svc = ANNService(idx, k=3, delta_cap=4, compact_rows=0,
+                         start=False)
+        try:
+            svc.insert([1, 2, 3, 4],
+                       rng.standard_normal((4, 8)).astype(np.float32))
+            with pytest.raises(ServiceOverloadError) as ei:
+                svc.insert([5], rng.standard_normal((1, 8)).astype(
+                    np.float32))
+            assert ei.value.retry_after_s > 0.0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# CI hygiene: the shed-hint audit
+# ---------------------------------------------------------------------- #
+class TestShedHintAudit:
+    def _check(self, tmp_path, relpath, src, monkeypatch):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(os.path.dirname(__file__),
+                                        "..", "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(mod, "REPO", str(tmp_path))
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        return mod.check_file(str(path))
+
+    def test_bare_shed_flagged(self, tmp_path, monkeypatch):
+        src = "raise ServiceOverloadError('full', 4, 4)\n"
+        probs = self._check(tmp_path, "raft_tpu/serve/bad.py", src,
+                            monkeypatch)
+        assert any("retry_after_s" in p for p in probs)
+
+    def test_hinted_shed_passes(self, tmp_path, monkeypatch):
+        src = ("raise ServiceOverloadError('full', 4, 4,\n"
+               "                           retry_after_s=0.5)\n")
+        assert self._check(tmp_path, "raft_tpu/serve/ok.py", src,
+                           monkeypatch) == []
+
+    def test_marker_exempts(self, tmp_path, monkeypatch):
+        src = ("raise ServiceOverloadError('full', 4, 4)"
+               "  # shed-hint-ok\n")
+        assert self._check(tmp_path, "raft_tpu/serve/marked.py", src,
+                           monkeypatch) == []
+
+    def test_outside_serve_not_audited(self, tmp_path, monkeypatch):
+        src = "raise ServiceOverloadError('full', 4, 4)\n"
+        assert self._check(tmp_path, "raft_tpu/spatial/out.py", src,
+                           monkeypatch) == []
+
+    def test_library_shed_sites_all_hinted(self):
+        """The audit holds on the real tree (the self-test above only
+        proves the checker; this proves the library)."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(os.path.dirname(__file__),
+                                        "..", "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        serve_dir = os.path.join(os.path.dirname(__file__), "..",
+                                 "raft_tpu", "serve")
+        problems = []
+        for fn in os.listdir(serve_dir):
+            if fn.endswith(".py"):
+                problems += [p for p in mod.check_file(
+                    os.path.join(serve_dir, fn))
+                    if "retry_after_s" in p]
+        assert problems == []
+
+
+# ---------------------------------------------------------------------- #
+# mixed-tenant loadgen scenario (threaded smoke)
+# ---------------------------------------------------------------------- #
+class TestMixedTenantLoadgen:
+    def test_mixed_run_reports_per_tenant_and_typed_sheds(self, rng):
+        from tools.loadgen import build_service, run_mixed_tenants
+
+        svc = build_service("knn", 2000, 16, 5, seed=SEED,
+                            max_batch_rows=64, max_wait_ms=1.0,
+                            queue_cap=32,
+                            tenant_weights={"interactive": 4,
+                                            "bulk": 1})
+        svc.warmup()
+        try:
+            rep = run_mixed_tenants(svc, duration=1.2,
+                                    interactive_concurrency=2,
+                                    bulk_qps=150.0, interactive_rows=2,
+                                    bulk_rows=16, seed=SEED)
+        finally:
+            svc.close()
+        assert set(rep["tenants"]) == {"interactive", "bulk"}
+        assert rep["tenants"]["interactive"]["requests_ok"] > 0
+        assert rep["untyped_sheds"] == 0
+        # the bulk flood sheds against its own share, interactive
+        # stays admitted (its closed loop can't exceed its cap)
+        assert rep["tenants"]["interactive"]["rejected"] == 0
+
+    def test_hedge_chaos_scenario(self, rng):
+        from tools.loadgen import build_service, run_hedge_chaos
+
+        svc = build_service("knn", 2000, 16, 5, seed=SEED,
+                            max_batch_rows=64, max_wait_ms=1.0,
+                            replicas=2, hedge_ms=60.0)
+        svc.warmup()
+        try:
+            rep = run_hedge_chaos(svc, duration=2.5, concurrency=3,
+                                  rows=4, seed=SEED, delay_s=0.4)
+        finally:
+            svc.close()
+        assert rep["chaos_ok"], rep
+        assert rep["hedge_wins"] > 0
+        assert rep["exactly_once"] and rep["typed_only"]
+        assert rep["post_warmup_compiles"] == 0

@@ -34,6 +34,17 @@ where it doubles as an end-to-end correctness check: recall 1.0).
 ``--recall-target`` calibrates ``nprobe`` to the target before the
 measured run (recall-targeted dispatch, docs/SERVING.md).
 
+``--tenants`` runs the **mixed-tenant traffic-shaping scenario**
+(docs/SERVING.md "Traffic shaping"): closed-loop interactive clients
+plus an open-loop bulk flood through one weighted-fair service,
+reporting per-tenant p50/p95/p99 and shed counts (every shed must be
+typed and carry ``retry_after_s`` — exit 1 otherwise).  ``./stress.sh
+tenants N`` loops it with rotating seeds.  ``--replicas R`` serves the
+kNN index replicated over R disjoint sub-meshes with hedged dispatch;
+``--hedge-chaos`` stalls one replica mid-run with a persistent
+``Delay`` and asserts exactly-once resolution with hedge wins and zero
+post-warmup compiles.
+
 ``--chaos`` runs the **seed-rotated chaos scenario** instead
 (docs/FAULT_MODEL.md "Serving failure model"): seeded transient faults
 at the serve seam for the whole run, a persistent serve-seam outage
@@ -151,7 +162,7 @@ def make_query_pool(ref, rows, n=32, seed=1, noise=0.1):
 
 def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
                   nlist=None, nprobe=None, train_rows=None,
-                  mesh_devices=None, **opts):
+                  mesh_devices=None, replicas=None, **opts):
     """A ready (not yet warmed) service over a synthetic index.
 
     ``kind="ann"`` builds an IVF-Flat index over the data first
@@ -165,13 +176,25 @@ def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
     serving"): the index row-/slot-shards over a 1-D mesh spanning the
     first N local devices, and every batch dispatches into the pjit'd
     SPMD search (``merge=`` in ``opts`` picks the topology).  kNN and
-    ANN only.
+    ANN only.  ``replicas=R`` (kNN only) serves REPLICATED with hedged
+    dispatch: R disjoint sub-mesh replicas of the index, drawn from
+    the ``mesh_devices`` span (default: all local devices).
     """
     import jax.numpy as jnp
 
     from raft_tpu.serve import ANNService, KNNService, PairwiseService
 
-    if mesh_devices is not None:
+    if replicas is not None:
+        from raft_tpu.comms.host_comms import default_mesh
+
+        if kind != "knn":
+            raise SystemExit("--replicas applies to the replicated "
+                             "service (knn)")
+        mesh = default_mesh(int(mesh_devices)
+                            if mesh_devices is not None else None)
+        opts = dict(opts, mesh=mesh, axis=mesh.axis_names[0],
+                    replicas=int(replicas))
+    elif mesh_devices is not None:
         from raft_tpu.comms.host_comms import default_mesh
 
         if kind not in ("knn", "ann"):
@@ -235,7 +258,7 @@ def _ground_truth_for_pool(service, pool, k):
 
 def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
              qps=100.0, rows=4, seed=0, deadline=None, recall=False,
-             query_pool=None):
+             query_pool=None, tenant=None):
     """Drive ``service`` for ``duration`` seconds; returns the report.
 
     Latencies are client-observed submit→result seconds.  Rejected
@@ -247,7 +270,9 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
     ids against it — the report then carries ``recall_at_k`` next to
     p50/p95/p99, so a speed claim cannot shed quality silently.
     ``query_pool`` overrides the default i.i.d. gaussian pool (see
-    :func:`make_query_pool` for data-aligned queries).
+    :func:`make_query_pool` for data-aligned queries).  ``tenant``
+    tags every submit (traffic shaping; the per-tenant solo baseline
+    the mixed-tenant scenario compares against).
     """
     import jax.numpy as jnp
     import numpy as np
@@ -284,7 +309,7 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         q = pool[i % len(pool)]
         t0 = time.monotonic()
         try:
-            fut = service.submit(q, timeout=deadline)
+            fut = service.submit(q, timeout=deadline, tenant=tenant)
             out = fut.result(timeout=max(30.0, duration))
         except ServiceOverloadError:
             with lock:
@@ -376,6 +401,255 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
             if recall_acc["n"] else 0.0)
         report["recall_k"] = int(recall_k)
     report.update(_registry_serve_stats(service.name))
+    return report
+
+
+def run_mixed_tenants(service, *, duration=5.0,
+                      interactive_concurrency=4, bulk_qps=200.0,
+                      interactive_rows=4, bulk_rows=32, seed=0,
+                      interactive_tenant="interactive",
+                      bulk_tenant="bulk", deadline=None):
+    """Mixed-class traffic-shaping scenario (docs/SERVING.md "Traffic
+    shaping"): **closed-loop interactive clients** (N threads,
+    submit→wait→repeat — latency-bound, the user-facing class) run
+    concurrently with an **open-loop bulk flood** (fixed arrival rate
+    regardless of completions — the batch-pipeline class that would
+    starve everyone without weighted-fair admission).  Reports
+    per-tenant p50/p95/p99 + shed counts, and verifies every shed was
+    *typed* (``ServiceOverloadError``/``ServiceUnavailableError``
+    carrying ``retry_after_s`` — ``untyped_sheds`` must be 0).
+
+    The service should be constructed with ``tenant_weights`` naming
+    both tenants; the isolation claim (interactive p99 holds while
+    bulk saturates its quota) is measured by comparing against an
+    interactive-only :func:`run_load` baseline — the
+    ``serve_mixed_tenant`` bench rung does exactly that.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.core.error import (ServiceOverloadError,
+                                     ServiceUnavailableError)
+
+    rng = np.random.default_rng(seed)
+    pools = {
+        interactive_tenant: [
+            jnp.asarray(rng.standard_normal((interactive_rows,
+                                             service.dim)), jnp.float32)
+            for _ in range(16)],
+        bulk_tenant: [
+            jnp.asarray(rng.standard_normal((bulk_rows, service.dim)),
+                        jnp.float32) for _ in range(16)],
+    }
+    lock = threading.Lock()
+    stats = {t: {"ok": 0, "rejected": 0, "unavailable": 0, "errors": 0,
+                 "latencies": []} for t in pools}
+    untyped = {"sheds": 0}
+    stop_t = time.monotonic() + duration
+
+    def one_request(tenant, i):
+        q = pools[tenant][i % 16]
+        st = stats[tenant]
+        t0 = time.monotonic()
+        try:
+            fut = service.submit(q, timeout=deadline, tenant=tenant)
+            fut.result(timeout=max(30.0, duration))
+        except (ServiceOverloadError, ServiceUnavailableError) as e:
+            with lock:
+                st["rejected" if isinstance(e, ServiceOverloadError)
+                   else "unavailable"] += 1
+                # the taxonomy audit: an overload shed must carry a
+                # REAL drain estimate (the batcher always produces
+                # one; 0.0 means a shed site skipped the hint), and a
+                # tenant-cap shed must name the tenant
+                if isinstance(e, ServiceOverloadError) and (
+                        e.retry_after_s <= 0.0 or e.tenant is None):
+                    untyped["sheds"] += 1
+            return
+        except Exception:
+            with lock:
+                st["errors"] += 1
+            return
+        dt = time.monotonic() - t0
+        with lock:
+            st["ok"] += 1
+            st["latencies"].append(dt)
+
+    def interactive_client(tid):
+        i = tid
+        while time.monotonic() < stop_t:
+            one_request(interactive_tenant, i)
+            i += interactive_concurrency
+
+    spawned = []
+
+    def bulk_pacer():
+        period = 1.0 / bulk_qps
+        i = 0
+        next_t = time.monotonic()
+        while time.monotonic() < stop_t:
+            t = threading.Thread(target=one_request,
+                                 args=(bulk_tenant, i), daemon=True)
+            t.start()
+            spawned.append(t)
+            i += 1
+            next_t += period
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    threads = ([threading.Thread(target=interactive_client, args=(t,),
+                                 daemon=True)
+                for t in range(interactive_concurrency)]
+               + [threading.Thread(target=bulk_pacer, daemon=True)])
+    misses0 = _compile_misses()
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60.0)
+    for t in spawned:
+        t.join(timeout=60.0)
+    wall = time.monotonic() - t_start
+
+    report = {"mode": "mixed-tenants", "duration_s": round(wall, 3),
+              "post_warmup_compiles": _compile_misses() - misses0,
+              "untyped_sheds": untyped["sheds"], "tenants": {}}
+    for tenant, st in stats.items():
+        lat = sorted(st["latencies"])
+        report["tenants"][tenant] = {
+            "requests_ok": st["ok"],
+            "rejected": st["rejected"],
+            "unavailable": st["unavailable"],
+            "errors": st["errors"],
+            "qps": round(st["ok"] / wall, 2) if wall else 0.0,
+            "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+            "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
+            "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+        }
+    report.update(_registry_serve_stats(service.name))
+    return report
+
+
+def run_hedge_chaos(service, *, duration=5.0, concurrency=4, rows=4,
+                    seed=0, delay_s=0.4, delay_at=0.25, delay_for=0.5):
+    """Hedged-dispatch chaos scenario (docs/FAULT_MODEL.md "Hedged
+    dispatch"): closed-loop traffic against a **replicated** service
+    while one replica straggles — a persistent ``Delay`` at replica
+    0's execute seam for the middle ``delay_for`` fraction of the run.
+    Hedges must fire and win (the straggler's batches resolve from the
+    other replica), losers must cancel via the commit handshake, and
+    the exactly-once/typed-only/zero-compile invariants must all hold.
+
+    ``chaos_ok`` requires: every admitted request resolved exactly
+    once with a result or typed error, ``hedge_wins > 0``, 0
+    post-warmup compiles, 0 host-staged bytes.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.comms import faults
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.core.metrics import default_registry
+    from raft_tpu.serve.replicas import ReplicaFaultInjector
+
+    if getattr(service, "_replica_set", None) is None:
+        raise SystemExit("--hedge-chaos needs a replicated service "
+                         "(--replicas >= 2)")
+    rng = np.random.default_rng(seed)
+    pool = [jnp.asarray(rng.standard_normal((rows, service.dim)),
+                        jnp.float32) for _ in range(16)]
+    lock = threading.Lock()
+    admitted = []
+    counts = {"submitted": 0, "sheds": 0}
+    stop_t = time.monotonic() + duration
+
+    def client(tid):
+        i = tid
+        while time.monotonic() < stop_t:
+            q = pool[i % len(pool)]
+            i += concurrency
+            try:
+                fut = service.submit(q)
+            except RaftError:
+                with lock:
+                    counts["sheds"] += 1
+                time.sleep(0.01)
+                continue
+            with lock:
+                counts["submitted"] += 1
+                admitted.append(fut)
+            fut.wait(timeout=10.0)
+
+    def reg_total(name):
+        return int(default_registry().family_total(name))
+
+    hedges0 = reg_total("raft_tpu_serve_hedges_total")
+    wins0 = reg_total("raft_tpu_serve_hedge_wins_total")
+    cancelled0 = reg_total("raft_tpu_serve_hedge_cancelled_total")
+    misses0 = _compile_misses()
+
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    injector = None
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(max(0.0, duration * delay_at))
+        # the straggling replica: every batch it carries stalls long
+        # past the hedge threshold
+        injector = ReplicaFaultInjector(service, 0,
+                                        [faults.Delay(delay_s)])
+        injector.activate()
+        time.sleep(duration * delay_for)
+        injector.deactivate()
+        injector = None
+        for t in threads:
+            t.join(timeout=duration + 30.0)
+    finally:
+        if injector is not None:
+            injector.deactivate()
+    service.drain(timeout=30.0)
+    results = {"ok": 0, "typed_errors": 0, "untyped_errors": 0,
+               "lost": 0}
+    for fut in admitted:
+        if not fut.wait(timeout=30.0):
+            results["lost"] += 1
+            continue
+        err = fut.exception(timeout=0)
+        if err is None:
+            results["ok"] += 1
+        elif isinstance(err, RaftError):
+            results["typed_errors"] += 1
+        else:
+            results["untyped_errors"] += 1
+    resolved = (results["ok"] + results["typed_errors"]
+                + results["untyped_errors"])
+    hedges = reg_total("raft_tpu_serve_hedges_total") - hedges0
+    wins = reg_total("raft_tpu_serve_hedge_wins_total") - wins0
+    report = {
+        "seed": seed,
+        "duration_s": duration,
+        "delay_s": delay_s,
+        **counts,
+        **results,
+        "resolved": resolved,
+        "exactly_once": (results["lost"] == 0
+                         and resolved == counts["submitted"]),
+        "typed_only": results["untyped_errors"] == 0,
+        "hedges_fired": hedges,
+        "hedge_wins": wins,
+        "hedge_cancelled": reg_total(
+            "raft_tpu_serve_hedge_cancelled_total") - cancelled0,
+        "post_warmup_compiles": _compile_misses() - misses0,
+        "host_staged_bytes": int(default_registry().family_total(
+            "raft_tpu_comms_host_staged_bytes")),
+    }
+    report["chaos_ok"] = (report["exactly_once"]
+                          and report["typed_only"]
+                          and wins > 0
+                          and report["post_warmup_compiles"] == 0
+                          and report["host_staged_bytes"] == 0)
     return report
 
 
@@ -630,6 +904,29 @@ def main(argv=None) -> int:
                     help="chaos: the outage permanently kills one "
                          "shard device; recovery re-partitions over "
                          "the survivors (requires --mesh >= 2)")
+    ap.add_argument("--replicas", type=int, default=None, metavar="R",
+                    help="serve REPLICATED over R disjoint sub-meshes "
+                         "with hedged dispatch (knn only; "
+                         "docs/SERVING.md traffic shaping)")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="fixed hedge threshold ms (default: the "
+                         "serve_hedge_ms knob / adaptive p99)")
+    ap.add_argument("--hedge-chaos", action="store_true",
+                    help="run the hedged-dispatch chaos scenario (one "
+                         "replica straggles under a persistent Delay; "
+                         "requires --replicas >= 2); exits 1 unless "
+                         "exactly-once + hedge wins + 0 compiles hold")
+    ap.add_argument("--tenants", action="store_true",
+                    help="run the mixed-tenant scenario instead: "
+                         "closed-loop interactive clients + open-loop "
+                         "bulk flood through weighted-fair admission, "
+                         "reporting per-tenant p50/p95/p99 and sheds")
+    ap.add_argument("--tenant-weights", default="interactive:4,bulk:1",
+                    help="tenant:weight spec for --tenants")
+    ap.add_argument("--bulk-qps", type=float, default=300.0,
+                    help="--tenants: open-loop bulk arrival rate")
+    ap.add_argument("--bulk-rows", type=int, default=32,
+                    help="--tenants: query rows per bulk request")
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--qps", type=float, default=100.0,
                     help="open-loop arrival rate")
@@ -660,19 +957,78 @@ def main(argv=None) -> int:
         opts.update(nlist=args.nlist, nprobe=args.nprobe,
                     train_rows=args.train_rows)
     if args.merge is not None:
-        if args.mesh is None:
+        if args.mesh is None and args.replicas is None:
             raise SystemExit("--merge is the sharded cross-shard merge "
-                             "topology — it requires --mesh N")
+                             "topology — it requires --mesh N or "
+                             "--replicas R")
         opts["merge"] = args.merge
     if args.kill_shard and (args.mesh is None or args.mesh < 2):
         raise SystemExit("--kill-shard requires --mesh >= 2")
+    if args.hedge_chaos and (args.replicas is None or args.replicas < 2):
+        raise SystemExit("--hedge-chaos requires --replicas >= 2")
+    if args.hedge_ms is not None:
+        if args.replicas is None:
+            raise SystemExit("--hedge-ms requires --replicas")
+        opts["hedge_ms"] = args.hedge_ms
+    if args.tenants:
+        opts["tenant_weights"] = args.tenant_weights
     service = build_service(args.service, args.index_rows, args.dim,
                             args.k, seed=args.seed,
                             clusters=args.clusters,
-                            mesh_devices=args.mesh, **opts)
+                            mesh_devices=args.mesh,
+                            replicas=args.replicas, **opts)
     t0 = time.monotonic()
     service.warmup()
     warmup_s = time.monotonic() - t0
+    if args.hedge_chaos:
+        try:
+            report = run_hedge_chaos(service, duration=args.duration,
+                                     concurrency=args.concurrency,
+                                     rows=args.rows, seed=args.seed)
+        finally:
+            service.close()
+        report["warmup_s"] = round(warmup_s, 3)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print("== loadgen: %s hedge-chaos (seed=%d) =="
+                  % (args.service, args.seed))
+            for key in ("duration_s", "delay_s", "submitted", "ok",
+                        "typed_errors", "untyped_errors", "lost",
+                        "sheds", "hedges_fired", "hedge_wins",
+                        "hedge_cancelled", "exactly_once", "typed_only",
+                        "post_warmup_compiles", "host_staged_bytes",
+                        "chaos_ok"):
+                if key in report:
+                    print("  %-20s %s" % (key, report[key]))
+        return 0 if report["chaos_ok"] else 1
+    if args.tenants:
+        try:
+            report = run_mixed_tenants(
+                service, duration=args.duration,
+                interactive_concurrency=args.concurrency,
+                bulk_qps=args.bulk_qps, interactive_rows=args.rows,
+                bulk_rows=args.bulk_rows, seed=args.seed,
+                deadline=args.deadline)
+        finally:
+            service.close()
+        report["warmup_s"] = round(warmup_s, 3)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print("== loadgen: %s mixed-tenants ==" % args.service)
+            for key in ("duration_s", "untyped_sheds",
+                        "post_warmup_compiles", "host_staged_bytes",
+                        "warmup_s"):
+                if key in report:
+                    print("  %-20s %s" % (key, report[key]))
+            for tenant, st in sorted(report["tenants"].items()):
+                print("  [%s]" % tenant)
+                for key in ("requests_ok", "rejected", "unavailable",
+                            "errors", "qps", "p50_ms", "p95_ms",
+                            "p99_ms"):
+                    print("    %-18s %s" % (key, st[key]))
+        return 0 if report["untyped_sheds"] == 0 else 1
     if args.chaos:
         from raft_tpu.serve.resilience import RecoveryManager
 
@@ -729,6 +1085,12 @@ def main(argv=None) -> int:
     if getattr(service, "axis", None) is not None:
         report["n_devices"] = int(service.mesh.shape[service.axis])
         report["merge"] = service.merge
+    if getattr(service, "_replica_set", None) is not None:
+        from raft_tpu.core.metrics import default_registry
+
+        report["replicas"] = len(service._replica_set.replicas)
+        report["hedges_fired"] = int(default_registry().family_total(
+            "raft_tpu_serve_hedges_total"))
     if args.service == "ann":
         report["nprobe"] = service.nprobe
         report["delta_rows"] = service.delta_rows

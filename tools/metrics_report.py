@@ -166,8 +166,80 @@ def _serve_summary(metrics: dict) -> list:
                             _fmt_s(w["p95"]) if w else "-",
                             _fmt_s(e["p50"]) if e else "-",
                             _fmt_s(e["p95"]) if e else "-"))
+    lines.extend(_serve_traffic_summary(metrics))
     lines.extend(_serve_resilience_summary(metrics))
     lines.extend(_serve_ann_summary(metrics))
+    return lines
+
+
+def _serve_traffic_summary(metrics: dict) -> list:
+    """Traffic-shaping digest (docs/SERVING.md "Traffic shaping"):
+    per-tenant served rows / requests / sheds, hedged-dispatch ledger
+    (fired / won / cancelled / failovers), and replica rotation state
+    — the one screen that answers "who got the machine, and did the
+    tail-latency defenses fire"."""
+
+    def by_tenant(name):
+        out = {}
+        for s in metrics.get(name, {}).get("series", []):
+            key = (s["labels"].get("service"),
+                   s["labels"].get("tenant"))
+            if key[0] is not None and key[1] is not None:
+                out[key] = int(s["value"])
+        return out
+
+    def by_service(name):
+        out = {}
+        for s in metrics.get(name, {}).get("series", []):
+            svc = s["labels"].get("service")
+            if svc is not None:
+                out[svc] = int(s["value"])
+        return out
+
+    lines = []
+    rows = by_tenant("raft_tpu_serve_tenant_rows_total")
+    reqs = by_tenant("raft_tpu_serve_tenant_requests_total")
+    sheds = by_tenant("raft_tpu_serve_tenant_rejected_total")
+    tenant_keys = sorted(set(rows) | set(reqs) | set(sheds))
+    tenants_by_svc = {}
+    for svc, tenant in tenant_keys:
+        tenants_by_svc.setdefault(svc, []).append(tenant)
+    for svc, tenants in sorted(tenants_by_svc.items()):
+        if tenants == ["default"]:
+            # a lone default tenant is just the single-queue service
+            # again — no shaping to report
+            continue
+        for tenant in tenants:
+            key = (svc, tenant)
+            lines.append(
+                "  %-24s tenant=%-12s rows=%-8d requests=%-7d "
+                "sheds=%d"
+                % (svc, tenant, rows.get(key, 0), reqs.get(key, 0),
+                   sheds.get(key, 0)))
+    hedges = by_service("raft_tpu_serve_hedges_total")
+    wins = by_service("raft_tpu_serve_hedge_wins_total")
+    cancelled = by_service("raft_tpu_serve_hedge_cancelled_total")
+    failovers = by_service("raft_tpu_serve_replica_failovers_total")
+    healthy = by_service("raft_tpu_serve_replicas_healthy")
+    for svc in sorted(set(hedges) | set(failovers) | set(healthy)):
+        lines.append(
+            "  %-24s hedges: fired=%-4d won=%-4d cancelled=%-4d "
+            "failovers=%-3d replicas_healthy=%s"
+            % (svc, hedges.get(svc, 0), wins.get(svc, 0),
+               cancelled.get(svc, 0), failovers.get(svc, 0),
+               healthy.get(svc, "-")))
+    state_names = {0: "closed", 1: "OPEN", 2: "half-open"}
+    rep_states = {}
+    for s in metrics.get("raft_tpu_serve_replica_state",
+                         {}).get("series", []):
+        svc = s["labels"].get("service")
+        rep = s["labels"].get("replica")
+        if svc is not None and rep is not None:
+            rep_states.setdefault(svc, []).append(
+                (str(rep), state_names.get(int(s["value"]), "?")))
+    for svc, reps in sorted(rep_states.items()):
+        lines.append("  %-24s   rotation: %s" % (
+            svc, "  ".join("r%s=%s" % r for r in sorted(reps))))
     return lines
 
 
