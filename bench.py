@@ -1156,6 +1156,165 @@ def _bench_serve_ann(index_rows, dim, k, duration, concurrency, nlist,
     return out
 
 
+def _bench_serve_ann_ooc(index_rows, dim, k, duration, concurrency,
+                         nlist, train_rows, state=None, rows=16,
+                         budget_frac=0.25):
+    """Out-of-core ANN serving rung (docs/SERVING.md "Out-of-core
+    serving"): the SAME 1M x 128 k=100 workload as ``serve_ann_1m``,
+    but served under a device budget of ``budget_frac`` of the slot
+    store (~4x oversubscription) — the host-resident store streams
+    through the hot set + double-buffered TilePool.  Three arms over
+    one built index:
+
+    - **resident** — the fully device-resident ANNService at the same
+      fixed nprobe: the recall-equality reference and the
+      ``qps_vs_resident`` denominator;
+    - **ooc (double-buffered)** — the tier under test: recall@k must
+      EQUAL the resident arm (same candidates, same arithmetic — the
+      spatial/ooc.py identity contract), 0 post-warmup compiles, and
+      the hidden-transfer fraction reports how much of the H2D wall
+      the prefetch buried under the scans;
+    - **ooc (synchronous prefetch)** — the same tier with the double
+      buffer disabled: ``overlap_speedup`` is the measured win of
+      issuing tile N+1's transfer before tile N's scan blocks, the
+      number the whole design argument rests on.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.core.metrics import default_registry
+    from raft_tpu.serve.ann_service import ANNService
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+    from raft_tpu.spatial.ooc import ivf_flat_to_ooc
+    from tools.loadgen import make_query_pool, run_load, synth_data
+
+    t_build = time.time()
+    ref = jnp.asarray(synth_data(index_rows, dim, seed=0, clusters=256))
+    index = ivf_flat_build(ref, IVFFlatParams(nlist=nlist, nprobe=8),
+                           train_rows=train_rows)
+    build_s = time.time() - t_build
+    store_bytes = int(np.asarray(index.slot_vecs).nbytes)
+    budget = max(1, int(store_bytes * budget_frac))
+    mbr = 128
+    svc_opts = dict(max_batch_rows=mbr, bucket_rungs=(8, 32, 64, mbr),
+                    max_wait_ms=2.0, queue_cap=4096,
+                    nprobe_ladder=(4, 8), nprobe=8,
+                    select_impl="approx", compact_rows=0)
+    pool = make_query_pool(ref, rows, n=8, seed=1)
+
+    def pool_stat(name, svc_name, attr="value"):
+        fam = default_registry().get(name)
+        if fam is None:
+            return 0.0
+        for labels, series in fam.series():
+            if labels.get("pool") == svc_name:
+                return float(getattr(series, attr))
+        return 0.0
+
+    def run_arm(svc, dur, recall):
+        svc.loadgen_ref = ref
+        t0 = time.time()
+        svc.warmup()
+        warm = time.time() - t0
+        base = {n: pool_stat(n, svc.name) for n in
+                ("raft_tpu_tile_hits_total", "raft_tpu_tile_misses_total",
+                 "raft_tpu_h2d_bytes_total")}
+        h2d0 = pool_stat("raft_tpu_h2d_seconds", svc.name, "total")
+        stall0 = pool_stat("raft_tpu_h2d_stall_seconds", svc.name,
+                           "total")
+        try:
+            rep = run_load(svc, mode="closed", duration=dur,
+                           concurrency=concurrency, rows=rows,
+                           recall=recall, query_pool=pool)
+        finally:
+            svc.close()
+        hits = pool_stat("raft_tpu_tile_hits_total", svc.name) \
+            - base["raft_tpu_tile_hits_total"]
+        miss = pool_stat("raft_tpu_tile_misses_total", svc.name) \
+            - base["raft_tpu_tile_misses_total"]
+        h2d_t = pool_stat("raft_tpu_h2d_seconds", svc.name,
+                          "total") - h2d0
+        stall_t = pool_stat("raft_tpu_h2d_stall_seconds", svc.name,
+                            "total") - stall0
+        rep["warmup_s"] = round(warm, 2)
+        if hits or miss:
+            # load-window deltas (warmup streams tiles too)
+            rep["tile_hit_rate"] = round(hits / (hits + miss), 4) \
+                if hits + miss else 0.0
+            rep["h2d_mb"] = round(
+                (pool_stat("raft_tpu_h2d_bytes_total", svc.name)
+                 - base["raft_tpu_h2d_bytes_total"]) / 1e6, 1)
+            rep["hidden_transfer_frac"] = round(
+                1.0 - stall_t / h2d_t, 4) if h2d_t else 0.0
+        return rep
+
+    # resident reference arm (same fixed nprobe -> same candidates)
+    resident = run_arm(ANNService(index, k=k, **svc_opts),
+                       max(1.5, duration / 2), recall=True)
+    ooc_index = ivf_flat_to_ooc(index)
+    del index  # frees the device slot store before the streamed arms
+    ooc = run_arm(ANNService(ooc_index, k=k,
+                             device_budget_bytes=budget, **svc_opts),
+                  duration, recall=True)
+    # same duration as the overlapped arm: the A/B must compare equal
+    # sample sizes (a 2-3-batch window on the CPU venue is noise)
+    sync = run_arm(ANNService(ooc_index, k=k,
+                              device_budget_bytes=budget,
+                              ooc_overlap=False, **svc_opts),
+                   duration, recall=False)
+    out = {
+        "query_qps": ooc["query_qps"],
+        "qps": ooc["qps"],
+        "recall_at_k": ooc.get("recall_at_k"),
+        "resident_query_qps": resident["query_qps"],
+        "resident_recall_at_k": resident.get("recall_at_k"),
+        "recall_equal": (ooc.get("recall_at_k")
+                         == resident.get("recall_at_k")),
+        "qps_vs_resident": round(
+            ooc["query_qps"] / max(resident["query_qps"], 1e-9), 3),
+        "sync_query_qps": sync["query_qps"],
+        "overlap_speedup": round(
+            ooc["query_qps"] / max(sync["query_qps"], 1e-9), 3),
+        "tile_hit_rate": ooc.get("tile_hit_rate"),
+        "h2d_mb": ooc.get("h2d_mb"),
+        "hidden_transfer_frac": ooc.get("hidden_transfer_frac"),
+        "sync_hidden_transfer_frac": sync.get("hidden_transfer_frac"),
+        "store_mb": round(store_bytes / 1e6, 1),
+        "budget_mb": round(budget / 1e6, 1),
+        "oversubscription": round(store_bytes / budget, 2),
+        "p50_ms": ooc["p50_ms"],
+        "p95_ms": ooc["p95_ms"],
+        "p99_ms": ooc["p99_ms"],
+        "post_warmup_compiles": ooc["post_warmup_compiles"],
+        "host_staged_bytes": ooc["host_staged_bytes"],
+        "build_s": round(build_s, 2),
+        "warmup_s": ooc["warmup_s"],
+        "config": {"index_rows": index_rows, "dim": dim, "k": k,
+                   "nlist": nlist, "train_rows": train_rows,
+                   "nprobe": 8, "budget_frac": budget_frac,
+                   "concurrency": concurrency,
+                   "rows_per_request": rows, "max_batch_rows": mbr,
+                   "select_impl": "approx", "clusters": 256},
+    }
+    base_ann = (state or {}).get("serve_ann_1m", {}).get("query_qps")
+    if base_ann:
+        out["serve_ann_1m_query_qps"] = base_ann
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # the honest-venue caveat (the serve_knn_sharded precedent):
+        # on the virtual CPU device "H2D" is a memcpy competing for
+        # the same cores as the scan, so hiding it buys little wall
+        # clock — hidden_transfer_frac still proves the transfers ride
+        # behind the scans; the wall-clock overlap_speedup is the TPU
+        # ladder's to prove, where the copy is a DMA the host does not
+        # pay for
+        out["note"] = ("virtual-CPU venue: transfer and scan share "
+                       "the cores, so overlap_speedup ~1.0 here; "
+                       "hidden_transfer_frac is the mechanism proof")
+    return out
+
+
 def _bench_comms_p2p(rows, dim, iters):
     """Tagged-p2p staging A/B (docs/ZERO_COPY.md): one full ring
     (every rank sends a (rows, dim) f32 block to its neighbor) per
@@ -1556,6 +1715,15 @@ def child_main():
              lambda: _bench_serve_ann(1_000_000, 128, 100, 4.0, 12,
                                       nlist=2048, train_rows=65536,
                                       target_recall=0.9, state=state)),
+            # the out-of-core tier at the same 1M x 128 scale: device
+            # budget = 1/4 of the slot store (~4x oversubscription),
+            # recall must EQUAL the resident arm, and the double-
+            # buffered vs synchronous-prefetch A/B measures the
+            # overlap win (docs/SERVING.md "Out-of-core serving")
+            ("serve_ann_ooc", 320,
+             lambda: _bench_serve_ann_ooc(1_000_000, 128, 100, 4.0, 8,
+                                          nlist=2048, train_rows=65536,
+                                          state=state)),
         ]
     else:
         def best_select():
@@ -1656,6 +1824,16 @@ def child_main():
              lambda: _bench_serve_ann(1_000_000, 128, 100, 5.0, 16,
                                       nlist=1024, train_rows=131072,
                                       target_recall=0.9, state=state)),
+            # out-of-core tier on hardware: index bigger than the
+            # budget by 4x, host-streamed tiles double-buffered against
+            # the scans — where H2D is a real interconnect, the
+            # hidden-transfer fraction and overlap_speedup are the
+            # honest version of the CPU ladder's numbers
+            ("serve_ann_ooc", 260,
+             lambda: _bench_serve_ann_ooc(1_000_000, 128, 100, 5.0, 12,
+                                          nlist=1024,
+                                          train_rows=131072,
+                                          state=state)),
             ("spectral", 60, _bench_spectral),
             ("linkage_50k", 130, _bench_linkage_50k),
             ("spectral_100k", 80, _bench_spectral_100k),

@@ -41,6 +41,14 @@ Checks, per Python source file:
   loadgen's ``post_warmup_compiles`` check are blind to sharded
   compiles (docs/SERVING.md "Sharded serving").  A deliberate
   exception carries an ``mnmg-jit-ok`` marker comment on the line.
+- no ``jax.device_put`` inside the out-of-core tier's path
+  (``raft_tpu/spatial/ooc.py`` / ``raft_tpu/mr/tile_pool.py``): the
+  tier exists so the full index NEVER lands on device
+  (docs/ZERO_COPY.md §6) — a whole-store ``device_put`` silently
+  un-does it.  The per-tile stream and the budget-bounded hot-set
+  materialization are the only legitimate transfer sites; each carries
+  an ``ooc-resident-ok`` marker comment (mirrors the comms
+  ``np.asarray`` ban).
 - no silent ``except Exception`` inside ``raft_tpu/serve/``: a serving
   failure must go SOMEWHERE a rider or an operator can see it — the
   handler must relay to rider futures (``_set_exception``), feed the
@@ -104,6 +112,16 @@ COMMS_NP_MARKER = "comms-host-ok"
 MNMG_JIT_FILES = (os.path.join("raft_tpu", "spatial", "mnmg_knn.py"),)
 MNMG_JIT_MARKER = "mnmg-jit-ok"
 
+# whole-index device_put ban (the out-of-core tier's search path:
+# raft_tpu/spatial/ooc.py + raft_tpu/mr/tile_pool.py): the tier's
+# guarantee is that the full slot store NEVER lands on device — the
+# only legitimate transfer sites are the pool's per-tile put and the
+# budget-bounded hot-set materialization, each marked
+# `ooc-resident-ok` (mirrors the comms np.asarray ban)
+OOC_PUT_FILES = (os.path.join("raft_tpu", "spatial", "ooc.py"),
+                 os.path.join("raft_tpu", "mr", "tile_pool.py"))
+OOC_PUT_MARKER = "ooc-resident-ok"
+
 # serve except-Exception audit (raft_tpu/serve/ only): a broad handler
 # must relay, count, or re-raise — see module doc
 SERVE_EXC_DIR = os.path.join("raft_tpu", "serve") + os.sep
@@ -161,6 +179,7 @@ def check_file(path):
                          and rel not in COMMS_NP_ALLOWLIST)
     in_serve_exc_scope = rel.startswith(SERVE_EXC_DIR)
     in_mnmg_jit_scope = rel in MNMG_JIT_FILES
+    in_ooc_put_scope = rel in OOC_PUT_FILES
     src_lines = src.splitlines()
     # aliases the time/threading modules are bound to ("import time",
     # "import time as t") — attribute-call matching must follow them or
@@ -253,6 +272,35 @@ def check_file(path):
                     "SPMD programs compile through profiled_jit "
                     "(docs/SERVING.md); mark deliberate exceptions "
                     f"`{MNMG_JIT_MARKER}`")
+        if in_ooc_put_scope:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        jax_aliases.add(a.asname or "jax")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "jax"
+                    and any(a.name == "device_put" for a in node.names)
+                    and OOC_PUT_MARKER
+                    not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: from-import of "
+                    "jax.device_put in the out-of-core path — the full "
+                    "index never lands on device (docs/ZERO_COPY.md "
+                    "§6); mark the per-tile/hot-set transfer sites "
+                    f"`{OOC_PUT_MARKER}`")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "device_put"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in jax_aliases
+                    and OOC_PUT_MARKER
+                    not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: jax.device_put() in the "
+                    "out-of-core path — the full index never lands on "
+                    "device (docs/ZERO_COPY.md §6); mark the "
+                    "per-tile/hot-set transfer sites "
+                    f"`{OOC_PUT_MARKER}`")
         if in_comms_np_scope:
             if isinstance(node, ast.Import):
                 for a in node.names:

@@ -90,6 +90,15 @@ serve_ann_compact_rows
     Delta-row threshold at which the serve worker loop compacts (re-
     clusters the delta into IVF slots and atomically swaps the index);
     ``0`` disables automatic compaction.  Free-form int.
+serve_ann_device_budget_bytes
+    Device-memory budget for the out-of-core ANN tier
+    (:class:`raft_tpu.serve.ANNService` ``ooc=True``): bytes the
+    service may hold device-resident for slot vectors — the
+    frequency-promoted hot set plus the double-buffered TilePool
+    staging window (docs/SERVING.md "Out-of-core serving").  ``0``
+    (the default) means no budget is configured and an ``ooc=True``
+    service must pass ``device_budget_bytes=`` explicitly.  Free-form
+    int; runtime-resolved at service construction.
 serve_breaker_threshold
     Consecutive batch failures that trip a service's circuit breaker
     (:class:`raft_tpu.serve.resilience.CircuitBreaker`); ``0`` disables
@@ -171,6 +180,8 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "serve_ann_delta_cap": ("RAFT_TPU_SERVE_ANN_DELTA_CAP", "4096", None),
     "serve_ann_compact_rows": ("RAFT_TPU_SERVE_ANN_COMPACT_ROWS",
                                "2048", None),
+    "serve_ann_device_budget_bytes": (
+        "RAFT_TPU_SERVE_ANN_DEVICE_BUDGET_BYTES", "0", None),
     "serve_breaker_threshold": ("RAFT_TPU_SERVE_BREAKER_THRESHOLD",
                                 "5", None),
     "serve_breaker_window": ("RAFT_TPU_SERVE_BREAKER_WINDOW",
@@ -194,6 +205,7 @@ _RUNTIME_KNOBS = frozenset(
     ("serve_bucket_rungs", "serve_max_wait_ms", "serve_queue_cap",
      "serve_ann_nprobe", "serve_ann_nprobe_ladder",
      "serve_ann_delta_cap", "serve_ann_compact_rows",
+     "serve_ann_device_budget_bytes",
      "serve_breaker_threshold", "serve_breaker_window",
      "serve_breaker_window_failures", "serve_breaker_cooldown_ms",
      "serve_ann_degrade_frac", "serve_tenant_weights",

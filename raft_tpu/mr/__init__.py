@@ -20,6 +20,11 @@ story the reference's mr layer provides to eager callers:
   paths (serve bucketing, mnmg index pad, comms p2p staging): jax
   arrays are immutable, so one cached block replaces a fresh
   ``jnp.zeros`` per call (docs/ZERO_COPY.md).
+- :class:`TilePool` — budgeted, double-buffered host-to-device tile
+  streaming for the out-of-core index tier (docs/ZERO_COPY.md §6):
+  slot stores bigger than device memory stay host-resident and the
+  probed tiles stream through a fixed staging budget, prefetch
+  overlapped with the scan.
 - :func:`device_memory_stats` — bytes in use / limit from the device
   (``cudaMemGetInfo``'s role, cudart_utils.h).
 - the native *host* arena (cpp/include/raft_tpu/arena.hpp, exposed via
@@ -40,11 +45,14 @@ from raft_tpu.mr.buffer import (
     device_memory_stats,
     zeros_cached,
 )
+from raft_tpu.mr.tile_pool import StagedTile, TilePool
 
 __all__ = [
     "DeviceBuffer",
     "HostBuffer",
     "PoolAllocator",
+    "StagedTile",
+    "TilePool",
     "ZerosPool",
     "default_zeros_pool",
     "device_memory_stats",

@@ -238,15 +238,33 @@ class PoolAllocator:
     Like RMM's pool resource, a pool HIT returns the buffer with its
     previous contents — only the fresh-allocation path zero-fills.
     Callers needing zeros must clear the buffer themselves.
+
+    ``max_bytes`` bounds the total bytes pooled across every key: when
+    a ``deallocate`` would exceed it, the LEAST-RECENTLY-POOLED buffers
+    are freed outright (oldest first, across keys) until the budget
+    holds — the ZerosPool byte-bound argument applied to the freelist:
+    a consumer cycling many shapes (the out-of-core tier's staging
+    buffers) must not pin unbounded device memory.  ``None`` keeps the
+    historical per-key-count-only bound.  Evictions are counted
+    (``n_evictions`` / ``raft_tpu_mr_pool_evictions_total``).
     """
 
     def __init__(self, device: Optional[jax.Device] = None,
-                 max_pooled_per_key: int = 4):
+                 max_pooled_per_key: int = 4,
+                 max_bytes: Optional[int] = None):
+        expects(max_bytes is None or max_bytes >= 1,
+                "PoolAllocator: max_bytes=%r", max_bytes)
         self.device = device if device is not None else jax.devices()[0]
         self.max_pooled_per_key = max_pooled_per_key
+        self.max_bytes = max_bytes
         self._free: Dict[Tuple, List[DeviceBuffer]] = {}
+        # pooled buffers in pooling order (oldest first) — the byte
+        # bound's eviction order; entries are kept in sync with _free
+        self._order: List[DeviceBuffer] = []
+        self._bytes = 0
         self.n_hits = 0
         self.n_misses = 0
+        self.n_evictions = 0
 
     def _key(self, shape, dtype):
         return (tuple(shape), jnp.dtype(dtype).name)
@@ -258,23 +276,47 @@ class PoolAllocator:
             self.n_hits += 1
             reg.counter("raft_tpu_mr_pool_hits_total",
                         help="pool allocations served from freelist").inc()
-            return bucket.pop()
+            buf = bucket.pop()
+            self._order.remove(buf)
+            self._bytes -= buf.size_bytes()
+            return buf
         self.n_misses += 1
         reg.counter("raft_tpu_mr_pool_misses_total",
                     help="pool allocations needing fresh memory").inc()
         return DeviceBuffer(shape, dtype, self.device)
 
+    def _evict_oldest(self) -> None:
+        buf = self._order.pop(0)
+        self._free[self._key(buf.shape, buf.dtype)].remove(buf)
+        self._bytes -= buf.size_bytes()
+        self.n_evictions += 1
+        _metrics.default_registry().counter(
+            "raft_tpu_mr_pool_evictions_total",
+            help="pooled buffers freed to hold the byte budget").inc()
+        buf.deallocate()
+
     def deallocate(self, buf: DeviceBuffer) -> None:
         expects(not buf.deallocated,
                 "PoolAllocator: cannot pool a deallocated buffer")
-        bucket = self._free.setdefault(self._key(buf.shape, buf.dtype), [])
-        if len(bucket) < self.max_pooled_per_key:
-            bucket.append(buf)
-        else:
+        nbytes = buf.size_bytes()
+        if self.max_bytes is not None and nbytes > self.max_bytes:
+            # a buffer alone over budget can never be pooled — freeing
+            # the whole pool for it would be strictly worse
             buf.deallocate()
+            return
+        bucket = self._free.setdefault(self._key(buf.shape, buf.dtype), [])
+        if len(bucket) >= self.max_pooled_per_key:
+            buf.deallocate()
+            return
+        bucket.append(buf)
+        self._order.append(buf)
+        self._bytes += nbytes
+        if self.max_bytes is not None:
+            while self._bytes > self.max_bytes:
+                self._evict_oldest()
 
     def pooled_bytes(self) -> int:
-        return sum(b.size_bytes() for bs in self._free.values() for b in bs)
+        return self._bytes
 
     def release(self) -> None:
         """Free all pooled memory (RMM pool release)."""
@@ -282,6 +324,8 @@ class PoolAllocator:
             for b in bs:
                 b.deallocate()
         self._free.clear()
+        self._order.clear()
+        self._bytes = 0
 
 
 class ZerosPool:

@@ -1,0 +1,282 @@
+"""TilePool: budgeted host-to-device tile streaming (out-of-core tier).
+
+The out-of-core index tier (docs/ZERO_COPY.md §6, docs/SERVING.md
+"Out-of-core serving") keeps the bulk of an index in **host** memory and
+streams the slots a query batch actually probes through a small,
+fixed budget of device-resident staging tiles.  This module owns the
+streaming mechanics; the search driver
+(:mod:`raft_tpu.spatial.ooc`) owns what to stream and when.
+
+Design points, in the order they matter:
+
+- **Double-buffered prefetch.**  ``stage()`` gathers the requested slot
+  rows from the host store (a fresh, contiguous numpy block) and issues
+  an *asynchronous* ``jax.device_put`` — on every backend this build
+  serves, the transfer proceeds on the runtime's transfer machinery
+  while the caller keeps dispatching compute.  The driver stages tile
+  N+1 right after launching the scan of tile N, so the H2D copy of N+1
+  overlaps the scan of N; ``take()`` is the one block point and records
+  how much of the transfer was NOT hidden.
+- **Budget enforcement.**  ``budget_bytes`` bounds the bytes staged and
+  not yet taken; a ``stage()`` that would exceed it *blocks* until a
+  concurrent ``take()`` makes room (bounded wait, then
+  :class:`~raft_tpu.core.error.AllocationError` — a single thread that
+  over-stages without taking must fail loudly, not deadlock).  The
+  ``raft_tpu_tile_staged_bytes`` gauge's high-water is the proof the
+  budget held under concurrent traffic.
+- **Donation-friendly ownership.**  Every staged tile is fresh storage
+  (the host gather copies; ``device_put`` materializes a new device
+  buffer), so the consumer may legally DONATE it to the scan executable
+  (docs/ZERO_COPY.md donation contract) — the tile buffer is recycled
+  for the scan's output instead of costing a fresh allocation.  This is
+  the :class:`~raft_tpu.mr.buffer.ZerosPool` ownership discipline
+  inverted: ZerosPool blocks are shared and must never be donated;
+  TilePool tiles are exclusively owned and always may be.
+
+Metrics (labeled ``pool=``): ``raft_tpu_h2d_bytes_total``,
+``raft_tpu_h2d_seconds`` (stage-to-observed-ready wall per tile — an
+upper bound, the ``exec_seconds`` convention),
+``raft_tpu_h2d_stall_seconds`` (the exposed fraction: time the consumer
+actually blocked in ``take()``, plus the host-side gather/issue time
+when nothing overlapped it), and the ``raft_tpu_tile_staged_bytes``
+gauge.  ``hidden-transfer fraction = 1 - stall/h2d`` is computed by
+``tools/metrics_report.py`` and the ``serve_ann_ooc`` bench rung — the
+overlap is *measured*, never asserted.
+
+The whole-index ``jax.device_put`` ban (``ci/style_check.py``,
+``ooc-resident-ok`` marker) applies to this file: the per-tile put
+below is the ONE legitimate transfer site — the point of the tier is
+that the full store never lands on device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from raft_tpu.core import metrics as _metrics
+from raft_tpu.core.error import AllocationError, expects
+from raft_tpu.core.profiler import default_profiler
+
+
+def _pool_counter(name: str, help: str, pool: str):
+    return _metrics.default_registry().counter(
+        name, help=help, labels=("pool",)).labels(pool=pool)
+
+
+def _pool_gauge(name: str, help: str, pool: str):
+    return _metrics.default_registry().gauge(
+        name, help=help, labels=("pool",)).labels(pool=pool)
+
+
+def _pool_timer(name: str, help: str, pool: str):
+    return _metrics.default_registry().timer(
+        name, help=help, labels=("pool",)).labels(pool=pool)
+
+
+class StagedTile:
+    """One in-flight host-to-device tile transfer (the handle
+    ``stage()`` returns and ``take()`` consumes).  Not constructed by
+    callers."""
+
+    __slots__ = ("vecs", "ids", "nbytes", "t_issue", "stage_s",
+                 "hidden", "taken")
+
+    def __init__(self, vecs, ids, nbytes, t_issue, stage_s, hidden):
+        self.vecs = vecs          # device array, transfer in flight
+        self.ids = ids            # (tile_slots,) int32 device slot ids
+        self.nbytes = nbytes
+        self.t_issue = t_issue
+        self.stage_s = stage_s    # host-side gather + issue seconds
+        self.hidden = hidden      # was compute in flight to hide it?
+        self.taken = False
+
+
+class TilePool:
+    """Budgeted staging pool for host-resident slot stores.
+
+    Parameters
+    ----------
+    tile_slots:
+        Slots per staged tile — the fixed leading dimension of every
+        tile, which is what bounds the scan program's executable
+        cardinality (one shape, however many tiles stream through).
+    budget_bytes:
+        Cap on bytes staged and not yet taken.  Must hold at least two
+        tiles of the largest store streamed through the pool or
+        double-buffering cannot form (checked per ``stage``).
+    name:
+        The ``pool=`` metric label (services pass their service name).
+    device:
+        Target device (default: the backend's first device).
+    clock:
+        Injectable monotonic clock (tests).
+
+    The pool is thread-safe and *passive*: it owns no thread and no
+    store.  Callers pass the host store per ``stage()`` call so an
+    atomic index swap (ANN compaction) never races in-flight streams —
+    a search that began on the old snapshot keeps gathering from the
+    old store.
+    """
+
+    def __init__(self, tile_slots: int, budget_bytes: int, *,
+                 name: str = "tilepool",
+                 device: Optional[jax.Device] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 stage_wait_s: float = 30.0):
+        expects(tile_slots >= 1, "TilePool: tile_slots=%d", tile_slots)
+        expects(budget_bytes >= 1, "TilePool: budget_bytes=%d",
+                budget_bytes)
+        self.tile_slots = int(tile_slots)
+        self.budget_bytes = int(budget_bytes)
+        self.name = name
+        self.device = device
+        self._clock = clock
+        self._stage_wait_s = float(stage_wait_s)
+        self._lock = threading.Condition()
+        self._staged_bytes = 0
+        self.n_staged = 0
+        self.n_taken = 0
+
+    # ------------------------------------------------------------------ #
+    def staged_bytes(self) -> int:
+        with self._lock:
+            return self._staged_bytes
+
+    def tile_bytes(self, store: np.ndarray) -> int:
+        """Bytes one staged tile of ``store`` occupies (vecs + ids)."""
+        per_slot = int(np.prod(store.shape[1:], dtype=np.int64)
+                       ) * store.dtype.itemsize
+        return self.tile_slots * (per_slot + 4)
+
+    def _gauge(self):
+        return _pool_gauge(
+            "raft_tpu_tile_staged_bytes",
+            "bytes staged on device and not yet taken (high_water "
+            "proves the budget held)", self.name)
+
+    # ------------------------------------------------------------------ #
+    def stage(self, store: np.ndarray, slot_ids: np.ndarray, *,
+              hidden: bool = True) -> StagedTile:
+        """Gather ``store[slot_ids]`` into a fresh tile and issue the
+        (asynchronous) host-to-device transfer.  ``slot_ids`` shorter
+        than ``tile_slots`` is padded with ``-1`` (pad rows carry
+        arbitrary store content; the scan's position map never reads
+        them).  ``hidden=False`` marks a stage nothing overlaps (the
+        synchronous-prefetch arm, or the first tile of a batch) so the
+        stall accounting stays honest.
+
+        Blocks while the budget is full (a concurrent ``take`` makes
+        room); raises :class:`AllocationError` after ``stage_wait_s``
+        — over-staging from one thread is a driver bug, not a wait.
+        """
+        ids = np.asarray(slot_ids, np.int32).ravel()
+        expects(ids.shape[0] <= self.tile_slots,
+                "TilePool.stage: %d slot ids exceed tile_slots=%d",
+                ids.shape[0], self.tile_slots)
+        nbytes = self.tile_bytes(store)
+        expects(2 * nbytes <= self.budget_bytes,
+                "TilePool.stage: budget_bytes=%d cannot double-buffer "
+                "%d-byte tiles (need >= 2 tiles)", self.budget_bytes,
+                nbytes)
+        deadline = self._clock() + self._stage_wait_s
+        with self._lock:
+            while self._staged_bytes + nbytes > self.budget_bytes:
+                remaining = deadline - self._clock()
+                if remaining <= 0.0:
+                    raise AllocationError(
+                        "TilePool(%s).stage: budget %d bytes full "
+                        "(%d staged) and no take() freed room within "
+                        "%.1fs" % (self.name, self.budget_bytes,
+                                   self._staged_bytes,
+                                   self._stage_wait_s),
+                        requested_bytes=nbytes,
+                        live_bytes=self._staged_bytes)
+                self._lock.wait(timeout=min(remaining, 0.05))
+            self._staged_bytes += nbytes
+            self.n_staged += 1
+            self._gauge().set(self._staged_bytes)
+        t0 = self._clock()
+        try:
+            with default_profiler().span("ooc.prefetch", layer="ooc"):
+                if ids.shape[0] < self.tile_slots:
+                    ids = np.concatenate(
+                        [ids, np.full(self.tile_slots - ids.shape[0],
+                                      -1, np.int32)])
+                # fresh contiguous copy (fancy indexing) — the one
+                # buffer the consumer may donate to the scan program
+                host = store[np.clip(ids, 0, store.shape[0] - 1)]
+                if self.device is not None:
+                    vecs = jax.device_put(host, self.device)  # ooc-resident-ok (per-tile stream)
+                    ids_d = jax.device_put(ids, self.device)  # ooc-resident-ok (per-tile stream)
+                else:
+                    vecs = jax.device_put(host)  # ooc-resident-ok (per-tile stream)
+                    ids_d = jax.device_put(ids)  # ooc-resident-ok (per-tile stream)
+        except BaseException:
+            with self._lock:
+                self._staged_bytes -= nbytes
+                self._gauge().set(self._staged_bytes)
+                self._lock.notify_all()
+            raise
+        stage_s = self._clock() - t0
+        _pool_counter("raft_tpu_h2d_bytes_total",
+                      "bytes streamed host-to-device by tile pools",
+                      self.name).inc(nbytes)
+        return StagedTile(vecs, ids_d, nbytes, t0, stage_s, hidden)
+
+    def take(self, tile: StagedTile, busy: bool = False):
+        """Block until the tile's transfer completes and hand over the
+        ``(vecs, ids)`` device arrays (ownership transfers: the caller
+        may donate ``vecs``).  Records the transfer wall
+        (``h2d_seconds``, stage-to-ready upper bound) and the exposed
+        stall: time blocked here counts as stalled only when ``busy``
+        is False — the caller passes whether device compute was still
+        in flight at the call (a block that overlaps a running scan is
+        *hidden* wall-clock, which is the whole point of the double
+        buffer) — plus the stage-side host time when the stage itself
+        overlapped nothing."""
+        expects(not tile.taken, "TilePool.take: tile already taken")
+        t0 = self._clock()
+        try:
+            jax.block_until_ready((tile.vecs, tile.ids))
+        except BaseException:
+            # a failed transfer must release its budget charge or the
+            # pool shrinks permanently (the worker's retry would then
+            # stall every later stage against a phantom reservation)
+            self.discard(tile)
+            raise
+        now = self._clock()
+        wait_s = now - t0
+        _pool_timer("raft_tpu_h2d_seconds",
+                    "tile transfer wall, stage to observed-ready "
+                    "(upper bound under the overlapped loop)",
+                    self.name).observe(max(0.0, now - tile.t_issue))
+        _pool_timer("raft_tpu_h2d_stall_seconds",
+                    "transfer time NOT hidden behind compute (take "
+                    "block while the device was idle, plus stage host "
+                    "time when unoverlapped)",
+                    self.name).observe(
+                        (0.0 if busy else wait_s)
+                        + (0.0 if tile.hidden else tile.stage_s))
+        self._release(tile)
+        self.n_taken += 1
+        return tile.vecs, tile.ids
+
+    def discard(self, tile: StagedTile) -> None:
+        """Release a staged tile's budget charge WITHOUT consuming it —
+        the unwind path for a driver whose scan failed between
+        ``stage`` and ``take`` (idempotent; a taken tile is a no-op)."""
+        self._release(tile)
+
+    def _release(self, tile: StagedTile) -> None:
+        with self._lock:
+            if tile.taken:
+                return
+            tile.taken = True
+            self._staged_bytes -= tile.nbytes
+            self._gauge().set(self._staged_bytes)
+            self._lock.notify_all()

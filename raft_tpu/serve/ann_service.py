@@ -76,10 +76,12 @@ __all__ = ["ANNService"]
 class _AnnState(NamedTuple):
     """One immutable serving snapshot: a dispatched batch reads exactly
     one of these (index + delta — and, when sharded, the slot-sharded
-    mirror — travel together: the atomic-swap unit), so an insert,
-    compaction, or re-partition can never tear a batch."""
+    mirror; when out-of-core, the hot set — travel together: the
+    atomic-swap unit), so an insert, compaction, re-partition, or
+    hot-set promotion can never tear a batch."""
 
-    index: object           # IVFFlatIndex | IVFPQIndex | IVFSQIndex
+    index: object           # IVFFlatIndex | IVFPQIndex | IVFSQIndex |
+    #                         OocIVFFlat (out-of-core tier)
     delta_vecs: jnp.ndarray  # (delta_cap, dim) device, zeros past count
     delta_ids: jnp.ndarray   # (delta_cap,) int32 device, -1 past count
     delta_rows: int
@@ -87,6 +89,9 @@ class _AnnState(NamedTuple):
     # mesh), None on single-device services — rebuilt only when the
     # index object or the mesh changes, NOT on delta appends
     sharded: object = None
+    # out-of-core hot set: (hot_vecs, hot_ids_device, hot_mask_numpy)
+    # or None — swapped whole by promotion/compaction, never mutated
+    ooc_hot: object = None
 
 
 def _labeled(kind: str, name: str, help: str, service: str, **extra):
@@ -154,6 +159,22 @@ class ANNService(Service):
         calibrated cell is restored as soon as pressure clears.
         Defaults to the ``serve_ann_degrade_frac`` knob; ``0`` disables.
         Counted via the ``raft_tpu_serve_degraded_*`` family.
+    ooc / device_budget_bytes / tile_slots / ooc_overlap /
+    ooc_promote_batches:
+        The out-of-core tier (docs/SERVING.md "Out-of-core serving"):
+        ``ooc=True`` keeps the IVF-Flat slot store HOST-resident and
+        serves it through a device working set bounded by
+        ``device_budget_bytes`` (default: the
+        ``serve_ann_device_budget_bytes`` knob) — a frequency-promoted
+        hot set plus a double-buffered
+        :class:`~raft_tpu.mr.TilePool` staging window of
+        ``tile_slots``-slot tiles the cold probes stream through.
+        ``ooc_overlap=False`` runs the synchronous-prefetch baseline
+        (the bench's A/B arm); ``ooc_promote_batches`` gates how often
+        maintenance re-evaluates the hot set.  IVF-Flat only; does not
+        compose with ``axis=`` (shard the resident path instead).
+        Passing a prebuilt :class:`~raft_tpu.spatial.ooc.OocIVFFlat`
+        as ``index`` implies ``ooc=True``.
     **opts:
         The shared :class:`~raft_tpu.serve.service.Service` options
         (``max_batch_rows``, ``bucket_rungs``, ``max_wait_ms``,
@@ -169,15 +190,25 @@ class ANNService(Service):
                  degrade_queue_frac: Optional[float] = None,
                  slot_multiple: int = 64,
                  select_impl: Optional[str] = None,
+                 ooc: bool = False,
+                 device_budget_bytes: Optional[int] = None,
+                 tile_slots: Optional[int] = None,
+                 ooc_overlap: bool = True,
+                 ooc_promote_batches: int = 32,
                  mesh=None, axis: Optional[str] = None,
                  merge: Optional[str] = None,
                  group_size: Optional[int] = None,
                  name: Optional[str] = None, **opts):
-        kinds = (_ann.IVFFlatIndex, _ann.IVFPQIndex, _ann.IVFSQIndex)
+        from raft_tpu.spatial.ooc import OocIVFFlat
+
+        kinds = (_ann.IVFFlatIndex, _ann.IVFPQIndex, _ann.IVFSQIndex,
+                 OocIVFFlat)
         expects(isinstance(index, kinds),
                 "ANNService: index must be an IVF index "
-                "(IVFFlatIndex/IVFPQIndex/IVFSQIndex), got %r",
-                type(index).__name__)
+                "(IVFFlatIndex/IVFPQIndex/IVFSQIndex/OocIVFFlat), "
+                "got %r", type(index).__name__)
+        if isinstance(index, OocIVFFlat):
+            ooc = True
         expects(k >= 1, "ANNService: k=%d", k)
         self.k = int(k)
         self._nlist = int(index.centroids.shape[0])
@@ -216,6 +247,76 @@ class ANNService(Service):
             self.mesh, self.axis, self.merge = _resolve_shard_spec(
                 "ANNService", mesh, axis, merge)
 
+        # out-of-core tier (docs/SERVING.md "Out-of-core serving"): the
+        # slot store stays host-resident; a byte budget buys a
+        # frequency-promoted hot set plus a double-buffered TilePool
+        # staging window the cold slots stream through
+        self._ooc = None                 # OocIVFFlat when enabled
+        self._ooc_pool = None
+        self._ooc_hot = None             # (vecs, ids_dev, mask_np)
+        self._ooc_hot_cap = 0
+        self._ooc_overlap = bool(ooc_overlap)
+        expects(ooc or (device_budget_bytes is None
+                        and tile_slots is None),
+                "ANNService: device_budget_bytes/tile_slots are "
+                "out-of-core knobs — pass ooc=True (a resident "
+                "service silently ignoring a memory budget would be "
+                "worse than an error)")
+        if ooc:
+            expects(self.axis is None,
+                    "ANNService: ooc=True does not compose with "
+                    "sharded serving (the tier trades device memory "
+                    "for host streaming; shard the resident path "
+                    "instead)")
+            expects(refine_ratio is None,
+                    "ANNService: refine_ratio is PQ-only; the "
+                    "out-of-core tier is IVF-Flat-only — drop it")
+            expects(isinstance(index, (_ann.IVFFlatIndex, OocIVFFlat)),
+                    "ANNService: ooc=True requires an IVF-Flat index "
+                    "(PQ/SQ stores are already memory-compressed; "
+                    "serve them resident)")
+            from raft_tpu.spatial import ooc as _ooc_mod
+
+            self._ooc_mod = _ooc_mod
+            if isinstance(index, _ann.IVFFlatIndex):
+                index = _ooc_mod.ivf_flat_to_ooc(index)
+            self._ooc = index
+            if device_budget_bytes is None:
+                device_budget_bytes = _knob_int(
+                    "serve_ann_device_budget_bytes")
+            expects(device_budget_bytes > 0,
+                    "ANNService: ooc=True needs a device budget — pass "
+                    "device_budget_bytes= or set the "
+                    "serve_ann_device_budget_bytes knob")
+            self._ooc_budget = int(device_budget_bytes)
+            slot_b = index.slot_bytes()
+            if tile_slots is None:
+                # auto-size: a tile is at most an eighth of the budget
+                # (3 in flight + a hot set must all fit), capped at 32
+                # slots — explicit tile_slots overrides
+                tile_slots = min(32, index.n_slots,
+                                 self._ooc_budget // (8 * (slot_b + 4)))
+            tile_slots = max(1, min(int(tile_slots), index.n_slots))
+            tile_b = tile_slots * (slot_b + 4)
+            expects(self._ooc_budget >= 3 * tile_b,
+                    "ANNService: device_budget_bytes=%d holds fewer "
+                    "than 3 tiles of %d bytes — raise the budget or "
+                    "shrink tile_slots", self._ooc_budget, tile_b)
+            # budget split: H hot slots + one taken tile in flight +
+            # two staged tiles (the double buffer)
+            self._ooc_hot_cap = min(
+                (self._ooc_budget - 3 * tile_b) // slot_b,
+                index.n_slots)
+            self._ooc_tile_slots = tile_slots
+            self._ooc_pool_budget = max(
+                2 * tile_b, self._ooc_budget
+                - self._ooc_hot_cap * slot_b - tile_b)
+            self._ooc_promote_batches = max(1, int(ooc_promote_batches))
+            self._ooc_batches = 0
+            # promotion signal: per-slot probe traffic (distinct slots
+            # per batch, weighted by how many queries probed each)
+            self._ooc_counters = np.zeros(index.n_slots, np.int64)
+
         if nprobe is None:
             nprobe = _knob_int("serve_ann_nprobe")
             if nprobe == 0:
@@ -237,7 +338,8 @@ class ANNService(Service):
             compact_rows = _knob_int("serve_ann_compact_rows")
         expects(compact_rows >= 0, "ANNService: compact_rows=%d",
                 compact_rows)
-        self._compactable = isinstance(index, _ann.IVFFlatIndex)
+        self._compactable = isinstance(
+            index, _ann.IVFFlatIndex) or self._ooc is not None
         # PQ/SQ slot stores hold codes, not vectors: there is nothing
         # ivf_flat_extend could re-cluster — keep ingesting into the
         # delta, but never auto-compact (module doc)
@@ -270,6 +372,17 @@ class ANNService(Service):
         # full-delta shed hands back ("wait one compaction out")
         self._last_compact_s = 0.0
         self._index = index
+        if self._ooc is not None:
+            from raft_tpu.mr.tile_pool import TilePool
+
+            self._ooc_pool = TilePool(self._ooc_tile_slots,
+                                      self._ooc_pool_budget,
+                                      name=self.name)
+            # initial hot set: slots of the biggest lists (the best
+            # stand-in for probe traffic before any is observed);
+            # promotion replaces it with the measured top-H
+            self._ooc_hot_ids = self._ooc_ideal_hot()
+            self._ooc_rebuild_hot()
         self._publish_state_locked()
 
         def execute(padded):
@@ -309,11 +422,12 @@ class ANNService(Service):
     # snapshot plumbing
     # ------------------------------------------------------------------ #
     def _snapshot_search(self, st: "_AnnState", q, nprobe, delta,
-                         donate):
+                         donate, force_rounds: int = 0):
         """ONE search entry for dispatch / warmup / calibrate: the
         slot-sharded SPMD program when the snapshot carries a sharded
-        mirror, the single-device quantizer search otherwise — so every
-        consumer measures/warms exactly what dispatch runs."""
+        mirror, the streamed out-of-core arm when the service owns a
+        tile pool, the single-device quantizer search otherwise — so
+        every consumer measures/warms exactly what dispatch runs."""
         if st.sharded is not None:
             from raft_tpu.spatial.mnmg_knn import mnmg_ivf_flat_search
 
@@ -322,6 +436,15 @@ class ANNService(Service):
                 select_impl=self._select_impl, merge=self.merge,
                 group_size=self._group_size, donate_queries=donate,
                 delta=delta)
+        if self._ooc_pool is not None:
+            return self._ooc_mod.ooc_ivf_flat_search(
+                st.index, q, self.k, nprobe=nprobe,
+                pool=self._ooc_pool, hot=st.ooc_hot, delta=delta,
+                donate_queries=donate,
+                select_impl=self._select_impl,
+                overlap=self._ooc_overlap,
+                probe_hook=self._ooc_note_probes,
+                force_rounds=force_rounds)
         return _ann.approx_knn_search(
             st.index, q, self.k, nprobe=nprobe,
             refine_ratio=self._refine_ratio, delta=delta,
@@ -349,7 +472,8 @@ class ANNService(Service):
             jnp.asarray(self._delta_vecs_np),
             jnp.asarray(self._delta_ids_np),
             self._delta_count,
-            sharded)
+            sharded,
+            self._ooc_hot)
         _labeled("gauge", "raft_tpu_serve_ann_delta_rows",
                  "rows in the append-only delta segment",
                  self.name).set(self._delta_count)
@@ -469,16 +593,118 @@ class ANNService(Service):
         (:class:`~raft_tpu.serve.resilience.RecoveryManager` step 4):
         re-materialize the device-resident delta segment from the host
         mirror, re-partition the slot shards onto the rebuilt session
-        mesh (sharded services), and re-publish the immutable
-        ``(index, delta)`` snapshot — every row inserted before the
-        failure is still queryable.  The index's own arrays are
-        device-committed by the next search the rebuilt executables
-        run (``warmup()`` follows this hook)."""
+        mesh (sharded services), re-commit the out-of-core hot set
+        from the host store (ooc services — the store itself never
+        left host, so the tier recovers by replaying one hot-set
+        transfer), and re-publish the immutable ``(index, delta)``
+        snapshot — every row inserted before the failure is still
+        queryable.  The index's own arrays are device-committed by the
+        next search the rebuilt executables run (``warmup()`` follows
+        this hook)."""
         if self.axis is not None:
             self.repartition()   # republishes the snapshot
             return
         with self._delta_lock:
+            if self._ooc is not None:
+                self._ooc_rebuild_hot()
             self._publish_state_locked()
+
+    # ------------------------------------------------------------------ #
+    # out-of-core tier (docs/SERVING.md "Out-of-core serving")
+    # ------------------------------------------------------------------ #
+    def _ooc_ideal_hot(self) -> np.ndarray:
+        """The H slots the hot set should hold right now: top-H by the
+        observed per-slot probe counters (before any traffic: by owning
+        list size, the best cold-start stand-in), deterministic under
+        ties (slot id ascending), always EXACTLY ``_ooc_hot_cap`` ids —
+        the hot block's shape is part of the compiled executables and
+        must never drift."""
+        ooc = self._ooc
+        counters = self._ooc_counters
+        if counters.any():
+            priority = counters.astype(np.int64)
+        else:
+            priority = np.asarray(ooc.list_sizes,
+                                  np.int64)[ooc.slot_centroid]
+        # layout-padding slots (no valid rows: first entry is -1) sink
+        # below every real slot
+        first = np.asarray(ooc.slot_ids[:, 0])
+        priority = np.where(first >= 0, priority, -1)
+        order = np.lexsort((np.arange(priority.size), -priority))
+        return np.sort(order[:self._ooc_hot_cap]).astype(np.int64)
+
+    def _ooc_rebuild_hot(self) -> None:
+        """(Re-)commit ``_ooc_hot_ids`` to device as the hot block and
+        refresh the gauges.  Callers swap the snapshot after."""
+        if self._ooc_hot_cap == 0:
+            self._ooc_hot = None
+        else:
+            self._ooc_hot = self._ooc_mod.materialize_hot(
+                self._ooc, self._ooc_hot_ids, pool_name=self.name)
+        hot_n = 0 if self._ooc_hot is None else len(self._ooc_hot_ids)
+        _labeled("gauge", "raft_tpu_ooc_hot_slots",
+                 "slots resident in the out-of-core hot set",
+                 self.name).set(hot_n)
+        _labeled("gauge", "raft_tpu_ooc_hot_bytes",
+                 "device bytes the out-of-core hot set occupies",
+                 self.name).set(hot_n * self._ooc.slot_bytes())
+
+    def _ooc_note_probes(self, distinct: np.ndarray,
+                         counts: np.ndarray) -> None:
+        """Per-batch probe-traffic hook (runs on whatever thread
+        searches — worker, calibrate): feed the promotion counters.
+        ``distinct`` is unique, so the fancy-index add is one atomic
+        ufunc call; a search still reading a pre-compaction snapshot
+        is bounds-guarded against the resized counter array."""
+        c = self._ooc_counters
+        if distinct.size and int(distinct[-1]) < c.size:
+            c[distinct] += counts
+        self._ooc_batches += 1
+
+    def _ooc_promote_tick(self) -> None:
+        """Maintenance hook: swap the hot set to the measured top-H
+        when probe traffic says the working set moved.  Gated on a
+        batch interval and a minimum drift (an eighth of the hot set)
+        so steady traffic never pays hot-set churn; the swap itself is
+        one immutable-snapshot publish — in-flight batches keep their
+        old hot block."""
+        if (self._ooc_pool is None or self._ooc_hot_cap == 0
+                or self._ooc_batches < self._ooc_promote_batches
+                or self.batcher.draining()):
+            return
+        self._ooc_batches = 0
+        with self._compact_lock:
+            ideal = self._ooc_ideal_hot()
+            cur = self._ooc_hot_ids
+            fresh = np.setdiff1d(ideal, cur, assume_unique=True)
+            if fresh.size <= max(1, self._ooc_hot_cap // 8):
+                return
+            evicted = np.setdiff1d(cur, ideal, assume_unique=True).size
+            with self._delta_lock:
+                self._ooc_hot_ids = ideal
+                self._ooc_rebuild_hot()
+                self._publish_state_locked()   # THE atomic swap
+        _metrics.default_registry().counter(
+            "raft_tpu_tile_evictions_total",
+            help="hot-set slots demoted by frequency promotion",
+            labels=("pool",)).labels(pool=self.name).inc(int(evicted))
+
+    def _ooc_remap_counters(self, old, new) -> None:
+        """Carry the probe counters across a compaction's slot
+        renumbering: centroids are stable under ``ooc_extend``
+        (nearest-existing-centroid assignment, no k-means re-run), so
+        the per-slot traffic aggregates to its owning centroid and
+        redistributes evenly over the centroid's NEW slots — the
+        promotion signal survives the swap instead of cold-starting."""
+        nlist = int(old.centroids.shape[0])
+        cent_tot = np.bincount(old.slot_centroid,
+                               weights=self._ooc_counters,
+                               minlength=nlist)
+        slots_per = np.maximum(
+            np.bincount(new.slot_centroid, minlength=nlist), 1)
+        self._ooc_counters = (
+            cent_tot[new.slot_centroid]
+            // slots_per[new.slot_centroid]).astype(np.int64)
 
     # ------------------------------------------------------------------ #
     # warmup: every bucket rung x every nprobe cell, both delta arms
@@ -488,20 +714,28 @@ class ANNService(Service):
         and, per pair, BOTH serving arms: the empty-delta fast path and
         the delta-merge path (plus their donating twins where dispatch
         donates) — so steady-state traffic at any admissible shape,
-        any ladder cell, and any delta fill performs zero compiles."""
+        any ladder cell, and any delta fill performs zero compiles.
+
+        Out-of-core services additionally force one streamed tile
+        round per search (``force_rounds=1``): the probed set of the
+        zeros warmup queries may land entirely in the hot set, and the
+        tile-scan executables must not wait for the first real cold
+        miss to compile."""
         st = self._ann_state
         blank_vecs = jnp.zeros((self._delta_cap, self.dim), self.dtype)
         blank_ids = jnp.full((self._delta_cap,), -1, jnp.int32)
+        force = 1 if self._ooc_pool is not None else 0
         for rung in self.policy.rungs:
             for cell in self._nprobe_ladder:
                 # fresh zeros per call: the donating arms consume them
                 out = self._snapshot_search(
                     st, jnp.zeros((rung, self.dim), self.dtype),
-                    cell, None, self.donate)
+                    cell, None, self.donate, force_rounds=force)
                 jax.block_until_ready(out)
                 out = self._snapshot_search(
                     st, jnp.zeros((rung, self.dim), self.dtype),
-                    cell, (blank_vecs, blank_ids), self.donate)
+                    cell, (blank_vecs, blank_ids), self.donate,
+                    force_rounds=force)
                 jax.block_until_ready(out)
         self._warmed = self.policy.rungs
         return self
@@ -553,9 +787,12 @@ class ANNService(Service):
         return at + n
 
     def _maintenance_tick(self) -> None:
-        """Worker-loop hook: compact when the delta crosses the
+        """Worker-loop hook: promote the out-of-core hot set when
+        probe traffic moved, and compact when the delta crosses the
         threshold (never while draining — drain must serve out, not
         start index rebuilds)."""
+        if self._ooc is not None:
+            self._ooc_promote_tick()
         if (self._compact_rows
                 and self._delta_count >= self._compact_rows
                 and not self.batcher.draining()):
@@ -579,9 +816,18 @@ class ANNService(Service):
                 keys = self._delta_ids_np[:n0].copy()
                 old_index = self._index
             t0 = self._clock()
-            new_index = _ann.ivf_flat_extend(
-                old_index, vecs, keys, slot_multiple=self._slot_multiple)
-            jax.block_until_ready(new_index.slot_vecs)
+            if self._ooc is not None:
+                # host-side rebuild: the extended store never touches
+                # the device (the tier's whole point); only the small
+                # metadata re-commits
+                new_index = self._ooc_mod.ooc_extend(
+                    old_index, vecs, keys,
+                    slot_multiple=self._slot_multiple)
+            else:
+                new_index = _ann.ivf_flat_extend(
+                    old_index, vecs, keys,
+                    slot_multiple=self._slot_multiple)
+                jax.block_until_ready(new_index.slot_vecs)
             with self._delta_lock:
                 rem = self._delta_count - n0
                 if rem:
@@ -592,6 +838,11 @@ class ANNService(Service):
                 self._delta_ids_np[rem:] = -1
                 self._delta_count = rem
                 self._index = new_index
+                if self._ooc is not None:
+                    self._ooc_remap_counters(old_index, new_index)
+                    self._ooc = new_index
+                    self._ooc_hot_ids = self._ooc_ideal_hot()
+                    self._ooc_rebuild_hot()
                 self._publish_state_locked()   # THE atomic swap
         _labeled("counter", "raft_tpu_serve_ann_compactions_total",
                  "delta-to-slots compactions", self.name).inc()
@@ -620,9 +871,14 @@ class ANNService(Service):
         :meth:`calibrate` pass the very snapshot it measures against.
         """
         st = state if state is not None else self._ann_state
+        from raft_tpu.spatial.ooc import OocIVFFlat, ooc_reconstruct
+
         if reference is not None:
             vecs = np.asarray(reference)
             ids = np.arange(vecs.shape[0], dtype=np.int64)
+        elif isinstance(st.index, OocIVFFlat):
+            # host-side: the store IS host memory (lossless, like Flat)
+            vecs, ids = ooc_reconstruct(st.index)
         elif isinstance(st.index, _ann.IVFFlatIndex):
             vecs, ids = _ann.ivf_flat_reconstruct(st.index)
         elif (isinstance(st.index, _ann.IVFPQIndex)
@@ -721,4 +977,15 @@ class ANNService(Service):
             "degrade_queue_frac": self._degrade_frac,
             "degrade_hold": self._degrade_hold,
         })
+        if self._ooc is not None:
+            out["ooc"] = {
+                "budget_bytes": self._ooc_budget,
+                "store_bytes": self._ooc.store_bytes(),
+                "hot_slots": (0 if self._ooc_hot is None
+                              else len(self._ooc_hot_ids)),
+                "hot_cap": self._ooc_hot_cap,
+                "tile_slots": self._ooc_pool.tile_slots,
+                "staged_bytes": self._ooc_pool.staged_bytes(),
+                "overlap": self._ooc_overlap,
+            }
         return out

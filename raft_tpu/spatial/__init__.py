@@ -22,3 +22,6 @@ from raft_tpu.spatial.ball_cover import (  # noqa: F401
     BallCoverIndex, rbc_build_index, rbc_knn_query, rbc_all_knn_query,
 )
 from raft_tpu.spatial.mnmg_knn import mnmg_knn  # noqa: F401
+from raft_tpu.spatial.ooc import (  # noqa: F401
+    OocIVFFlat, ivf_flat_to_ooc, ooc_extend, ooc_ivf_flat_search,
+)

@@ -801,6 +801,39 @@ def ivf_flat_reconstruct(index: IVFFlatIndex
     return vecs[mask], ids[mask].astype(np.int64)
 
 
+def _extend_slot_layout(labels: np.ndarray, nlist: int, cap: int,
+                        slot_multiple: int
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                   np.ndarray]:
+    """Shared host-side slot layout for the extend paths (resident
+    :func:`ivf_flat_extend` and the out-of-core
+    :func:`raft_tpu.spatial.ooc.ooc_extend`): cut the labeled rows into
+    ``cap``-length slots, then round the slot count (and the per-list
+    table width, to a multiple of 8) UP to ``slot_multiple`` so repeat
+    compactions that stay inside the rounded shape reuse the compiled
+    search executables.  Returns ``(slot_rows, slot_cent, cent_slots,
+    counts)`` — all numpy; padding slots hold ids=-1 and are never
+    referenced by ``cent_slots``."""
+    expects(slot_multiple >= 1, "_extend_slot_layout: slot_multiple=%d",
+            slot_multiple)
+    slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
+        labels, nlist, cap=cap)
+    n_slots = slot_rows.shape[0]
+    pad_slots = round_up_safe(max(n_slots, 1), slot_multiple) - n_slots
+    if pad_slots:
+        slot_rows = np.concatenate(
+            [slot_rows, np.full((pad_slots, cap), -1, slot_rows.dtype)])
+        slot_cent = np.concatenate(
+            [slot_cent, np.zeros(pad_slots, slot_cent.dtype)])
+    max_slots = cent_slots.shape[1]
+    pad_width = round_up_safe(max(max_slots, 1), 8) - max_slots
+    if pad_width:
+        cent_slots = np.concatenate(
+            [cent_slots, np.full((nlist, pad_width), -1,
+                                 cent_slots.dtype)], axis=1)
+    return slot_rows, slot_cent, cent_slots, counts
+
+
 def ivf_flat_extend(index: IVFFlatIndex, vectors, ids, *,
                     slot_multiple: int = 64,
                     handle=None) -> IVFFlatIndex:
@@ -848,23 +881,10 @@ def ivf_flat_extend(index: IVFFlatIndex, vectors, ids, *,
         all_vecs, all_ids = old_vecs, old_ids
         labels = old_labels.astype(np.int64)
 
-    slot_rows, slot_cent, cent_slots, _, counts = _build_slots(
-        labels, nlist, cap=cap)
-    # shape-stability padding: extra slots hold ids=-1 / zero vectors
-    # and no cent_slots entry points at them
-    n_slots = slot_rows.shape[0]
-    pad_slots = round_up_safe(max(n_slots, 1), slot_multiple) - n_slots
-    if pad_slots:
-        slot_rows = np.concatenate(
-            [slot_rows, np.full((pad_slots, cap), -1, slot_rows.dtype)])
-        slot_cent = np.concatenate(
-            [slot_cent, np.zeros(pad_slots, slot_cent.dtype)])
-    max_slots = cent_slots.shape[1]
-    pad_width = round_up_safe(max(max_slots, 1), 8) - max_slots
-    if pad_width:
-        cent_slots = np.concatenate(
-            [cent_slots, np.full((nlist, pad_width), -1,
-                                 cent_slots.dtype)], axis=1)
+    # shape-stability padding (inside _extend_slot_layout): extra slots
+    # hold ids=-1 / zero vectors and no cent_slots entry points at them
+    slot_rows, slot_cent, cent_slots, counts = _extend_slot_layout(
+        labels, nlist, cap, slot_multiple)
 
     rows_j = jnp.asarray(slot_rows)
     gather = jnp.where(rows_j >= 0, rows_j, 0)

@@ -1,0 +1,442 @@
+"""Out-of-core IVF-Flat search: host-resident slot store, streamed scan.
+
+Every search path in :mod:`raft_tpu.spatial.ann` assumes the whole
+slot store is device-resident; this module is the arm for indexes
+**bigger than device memory** (ROADMAP item 3, the libhclooc overlapped
+tile pipeline from PAPERS.md).  The split:
+
+- **device-resident metadata** (small, O(n_slots·cap) ints/floats):
+  centroids, ``cent_slots``, ``slot_ids``, ``slot_norms`` — everything
+  the probe and the candidate bookkeeping need;
+- **host-resident vectors**: the ``(n_slots, cap, d)`` slot store —
+  the ~all of the index's bytes — stays numpy;
+- **a device working set**: a fixed *hot set* of frequency-promoted
+  slots (owned by the caller, typically
+  :class:`raft_tpu.serve.ANNService`) plus a
+  :class:`~raft_tpu.mr.tile_pool.TilePool` staging budget the cold
+  slots stream through.
+
+Search (:func:`ooc_ivf_flat_search`) per batch:
+
+1. probe on device (same ``expanded_sq_dists`` + ``select_k`` as the
+   resident path), fetch the per-query probed-slot lists to host (a
+   few KB — the one D2H sync);
+2. split the distinct probed slots into hot hits and cold misses
+   (``raft_tpu_tile_{hits,misses}_total``);
+3. scan the hot subset with the resident path's gather+einsum step
+   over the hot-set block;
+4. stream the cold slots through the pool in fixed-shape tiles,
+   **double-buffered**: the transfer of tile N+1 is issued right after
+   the scan of tile N is dispatched, so the H2D copy overlaps the scan
+   (``overlap=False`` is the measured synchronous baseline); each
+   staged tile is DONATED to its scan program (pool-owned fresh
+   storage — docs/ZERO_COPY.md);
+5. merge through the same running ``select_k`` seam as the resident
+   scan; the delta segment merges after
+   (:func:`raft_tpu.spatial.ann._delta_merge_impl`), unchanged.
+
+Identity contract: every probed ``(query, candidate)`` pair's distance
+is computed by the *same arithmetic* as the resident path (precomputed
+slot norms + one ``"nd,ncd->nc"`` highest-precision einsum over the
+gathered slot block), each pair is scanned exactly once, and candidate
+membership is exact — so results match the resident search bit-for-bit
+except on exact distance ties at the k-th boundary, where the scan
+order (hot first, then tiles) may keep a different survivor (the same
+caveat the sharded path documents).  Recall@k is identical.
+
+Executable cardinality stays bounded (the zero-post-warmup-compiles
+proof): the probe program is shaped by (rung, nprobe cell), the scan
+program by (rung, part size) with exactly two part sizes — the hot set
+H and the tile ``tile_slots`` — however many tiles stream through.
+
+The ``jax.device_put`` ban (``ci/style_check.py``, ``ooc-resident-ok``
+marker) applies to this file: the point of the tier is that the full
+store never lands on device, so the only transfer sites are the pool's
+per-tile put and the budget-bounded hot-set materialization below.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.handle import record_on_handle
+from raft_tpu.core.profiler import default_profiler, profiled_jit
+from raft_tpu.distance.distance_type import DistanceType
+from raft_tpu.distance.pairwise import expanded_sq_dists
+from raft_tpu.mr.tile_pool import TilePool, _pool_counter
+from raft_tpu.spatial.ann import (IVFFlatIndex, _assign_labels,
+                                  _extend_slot_layout, _merge_delta,
+                                  _validate_nprobe)
+from raft_tpu.spatial.select_k import select_k
+
+D = DistanceType
+
+__all__ = ["OocIVFFlat", "ivf_flat_to_ooc", "ooc_ivf_flat_search",
+           "ooc_extend", "ooc_reconstruct", "materialize_hot"]
+
+
+class OocIVFFlat(NamedTuple):
+    """IVF-Flat index with the slot store held on HOST (module doc).
+
+    Immutable like :class:`~raft_tpu.spatial.ann.IVFFlatIndex` — an
+    atomic snapshot swap (compaction) builds a new one; in-flight
+    searches keep gathering from the old ``store``."""
+
+    centroids: jnp.ndarray      # (nlist, d) device
+    slot_ids: jnp.ndarray       # (n_slots, cap) int32 device, -1 pad
+    slot_norms: jnp.ndarray     # (n_slots, cap) f32 device
+    cent_slots: jnp.ndarray     # (nlist, max_slots) int32 device
+    slot_centroid: np.ndarray   # (n_slots,) int32 HOST (extend/remap)
+    list_sizes: jnp.ndarray     # (nlist,)
+    metric: DistanceType
+    nprobe: int
+    store: np.ndarray           # (n_slots, cap, d) HOST — the bulk
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.store.shape[0])
+
+    @property
+    def cap(self) -> int:
+        return int(self.store.shape[1])
+
+    def slot_bytes(self) -> int:
+        """Device bytes one resident slot of vectors costs."""
+        return (self.cap * int(self.store.shape[2])
+                * self.store.dtype.itemsize)
+
+    def store_bytes(self) -> int:
+        """Total vector bytes of the host store — what the device
+        budget is measured against."""
+        return int(self.store.nbytes)
+
+
+def ivf_flat_to_ooc(index: IVFFlatIndex) -> OocIVFFlat:
+    """Demote a resident :class:`IVFFlatIndex` to the out-of-core form:
+    the slot vectors move to a host numpy store (dropping the caller's
+    reference to ``index`` then frees the device copy); the metadata
+    stays device-resident.  Builds at billion scale would assemble the
+    host store directly (:func:`ooc_extend` shows the shape) — this
+    converter is the bridge from the existing build path."""
+    expects(isinstance(index, IVFFlatIndex),
+            "ivf_flat_to_ooc: expected IVFFlatIndex, got %r",
+            type(index).__name__)
+    store = np.asarray(index.slot_vecs)
+    norms = (index.slot_norms if index.slot_norms is not None
+             else jnp.asarray(np.einsum("scd,scd->sc", store, store)))
+    slot_centroid = np.asarray(index.slot_centroid, np.int32)
+    return OocIVFFlat(index.centroids, index.slot_ids, norms,
+                      index.cent_slots, slot_centroid,
+                      index.list_sizes, index.metric, index.nprobe,
+                      store)
+
+
+# --------------------------------------------------------------------- #
+# programs (profiled_jit: the serve warmup proof sees every compile)
+# --------------------------------------------------------------------- #
+def _ooc_probe_impl(centroids, cent_slots, q, nprobe, select_impl=None):
+    """Probe + per-query slot-list compaction, device side.  Identical
+    probe selection to the resident `_probe_scan_search` (same
+    ``expanded_sq_dists`` + ``select_k`` + valid-first stable sort), so
+    the ooc arm probes exactly the lists the resident arm would."""
+    qn = jnp.sum(q * q, axis=1)
+    qc = expanded_sq_dists(q, centroids)
+    _, probes = select_k(qc, nprobe, select_min=True, impl=select_impl)
+    nq = q.shape[0]
+    slots = cent_slots[probes].reshape(nq, -1)           # -1-padded
+    _, slots = lax.sort(((slots < 0).astype(jnp.int32), slots),
+                        dimension=1, num_keys=1, is_stable=True)
+    return slots, qn
+
+
+_OOC_PROBE_STATICS = ("nprobe", "select_impl")
+_ooc_probe_jit = profiled_jit(
+    name="ooc_probe", static_argnames=_OOC_PROBE_STATICS)(_ooc_probe_impl)
+
+
+def _ooc_scan_impl(part_vecs, part_ids, slot_ids, slot_norms, q, qn,
+                   slots, run_d, run_i, k, select_impl=None):
+    """Scan ONE device-resident part (the hot set, or one staged tile)
+    against every query's probed-slot list, folding into the running
+    top-k.  Per-candidate arithmetic is byte-identical to the resident
+    `_ivf_flat_search_impl` step: gathered (nq, cap, d) block feeding
+    only the highest-precision einsum, precomputed norms.  Entries
+    whose slot is not in this part map to -1 and are compacted away —
+    each probed (query, slot) pair is scanned by exactly one part."""
+    nq = q.shape[0]
+    S = part_vecs.shape[0]
+    n_slots = slot_ids.shape[0]
+    # slot id -> position in this part (scatter; pad part entries dump
+    # into the n_slots overflow cell, which is then FORCED back to -1:
+    # it must read as "absent" both for pad tiles and for the invalid
+    # probed-slot entries that look up through it)
+    pos = jnp.full((n_slots + 1,), -1, jnp.int32)
+    pos = pos.at[jnp.where(part_ids >= 0, part_ids, n_slots)].set(
+        jnp.arange(S, dtype=jnp.int32))
+    pos = pos.at[n_slots].set(-1)
+    sp = pos[jnp.where(slots >= 0, slots, n_slots)]      # (nq, P)
+    # valid-first compaction as ONE stable variadic sort (the resident
+    # scan's idiom): preserves probe order among the entries this part
+    # holds
+    _, sp, sl = lax.sort(
+        ((sp < 0).astype(jnp.int32), sp, jnp.where(slots >= 0, slots, 0)),
+        dimension=1, num_keys=1, is_stable=True)
+    n_live = jnp.max(jnp.sum(sp >= 0, axis=1))
+    dt = run_d.dtype
+
+    def body(j, carry):
+        rd, ri = carry
+        valid = sp[:, j] >= 0
+        spx = jnp.where(valid, sp[:, j], 0)
+        slx = jnp.where(valid, sl[:, j], 0)
+        vecs = part_vecs[spx]                            # (nq, cap, d)
+        ids = slot_ids[slx]                              # (nq, cap)
+        dist = (qn[:, None] + slot_norms[slx]
+                - 2.0 * jnp.einsum("nd,ncd->nc", q, vecs,
+                                   precision="highest"))
+        ids = jnp.where(valid[:, None], ids, -1)
+        dist = jnp.where(ids >= 0, jnp.maximum(dist, 0.0),
+                         jnp.inf).astype(dt)
+        cat_d = jnp.concatenate([rd, dist], axis=1)
+        cat_i = jnp.concatenate([ri, ids], axis=1)
+        return select_k(cat_d, k, select_min=True, values=cat_i,
+                        impl=select_impl)
+
+    return lax.fori_loop(0, n_live, body, (run_d, run_i))
+
+
+_OOC_SCAN_STATICS = ("k", "select_impl")
+_ooc_scan_jit = profiled_jit(
+    name="ooc_scan", static_argnames=_OOC_SCAN_STATICS)(_ooc_scan_impl)
+# donating twin for STAGED TILES only: a tile is pool-owned fresh
+# storage, so the scan may recycle it; the hot set is persistent shared
+# state and must go through the non-donating wrapper
+_ooc_scan_jit_donated = profiled_jit(
+    name="ooc_scan_donated", static_argnames=_OOC_SCAN_STATICS,
+    donate_argnames=("part_vecs",))(_ooc_scan_impl)
+
+# one pool-labeled counter constructor for the whole tier — the
+# hit/miss families here must never skew from the pool's h2d families
+_tile_counter = _pool_counter
+
+
+# --------------------------------------------------------------------- #
+# search driver
+# --------------------------------------------------------------------- #
+def ooc_ivf_flat_search(ooc: OocIVFFlat, queries, k: int,
+                        nprobe: Optional[int] = None, *,
+                        pool: TilePool,
+                        hot: Optional[Tuple] = None,
+                        delta=None,
+                        donate_queries: bool = False,
+                        select_impl: Optional[str] = None,
+                        overlap: bool = True,
+                        probe_hook=None,
+                        force_rounds: int = 0,
+                        handle=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Search the out-of-core index (module doc).
+
+    ``hot`` is ``(hot_vecs (H, cap, d) device, hot_ids (H,) int32
+    device, hot_mask (n_slots,) bool numpy)`` or None (everything
+    streams).  ``overlap=False`` is the synchronous-prefetch baseline:
+    each tile's transfer completes before its scan starts and the
+    previous scan is drained first — the arm the bench measures the
+    double-buffering win against.  ``probe_hook(distinct_slots,
+    query_counts)`` feeds the caller's promotion counters.  ``force_rounds`` pads the tile
+    loop with empty tiles (warmup: compiles the tile-scan executables
+    even when the probed set happens to be fully hot).
+
+    ``donate_queries`` donates the query buffer to the delta-merge twin
+    only (the last consumer when a delta rides along); the streamed arm
+    always donates the *staged tiles* instead — that is where the
+    buffer traffic is.
+    """
+    q = jnp.asarray(queries)
+    nprobe = ooc.nprobe if nprobe is None else nprobe
+    nprobe = _validate_nprobe("ooc_ivf_flat_search", nprobe,
+                              int(ooc.centroids.shape[0]))
+    metric = DistanceType(int(ooc.metric))
+    slots, qn = _ooc_probe_jit(ooc.centroids, ooc.cent_slots, q,
+                               nprobe, select_impl=select_impl)
+    # the ONE D2H sync: per-query probed slot ids (a few KB)
+    slots_np = np.asarray(slots)
+    distinct, dcounts = np.unique(slots_np[slots_np >= 0],
+                                  return_counts=True)
+    if hot is not None and hot[0].shape[0]:
+        hot_mask = hot[2]
+        cold = distinct[~hot_mask[distinct]]
+    else:
+        hot = None
+        cold = distinct
+    hits = int(distinct.size - cold.size)
+    if hits:
+        _tile_counter("raft_tpu_tile_hits_total",
+                      "probed slots served from the device-resident "
+                      "hot set", pool.name).inc(hits)
+    if cold.size:
+        _tile_counter("raft_tpu_tile_misses_total",
+                      "probed slots streamed from the host store",
+                      pool.name).inc(int(cold.size))
+    if probe_hook is not None:
+        probe_hook(distinct, dcounts)
+
+    T = pool.tile_slots
+    chunks = [cold[i:i + T] for i in range(0, int(cold.size), T)]
+    while len(chunks) < force_rounds:
+        chunks.append(np.empty(0, np.int64))
+
+    nq = q.shape[0]
+    dtp = jnp.result_type(q.dtype, jnp.float32)
+    run = (jnp.full((nq, k), jnp.inf, dtp),
+           jnp.full((nq, k), -1, jnp.int32))
+    with default_profiler().span("ooc.scan", layer="ooc"):
+        if hot is not None:
+            run = _ooc_scan_jit(hot[0], hot[1], ooc.slot_ids,
+                                ooc.slot_norms, q, qn, slots,
+                                run[0], run[1], k,
+                                select_impl=select_impl)
+        staged = None
+        try:
+            if overlap and chunks:
+                # double buffering: the first transfer overlaps the
+                # hot scan when there is one; later transfers overlap
+                # the previous tile's scan
+                staged = pool.stage(ooc.store, chunks[0],
+                                    hidden=hot is not None)
+            for r in range(len(chunks)):
+                if not overlap:
+                    # synchronous baseline: drain the running scan,
+                    # then transfer, then scan — nothing overlaps by
+                    # design
+                    jax.block_until_ready(run)
+                    staged = pool.stage(ooc.store, chunks[r],
+                                        hidden=False)
+                # the scan still being in flight at the take is what
+                # makes the remaining transfer wait *hidden* wall time
+                vecs, ids_d = pool.take(staged,
+                                        busy=not run[0].is_ready())
+                staged = None
+                run = _ooc_scan_jit_donated(vecs, ids_d, ooc.slot_ids,
+                                            ooc.slot_norms, q, qn,
+                                            slots, run[0], run[1], k,
+                                            select_impl=select_impl)
+                if overlap and r + 1 < len(chunks):
+                    staged = pool.stage(ooc.store, chunks[r + 1],
+                                        hidden=True)
+        except BaseException:
+            # a scan/stage failure mid-stream must not strand a
+            # staged-not-taken tile's budget charge (the serve worker
+            # relays the error and keeps dispatching)
+            if staged is not None:
+                pool.discard(staged)
+            raise
+    dist, ids = run
+    if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+        dist = jnp.sqrt(dist)
+    out = (dist, ids)
+    if delta is not None:
+        out = _merge_delta(out, delta, q, k, metric, donate_queries)
+    record_on_handle(handle, *out)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# hot set / maintenance plumbing
+# --------------------------------------------------------------------- #
+def materialize_hot(ooc: OocIVFFlat, hot_ids: np.ndarray, *,
+                    pool_name: str = "ooc",
+                    device=None) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                          np.ndarray]:
+    """Commit the slots in ``hot_ids`` to device as the hot-set block;
+    returns ``(hot_vecs, hot_ids_device, hot_mask)``.  Budget-bounded
+    by construction (the caller sized H from its byte budget); counted
+    as H2D traffic like any other stream."""
+    ids = np.asarray(hot_ids, np.int32).ravel()
+    expects(ids.size == 0 or (ids.min() >= 0
+                              and ids.max() < ooc.n_slots),
+            "materialize_hot: slot ids out of range")
+    host = ooc.store[ids]
+    if device is not None:
+        vecs = jax.device_put(host, device)  # ooc-resident-ok (budget-bounded hot set)
+        ids_d = jax.device_put(ids, device)  # ooc-resident-ok (budget-bounded hot set)
+    else:
+        vecs = jax.device_put(host)  # ooc-resident-ok (budget-bounded hot set)
+        ids_d = jax.device_put(ids)  # ooc-resident-ok (budget-bounded hot set)
+    _tile_counter("raft_tpu_h2d_bytes_total",
+                  "bytes streamed host-to-device by tile pools",
+                  pool_name).inc(int(host.nbytes) + int(ids.nbytes))
+    mask = np.zeros(ooc.n_slots, bool)
+    mask[ids] = True
+    return vecs, ids_d, mask
+
+
+def ooc_reconstruct(ooc: OocIVFFlat) -> Tuple[np.ndarray, np.ndarray]:
+    """``(vectors, ids)`` from the host store (valid rows, slot order)
+    — the out-of-core twin of
+    :func:`~raft_tpu.spatial.ann.ivf_flat_reconstruct`; entirely
+    host-side."""
+    ids = np.asarray(ooc.slot_ids).reshape(-1)
+    mask = ids >= 0
+    vecs = ooc.store.reshape(-1, ooc.store.shape[-1])
+    return vecs[mask], ids[mask].astype(np.int64)
+
+
+def ooc_extend(ooc: OocIVFFlat, vectors, ids, *,
+               slot_multiple: int = 64) -> OocIVFFlat:
+    """Fold new rows into the out-of-core index — the compaction half
+    of streaming ingestion, host-side: same nearest-existing-centroid
+    assignment and slot-layout rounding as
+    :func:`~raft_tpu.spatial.ann.ivf_flat_extend`
+    (``_extend_slot_layout`` is literally shared), but the rebuilt slot
+    store is assembled in numpy and NEVER materialized on device — the
+    whole point of the tier.  Only the small metadata (ids, norms,
+    cent_slots) is re-committed."""
+    new_vecs = np.asarray(vectors, ooc.store.dtype)
+    expects(new_vecs.ndim == 2
+            and new_vecs.shape[1] == ooc.store.shape[2],
+            "ooc_extend: expected (rows, %d) vectors, got %r",
+            int(ooc.store.shape[2]), tuple(new_vecs.shape))
+    new_ids = np.asarray(ids, np.int64).ravel()
+    expects(new_ids.shape[0] == new_vecs.shape[0],
+            "ooc_extend: %d ids for %d vectors",
+            new_ids.shape[0], new_vecs.shape[0])
+    nlist = int(ooc.centroids.shape[0])
+    cap = ooc.cap
+
+    old_vecs, old_ids = ooc_reconstruct(ooc)
+    old_labels = np.repeat(ooc.slot_centroid, cap)[
+        np.asarray(ooc.slot_ids).reshape(-1) >= 0]
+    if new_vecs.shape[0]:
+        new_labels = np.asarray(_assign_labels(jnp.asarray(new_vecs),
+                                               ooc.centroids))
+        all_vecs = np.concatenate([old_vecs, new_vecs], axis=0)
+        all_ids = np.concatenate([old_ids, new_ids])
+        labels = np.concatenate(
+            [old_labels.astype(np.int64), new_labels.astype(np.int64)])
+    else:
+        all_vecs, all_ids = old_vecs, old_ids
+        labels = old_labels.astype(np.int64)
+
+    slot_rows, slot_cent, cent_slots, counts = _extend_slot_layout(
+        labels, nlist, cap, slot_multiple)
+    gather = np.clip(slot_rows, 0, None)
+    store = all_vecs[gather]
+    store[slot_rows < 0] = 0
+    slot_ids_np = np.where(slot_rows >= 0,
+                           all_ids[gather].astype(np.int32), -1)
+    # einsum, not (store * store).sum(-1): the elementwise square of a
+    # store-sized array would transiently double host memory
+    norms = np.einsum("scd,scd->sc", store, store)
+    return OocIVFFlat(ooc.centroids,
+                      jnp.asarray(slot_ids_np.astype(np.int32)),
+                      jnp.asarray(norms),
+                      jnp.asarray(cent_slots),
+                      slot_cent.astype(np.int32),
+                      jnp.asarray(counts, jnp.int32),
+                      ooc.metric, ooc.nprobe, store)

@@ -52,6 +52,17 @@ if [[ "${1:-}" == "chaos" ]]; then
         python tools/loadgen.py --chaos --seed "$i" --duration 3 \
             --concurrency 4 --index-rows 3000 --dim 16 --k 5 \
             --max-batch-rows 64 --max-wait-ms 1
+        # every third round runs the chaos scenario against the
+        # OUT-OF-CORE ANN tier (host-streamed slot store under a 1/4
+        # device budget): breaker/recovery/exactly-once must hold while
+        # tiles stream (docs/SERVING.md "Out-of-core serving")
+        if (( i % 3 == 0 )); then
+            echo "== serve chaos ooc $i/$n (seed=$i) =="
+            python tools/loadgen.py --chaos --service ann --ooc \
+                --clusters 32 --nlist 64 --seed "$i" --duration 3 \
+                --concurrency 3 --index-rows 8000 --dim 16 --k 5 \
+                --max-batch-rows 64 --max-wait-ms 1
+        fi
         # every other round runs the SHARDED variant with a permanent
         # shard kill: recovery must re-partition over the survivors
         # with exactly-once resolution and exact post-heal results

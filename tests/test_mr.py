@@ -1,5 +1,8 @@
 """Memory-resource tests (reference test/mr/device/buffer.cpp,
-test/mr/host/buffer.cpp)."""
+test/mr/host/buffer.cpp) — plus the out-of-core tier's TilePool
+budget/streaming contract (docs/ZERO_COPY.md §6)."""
+
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +11,7 @@ import pytest
 
 from raft_tpu import RaftError
 from raft_tpu.mr import (DeviceBuffer, HostBuffer, PoolAllocator,
-                         ZerosPool, default_zeros_pool,
+                         TilePool, ZerosPool, default_zeros_pool,
                          device_memory_stats, zeros_cached)
 
 
@@ -82,6 +85,199 @@ class TestPoolAllocator:
         a.deallocate()
         with pytest.raises(RaftError):
             pool.deallocate(a)
+
+    def test_byte_budget_enforced(self):
+        """Pooled bytes never exceed max_bytes; overflow evicts."""
+        pool = PoolAllocator(max_pooled_per_key=8, max_bytes=64)
+        bufs = [pool.allocate((4,), jnp.float32) for _ in range(6)]
+        for b in bufs:                      # 6 * 16 bytes > 64
+            pool.deallocate(b)
+        assert pool.pooled_bytes() <= 64
+        assert pool.n_evictions == 2
+        assert sum(b.deallocated for b in bufs) == 2
+
+    def test_eviction_order_oldest_pooled_first(self):
+        """The byte bound frees the LEAST-RECENTLY-POOLED buffer first,
+        across keys — a freshly returned buffer must never be the
+        victim."""
+        pool = PoolAllocator(max_pooled_per_key=8, max_bytes=40)
+        a = pool.allocate((4,), jnp.float32)   # 16 bytes
+        b = pool.allocate((2,), jnp.float32)   # 8 bytes
+        c = pool.allocate((4,), jnp.float32)   # 16 bytes
+        pool.deallocate(a)
+        pool.deallocate(b)                     # 24 pooled
+        pool.deallocate(c)                     # 40 pooled: fits
+        d = pool.allocate((2, 2), jnp.float32)  # new key, 16 bytes
+        pool.deallocate(d)          # 56 > 40: evict a (oldest) -> 40
+        assert a.deallocated
+        assert not b.deallocated and not c.deallocated \
+            and not d.deallocated
+        assert pool.pooled_bytes() == 40
+        f = pool.allocate((8,), jnp.float32)    # new key, 32 bytes
+        pool.deallocate(f)          # 72: evict b, c, d in pool order
+        assert b.deallocated and c.deallocated and d.deallocated
+        assert not f.deallocated
+        assert pool.pooled_bytes() == 32
+
+    def test_reuse_refreshes_nothing_but_removes_from_order(self):
+        """An allocate() that hits the freelist must leave the byte
+        accounting consistent (the buffer left the pool)."""
+        pool = PoolAllocator(max_bytes=64)
+        a = pool.allocate((4,), jnp.float32)
+        pool.deallocate(a)
+        assert pool.pooled_bytes() == 16
+        b = pool.allocate((4,), jnp.float32)
+        assert b is a and pool.pooled_bytes() == 0
+
+    def test_single_oversize_buffer_never_pooled(self):
+        pool = PoolAllocator(max_bytes=8)
+        a = pool.allocate((4,), jnp.float32)   # 16 > 8
+        pool.deallocate(a)
+        assert a.deallocated and pool.pooled_bytes() == 0
+
+    def test_release_resets_byte_accounting(self):
+        pool = PoolAllocator(max_bytes=1024)
+        pool.deallocate(pool.allocate((4,)))
+        pool.release()
+        assert pool.pooled_bytes() == 0
+        pool.deallocate(pool.allocate((4,)))   # usable after release
+        assert pool.pooled_bytes() == 16
+
+
+class TestTilePool:
+    """The out-of-core staging pool (docs/ZERO_COPY.md §6): gathered
+    tiles, budget enforcement, stall accounting."""
+
+    def _store(self, n_slots=16, cap=4, dim=3):
+        rng = np.random.default_rng(7)
+        return rng.standard_normal((n_slots, cap, dim)).astype(
+            np.float32)
+
+    def test_stage_take_round_trip(self):
+        store = self._store()
+        pool = TilePool(4, 1 << 20, name="t-rt")
+        tile = pool.stage(store, np.array([3, 1, 5]))
+        vecs, ids = pool.take(tile)
+        assert vecs.shape == (4, 4, 3)          # padded to tile_slots
+        np.testing.assert_array_equal(np.asarray(ids),
+                                      [3, 1, 5, -1])
+        np.testing.assert_allclose(np.asarray(vecs)[:3],
+                                   store[[3, 1, 5]])
+        assert pool.staged_bytes() == 0
+        assert pool.n_staged == 1 and pool.n_taken == 1
+
+    def test_double_take_rejected(self):
+        pool = TilePool(2, 1 << 20, name="t-dt")
+        tile = pool.stage(self._store(), np.array([0]))
+        pool.take(tile)
+        with pytest.raises(RaftError, match="already taken"):
+            pool.take(tile)
+
+    def test_budget_must_hold_two_tiles(self):
+        store = self._store()
+        tiny = TilePool(8, 64, name="t-tiny")   # one tile is 8*(48+4)
+        with pytest.raises(RaftError, match="double-buffer"):
+            tiny.stage(store, np.array([0]))
+
+    def test_overstage_from_one_thread_fails_loudly(self):
+        """A driver that stages past the budget without taking must get
+        AllocationError after the bounded wait, not a deadlock."""
+        from raft_tpu.core.error import AllocationError
+
+        store = self._store()
+        tile_b = 4 * (store.shape[1] * store.shape[2] * 4 + 4)
+        pool = TilePool(4, 2 * tile_b, name="t-over",
+                        stage_wait_s=0.2)
+        a = pool.stage(store, np.array([0]))
+        b = pool.stage(store, np.array([1]))
+        with pytest.raises(AllocationError):
+            pool.stage(store, np.array([2]))
+        pool.take(a)
+        pool.take(b)
+
+    def test_budget_holds_under_concurrent_traffic(self):
+        """The satellite acceptance: an oversubscribed pool shared by
+        concurrent searchers never exceeds its budget — proven by the
+        staged-bytes gauge's high-water, not asserted."""
+        from raft_tpu.core.metrics import default_registry
+
+        store = self._store(n_slots=64)
+        pool = TilePool(4, 3 * (4 * (store.shape[1] * store.shape[2]
+                                     * 4 + 4)),
+                        name="t-conc", stage_wait_s=10.0)
+        errors = []
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(25):
+                    ids = rng.integers(0, 64, 3)
+                    pool.take(pool.stage(store, ids))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert pool.staged_bytes() == 0
+        fam = default_registry().get("raft_tpu_tile_staged_bytes")
+        assert fam is not None
+        for labels, series in fam.series():
+            if labels.get("pool") == "t-conc":
+                assert series.high_water <= pool.budget_bytes
+                break
+        else:  # pragma: no cover
+            pytest.fail("staged-bytes gauge missing")
+
+    def test_discard_releases_budget(self):
+        """The unwind path: a staged-not-taken tile (its scan failed)
+        must give its budget charge back — a leaked reservation would
+        shrink the pool until every stage stalls out."""
+        store = self._store()
+        pool = TilePool(2, 1 << 20, name="t-disc")
+        tile = pool.stage(store, np.array([0]))
+        assert pool.staged_bytes() > 0
+        pool.discard(tile)
+        assert pool.staged_bytes() == 0
+        pool.discard(tile)                  # idempotent
+        assert pool.staged_bytes() == 0
+        with pytest.raises(RaftError, match="already taken"):
+            pool.take(tile)
+
+    def test_h2d_metrics_recorded(self):
+        from raft_tpu.core.metrics import default_registry
+
+        reg = default_registry()
+        b0 = reg.family_total("raft_tpu_h2d_bytes_total")
+        store = self._store()
+        pool = TilePool(2, 1 << 20, name="t-met")
+        pool.take(pool.stage(store, np.array([0, 1])))
+        assert reg.family_total("raft_tpu_h2d_bytes_total") > b0
+
+    def test_sync_stage_counts_exposed_stall(self):
+        """hidden=False (the synchronous-prefetch arm) charges the
+        stage-side host time to the stall timer; a fully hidden stage
+        whose take overlapped compute charges ~nothing."""
+        from raft_tpu.core.metrics import default_registry
+
+        store = self._store()
+        pool = TilePool(2, 1 << 20, name="t-stall")
+        pool.take(pool.stage(store, np.array([0]), hidden=False))
+        fam = default_registry().get("raft_tpu_h2d_stall_seconds")
+        total_sync = None
+        for labels, series in fam.series():
+            if labels.get("pool") == "t-stall":
+                total_sync = series.total
+        assert total_sync is not None and total_sync > 0.0
+        pool.take(pool.stage(store, np.array([1]), hidden=True),
+                  busy=True)
+        for labels, series in fam.series():
+            if labels.get("pool") == "t-stall":
+                assert series.total == total_sync  # hidden: no charge
 
 
 class TestZerosPool:

@@ -33,6 +33,12 @@ number (``--recall`` adds the same scoring to the exact services,
 where it doubles as an end-to-end correctness check: recall 1.0).
 ``--recall-target`` calibrates ``nprobe`` to the target before the
 measured run (recall-targeted dispatch, docs/SERVING.md).
+``--ooc --device-budget-mb N`` serves the out-of-core tier instead
+(host-resident slot store streamed through an N-MiB device budget,
+docs/SERVING.md "Out-of-core serving"); the report then carries
+``tile_hit_rate`` / ``h2d_mb`` / ``hidden_transfer_frac`` alongside
+recall, and the chaos/steady scenarios compose unchanged — including
+the 0-post-warmup-compiles assertion.
 
 ``--tenants`` runs the **mixed-tenant traffic-shaping scenario**
 (docs/SERVING.md "Traffic shaping"): closed-loop interactive clients
@@ -79,10 +85,45 @@ def _percentile(sorted_vals, q):
     return sorted_vals[idx]
 
 
-def _registry_serve_stats(service_name):
+def _ooc_pool_totals(service_name):
+    """Current pool-labeled out-of-core counters for one service
+    (pool name == service name) — the baseline :func:`run_load`
+    snapshots before the measured window so warmup's forced tile
+    streams do not pollute the reported hit rate / hidden fraction
+    (the bench's load-window-delta discipline)."""
+    from raft_tpu.core.metrics import default_registry
+
+    reg = default_registry()
+
+    def series_for(name):
+        fam = reg.get(name)
+        if fam is None:
+            return None
+        for labels, series in fam.series():
+            if labels.get("pool") == service_name:
+                return series
+        return None
+
+    out = {}
+    for key, name, attr in (
+            ("hits", "raft_tpu_tile_hits_total", "value"),
+            ("misses", "raft_tpu_tile_misses_total", "value"),
+            ("h2d_bytes", "raft_tpu_h2d_bytes_total", "value"),
+            ("h2d_s", "raft_tpu_h2d_seconds", "total"),
+            ("stall_s", "raft_tpu_h2d_stall_seconds", "total")):
+        s = series_for(name)
+        out[key] = float(getattr(s, attr)) if s is not None else 0.0
+    out["present"] = any(out[k] for k in ("hits", "misses",
+                                          "h2d_bytes"))
+    return out
+
+
+def _registry_serve_stats(service_name, ooc_base=None):
     """Padding-waste / batch-fill numbers for one service, read back
     from the metrics registry (the numbers the scheduler recorded —
-    loadgen measures the client side, the registry the server side)."""
+    loadgen measures the client side, the registry the server side).
+    ``ooc_base`` (a pre-run :func:`_ooc_pool_totals` snapshot) turns
+    the out-of-core counters into load-window deltas."""
     from raft_tpu.core.metrics import default_registry
 
     reg = default_registry()
@@ -118,6 +159,26 @@ def _registry_serve_stats(service_name):
     # device-resident paths (absent family == nothing ever staged)
     out["host_staged_bytes"] = int(
         reg.family_total("raft_tpu_comms_host_staged_bytes"))
+
+    # out-of-core tier (docs/SERVING.md "Out-of-core serving"): tile
+    # hit rate, H2D traffic and the hidden-transfer fraction as
+    # LOAD-WINDOW deltas against the pre-run baseline (warmup streams
+    # tiles too and must not pollute the measured window)
+    now = _ooc_pool_totals(service_name)
+    if now["present"]:
+        base = ooc_base or {k: 0.0 for k in now}
+        hits = now["hits"] - base.get("hits", 0.0)
+        miss = now["misses"] - base.get("misses", 0.0)
+        out["tile_hits"] = int(hits)
+        out["tile_misses"] = int(miss)
+        out["tile_hit_rate"] = (hits / (hits + miss)
+                                if hits + miss else 0.0)
+        out["h2d_mb"] = round(
+            (now["h2d_bytes"] - base.get("h2d_bytes", 0.0)) / 1e6, 1)
+        h2d_t = now["h2d_s"] - base.get("h2d_s", 0.0)
+        stall_t = now["stall_s"] - base.get("stall_s", 0.0)
+        out["hidden_transfer_frac"] = round(
+            1.0 - stall_t / h2d_t, 3) if h2d_t else 0.0
     return out
 
 
@@ -162,7 +223,8 @@ def make_query_pool(ref, rows, n=32, seed=1, noise=0.1):
 
 def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
                   nlist=None, nprobe=None, train_rows=None,
-                  mesh_devices=None, replicas=None, **opts):
+                  mesh_devices=None, replicas=None, ooc=False,
+                  device_budget_mb=None, **opts):
     """A ready (not yet warmed) service over a synthetic index.
 
     ``kind="ann"`` builds an IVF-Flat index over the data first
@@ -171,6 +233,11 @@ def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
     :class:`~raft_tpu.serve.ANNService`.  The generated reference
     matrix is attached as ``service.loadgen_ref`` so recall ground
     truth and query pools can reuse it without regeneration.
+    ``ooc=True`` serves the OUT-OF-CORE tier instead (docs/SERVING.md
+    "Out-of-core serving"): the slot store stays host-resident and the
+    device working set is bounded by ``device_budget_mb`` (default:
+    one quarter of the store — the oversubscription the tier exists
+    for).
 
     ``mesh_devices=N`` serves SHARDED (docs/SERVING.md "Sharded
     serving"): the index row-/slot-shards over a 1-D mesh spanning the
@@ -216,6 +283,13 @@ def build_service(kind, index_rows, dim, k, seed=0, clusters=0,
         params = IVFFlatParams(nlist=int(nlist),
                                nprobe=int(nprobe) if nprobe else 8)
         index = ivf_flat_build(ref, params, train_rows=train_rows)
+        if ooc:
+            import numpy as np
+
+            store_bytes = int(np.asarray(index.slot_vecs).nbytes)
+            budget = (int(device_budget_mb) << 20
+                      if device_budget_mb else store_bytes // 4)
+            opts = dict(opts, ooc=True, device_budget_bytes=budget)
         svc = ANNService(index, k=k, **opts)
     else:
         raise SystemExit("unknown --service %r" % kind)
@@ -366,6 +440,7 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         raise SystemExit("unknown --mode %r" % mode)
 
     misses0 = _compile_misses()
+    ooc_base = _ooc_pool_totals(service.name)
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -400,7 +475,8 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
             round(recall_acc["sum"] / recall_acc["n"], 4)
             if recall_acc["n"] else 0.0)
         report["recall_k"] = int(recall_k)
-    report.update(_registry_serve_stats(service.name))
+    report.update(_registry_serve_stats(service.name,
+                                        ooc_base=ooc_base))
     return report
 
 
@@ -503,6 +579,7 @@ def run_mixed_tenants(service, *, duration=5.0,
                 for t in range(interactive_concurrency)]
                + [threading.Thread(target=bulk_pacer, daemon=True)])
     misses0 = _compile_misses()
+    ooc_base = _ooc_pool_totals(service.name)
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -527,7 +604,8 @@ def run_mixed_tenants(service, *, duration=5.0,
             "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
             "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
         }
-    report.update(_registry_serve_stats(service.name))
+    report.update(_registry_serve_stats(service.name,
+                                        ooc_base=ooc_base))
     return report
 
 
@@ -877,6 +955,20 @@ def main(argv=None) -> int:
                     help="ann: served probe count (default: knob/index)")
     ap.add_argument("--train-rows", type=int, default=None,
                     help="ann: subsampled k-means training rows")
+    ap.add_argument("--ooc", action="store_true",
+                    help="ann: serve the OUT-OF-CORE tier — host-"
+                         "resident slot store streamed through a "
+                         "device budget (docs/SERVING.md); reports "
+                         "tile hit rate + hidden-transfer fraction "
+                         "alongside recall")
+    ap.add_argument("--device-budget-mb", type=int, default=None,
+                    metavar="N",
+                    help="ooc: device budget in MiB (default: a "
+                         "quarter of the slot-store bytes)")
+    ap.add_argument("--ooc-sync", action="store_true",
+                    help="ooc: synchronous-prefetch baseline arm (no "
+                         "double buffering) — the A/B the bench "
+                         "measures the overlap win against")
     ap.add_argument("--recall", action="store_true",
                     help="score recall@k against brute-force ground "
                          "truth (automatic for --service ann)")
@@ -956,6 +1048,24 @@ def main(argv=None) -> int:
     if args.service == "ann":
         opts.update(nlist=args.nlist, nprobe=args.nprobe,
                     train_rows=args.train_rows)
+        if args.ooc:
+            opts.update(ooc=True, device_budget_mb=args.device_budget_mb)
+            if args.ooc_sync:
+                opts["ooc_overlap"] = False
+    if (args.ooc or args.device_budget_mb is not None
+            or args.ooc_sync) and args.service != "ann":
+        raise SystemExit("--ooc/--device-budget-mb/--ooc-sync apply to "
+                         "the out-of-core ANN tier (--service ann)")
+    if (args.device_budget_mb is not None or args.ooc_sync) \
+            and not args.ooc:
+        # a resident run silently ignoring a memory budget would claim
+        # out-of-core numbers it never measured — same guard the
+        # ANNService constructor applies
+        raise SystemExit("--device-budget-mb/--ooc-sync require --ooc")
+    if args.ooc and args.mesh is not None:
+        raise SystemExit("--ooc does not compose with --mesh (the "
+                         "tier trades device memory for host "
+                         "streaming; shard the resident path instead)")
     if args.merge is not None:
         if args.mesh is None and args.replicas is None:
             raise SystemExit("--merge is the sharded cross-shard merge "
@@ -1104,6 +1214,7 @@ def main(argv=None) -> int:
     for key in ("duration_s", "requests_ok", "rejected", "errors", "qps",
                 "query_qps", "n_devices", "merge",
                 "recall_at_k", "recall_k", "nprobe", "delta_rows",
+                "tile_hit_rate", "h2d_mb", "hidden_transfer_frac",
                 "p50_ms", "p95_ms", "p99_ms", "queue_wait_p50_ms",
                 "queue_wait_p95_ms", "batches", "mean_batch_rows",
                 "padding_waste", "post_warmup_compiles",

@@ -169,6 +169,7 @@ def _serve_summary(metrics: dict) -> list:
     lines.extend(_serve_traffic_summary(metrics))
     lines.extend(_serve_resilience_summary(metrics))
     lines.extend(_serve_ann_summary(metrics))
+    lines.extend(_serve_ooc_summary(metrics))
     return lines
 
 
@@ -335,6 +336,58 @@ def _serve_ann_summary(metrics: dict) -> list:
         if mix:
             lines.append("  %-24s   batches by nprobe: %s" % (
                 "", "  ".join("nprobe=%s:%d" % t for t in mix)))
+    return lines
+
+
+def _serve_ooc_summary(metrics: dict) -> list:
+    """Out-of-core tier digest (docs/SERVING.md "Out-of-core
+    serving"): hot-set size, tile hit rate, H2D traffic, and the
+    overlap-efficiency number — the *hidden-transfer fraction*
+    ``1 - stall/h2d``: how much of the host-to-device copy time was
+    buried under the scan by the double-buffered prefetch (1.0 =
+    fully hidden, 0.0 = every transfer paid serially, which is what
+    the synchronous-prefetch arm measures)."""
+
+    def by_label(name, label):
+        out = {}
+        for s in metrics.get(name, {}).get("series", []):
+            key = s["labels"].get(label)
+            if key is not None:
+                out[key] = s
+        return out
+
+    hits = by_label("raft_tpu_tile_hits_total", "pool")
+    misses = by_label("raft_tpu_tile_misses_total", "pool")
+    pools = sorted(set(hits) | set(misses))
+    if not pools:
+        return []
+    evictions = by_label("raft_tpu_tile_evictions_total", "pool")
+    h2d_bytes = by_label("raft_tpu_h2d_bytes_total", "pool")
+    h2d = by_label("raft_tpu_h2d_seconds", "pool")
+    stall = by_label("raft_tpu_h2d_stall_seconds", "pool")
+    staged = by_label("raft_tpu_tile_staged_bytes", "pool")
+    hot_slots = by_label("raft_tpu_ooc_hot_slots", "service")
+    hot_bytes = by_label("raft_tpu_ooc_hot_bytes", "service")
+    lines = []
+    for pool in pools:
+        h = hits.get(pool, {}).get("value", 0)
+        m = misses.get(pool, {}).get("value", 0)
+        rate = h / (h + m) if (h + m) else 0.0
+        h2d_t = h2d.get(pool, {}).get("total", 0.0)
+        stall_t = stall.get(pool, {}).get("total", 0.0)
+        hidden = (1.0 - stall_t / h2d_t) if h2d_t else 0.0
+        lines.append(
+            "  %-24s OOC: hot_slots=%-6d hot_mb=%-8.1f "
+            "tile_hit_rate=%.3f evictions=%d"
+            % (pool, hot_slots.get(pool, {}).get("value", 0),
+               hot_bytes.get(pool, {}).get("value", 0) / 1e6,
+               rate, evictions.get(pool, {}).get("value", 0)))
+        lines.append(
+            "  %-24s   h2d=%.1fMB in %s (stall %s, hidden-transfer "
+            "fraction %.2f)  staged_peak=%.1fMB"
+            % ("", h2d_bytes.get(pool, {}).get("value", 0) / 1e6,
+               _fmt_s(h2d_t), _fmt_s(stall_t), hidden,
+               staged.get(pool, {}).get("high_water", 0) / 1e6))
     return lines
 
 
