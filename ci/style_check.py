@@ -64,12 +64,22 @@ Checks, per Python source file:
   ``ServiceOverloadError(msg, depth, cap)`` silently hands back the
   0.0 default — a shed site with genuinely no estimate marks the line
   ``shed-hint-ok``.
+- metric docs drift: every ``raft_tpu_*`` metric name registered in
+  ``raft_tpu/`` (a string literal inside a
+  counter/gauge/timer/labeled registry call) must appear in
+  ``docs/OBSERVABILITY.md`` — the naming table is the operator's
+  contract and it must not rot as instrumentation grows.  A
+  deliberately undocumented name (e.g. a test-only probe) carries a
+  ``metric-doc-ok`` marker comment on the line.  ``--selftest`` runs
+  the lint's own fixtures (detection, marker escape, documented-name
+  pass).
 
 Exit code 0 when clean; prints one line per violation otherwise.
 """
 
 import ast
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -137,6 +147,53 @@ SERVE_SHED_MARKER = "shed-hint-ok"
 SERVE_SHED_NAME = "ServiceOverloadError"
 SERVE_SHED_HINT_KW = "retry_after_s"
 
+# metric docs-drift lint (raft_tpu/ only): a raft_tpu_* name literal
+# inside a registry call (function name containing one of the hints)
+# must appear in docs/OBSERVABILITY.md; `metric-doc-ok` marks a
+# deliberately undocumented name
+METRIC_DOC = os.path.join("docs", "OBSERVABILITY.md")
+METRIC_DOC_MARKER = "metric-doc-ok"
+METRIC_NAME_RE = re.compile(r"^raft_tpu_[a-z0-9_]+$")
+METRIC_CALL_HINTS = ("counter", "gauge", "timer", "labeled")
+
+_metric_doc_text = None
+
+
+def _metric_doc(doc_text=None):
+    """The observability doc's text (cached); ``doc_text`` injects a
+    synthetic doc for the self-tests."""
+    global _metric_doc_text
+    if doc_text is not None:
+        return doc_text
+    if _metric_doc_text is None:
+        try:
+            with open(os.path.join(REPO, METRIC_DOC),
+                      encoding="utf-8") as f:
+                _metric_doc_text = f.read()
+        except OSError:
+            _metric_doc_text = ""
+    return _metric_doc_text
+
+
+def _metric_literals(tree):
+    """(name, lineno) of every raft_tpu_* string literal passed into a
+    registry-shaped call (counter/gauge/timer/_labeled and friends)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = (fn.attr if isinstance(fn, ast.Attribute)
+                 else getattr(fn, "id", ""))
+        if not any(h in fname.lower() for h in METRIC_CALL_HINTS):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and METRIC_NAME_RE.match(arg.value)):
+                out.append((arg.value, arg.lineno))
+    return out
+
 
 def _serve_handler_visible(handler):
     """Whether an ``except Exception`` handler relays (futures), counts
@@ -151,9 +208,15 @@ def _serve_handler_visible(handler):
     return False
 
 
-def check_file(path):
+def check_file(path, doc_text=None, repo_root=None):
+    """Lint one file.  ``doc_text`` injects a synthetic observability
+    doc and ``repo_root`` a synthetic tree root — both exist so
+    :func:`selftest` can run fixtures through THIS function (not a
+    copy of its logic).  ``repo_root=None`` resolves the module's
+    ``REPO`` at call time (tests monkeypatch it)."""
     problems = []
-    rel = os.path.relpath(path, REPO)
+    rel = os.path.relpath(path, REPO if repo_root is None
+                          else repo_root)
     with open(path, encoding="utf-8") as f:
         src = f.read()
     try:
@@ -181,6 +244,23 @@ def check_file(path):
     in_mnmg_jit_scope = rel in MNMG_JIT_FILES
     in_ooc_put_scope = rel in OOC_PUT_FILES
     src_lines = src.splitlines()
+    if rel.startswith("raft_tpu" + os.sep):
+        doc = _metric_doc(doc_text)
+        for mname, lineno in _metric_literals(tree):
+            # delimited match, not substring: an undocumented name
+            # that is a prefix of a documented one (misses vs
+            # misses_total) must still be flagged
+            documented = re.search(
+                r"(?<![A-Za-z0-9_])" + re.escape(mname)
+                + r"(?![A-Za-z0-9_])", doc)
+            if (not documented
+                    and METRIC_DOC_MARKER not in src_lines[lineno - 1]):
+                problems.append(
+                    f"{rel}:{lineno}: metric {mname} is not documented "
+                    f"in {METRIC_DOC} — add it to the naming table "
+                    "(the operator contract must not rot; "
+                    f"docs/OBSERVABILITY.md), or mark the line "
+                    f"`{METRIC_DOC_MARKER}`")
     # aliases the time/threading modules are bound to ("import time",
     # "import time as t") — attribute-call matching must follow them or
     # the bans are trivially evaded
@@ -354,7 +434,60 @@ def check_file(path):
     return problems
 
 
+def selftest():
+    """Executable fixtures for the metric docs-drift lint: an
+    undocumented registered name is flagged, a documented one passes,
+    the ``metric-doc-ok`` marker escapes, and a raft_tpu_* string
+    outside a registry call (e.g. a thread-attribute name) is ignored.
+    Returns the number of failed fixtures (0 = green)."""
+    import tempfile
+
+    doc = "| `raft_tpu_test_documented_total` | counter | fixture |\n"
+    cases = [
+        # (source, expect_flagged)
+        ('reg.counter("raft_tpu_test_undocumented_total")\n', True),
+        ('reg.counter("raft_tpu_test_documented_total")\n', False),
+        ('reg.counter("raft_tpu_test_undocumented_total")'
+         '  # metric-doc-ok: probe\n', False),
+        ('getattr(t, "raft_tpu_test_undocumented_total", None)\n',
+         False),
+        ('_labeled("gauge", "raft_tpu_test_undocumented_total", "h",'
+         ' "svc")\n', True),
+        # a PREFIX of a documented name is still undocumented — the
+        # substring-match hole the delimited regex closes
+        ('reg.counter("raft_tpu_test_documented")\n', True),
+    ]
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        # the lint only fires under raft_tpu/ — stage the fixtures in
+        # a synthetic repo root holding its own raft_tpu/ directory
+        fixdir = os.path.join(tmp, "raft_tpu")
+        os.makedirs(fixdir)
+        for i, (src, expect) in enumerate(cases):
+            path = os.path.join(fixdir, "fixture%d.py" % i)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(src)
+            # the REAL check_file, pointed at the synthetic tree root
+            # so the fixture is in scope exactly like a library file —
+            # a copy of the lint logic here would let the real lint
+            # regress while the selftest stayed green
+            problems = [p for p in check_file(path, doc_text=doc,
+                                              repo_root=tmp)
+                        if "not documented" in p]
+            flagged = bool(problems)
+            if flagged != expect:
+                failures += 1
+                print("selftest fixture %d: expected flagged=%s, "
+                      "got %r" % (i, expect, problems),
+                      file=sys.stderr)
+    print("metric-doc lint selftest: %d fixtures, %d failures"
+          % (len(cases), failures), file=sys.stderr)
+    return failures
+
+
 def main():
+    if "--selftest" in sys.argv[1:]:
+        return 1 if selftest() else 0
     files = list(EXTRA)
     for root in ROOTS:
         for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, root)):

@@ -142,6 +142,28 @@ serve_hedge_factor
 serve_hedge_min_ms
     Floor for the adaptive hedge threshold — hedging below it would
     duplicate healthy work on latency noise.  Free-form float ms.
+flight_events
+    Ring capacity (events) of the process-wide
+    :class:`raft_tpu.core.flight.FlightRecorder` — the bounded-memory
+    contract of the always-on flight recorder
+    (docs/OBSERVABILITY.md "Flight recorder & request tracing").
+    Consumed once, lazily, when the default recorder is first used.
+    ``RAFT_TPU_FLIGHT=0`` (not a knob — an env gate like
+    ``RAFT_TPU_METRICS``) disables recording entirely.  Free-form int.
+serve_slo_target_ms
+    Per-request latency objective for the per-tenant SLO tracker every
+    serve service carries (docs/OBSERVABILITY.md): a resolved request
+    slower than this counts as an SLO miss.  ``0`` = deadline-only
+    (only blown deadlines and failures miss).  Free-form float ms;
+    runtime-resolved at service construction.
+serve_slo_objective
+    The availability objective in (0, 1) the burn rate is measured
+    against (``burn = miss_rate / (1 - objective)``; burn > 1 spends
+    error budget faster than it accrues).  Free-form float.
+serve_slo_windows_s
+    Comma-separated burn-rate window lengths in seconds (multi-window
+    alerting: the short window catches a fast burn, the long one a
+    slow leak).  Free-form list.
 """
 
 from __future__ import annotations
@@ -196,6 +218,12 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "serve_hedge_ms": ("RAFT_TPU_SERVE_HEDGE_MS", "0", None),
     "serve_hedge_factor": ("RAFT_TPU_SERVE_HEDGE_FACTOR", "1.5", None),
     "serve_hedge_min_ms": ("RAFT_TPU_SERVE_HEDGE_MIN_MS", "10", None),
+    "flight_events": ("RAFT_TPU_FLIGHT_EVENTS", "4096", None),
+    "serve_slo_target_ms": ("RAFT_TPU_SERVE_SLO_TARGET_MS", "100", None),
+    "serve_slo_objective": ("RAFT_TPU_SERVE_SLO_OBJECTIVE",
+                            "0.99", None),
+    "serve_slo_windows_s": ("RAFT_TPU_SERVE_SLO_WINDOWS_S",
+                            "60,300", None),
 }
 
 # knobs resolved at *runtime* (service/object construction), never baked
@@ -209,7 +237,9 @@ _RUNTIME_KNOBS = frozenset(
      "serve_breaker_threshold", "serve_breaker_window",
      "serve_breaker_window_failures", "serve_breaker_cooldown_ms",
      "serve_ann_degrade_frac", "serve_tenant_weights",
-     "serve_hedge_ms", "serve_hedge_factor", "serve_hedge_min_ms"))
+     "serve_hedge_ms", "serve_hedge_factor", "serve_hedge_min_ms",
+     "flight_events", "serve_slo_target_ms", "serve_slo_objective",
+     "serve_slo_windows_s"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

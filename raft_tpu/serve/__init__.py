@@ -25,6 +25,13 @@ padded device call per shape bucket, so
   live in :mod:`~raft_tpu.serve.replicas` (docs/SERVING.md "Traffic
   shaping").
 
+Every layer also records into the flight recorder
+(:mod:`raft_tpu.core.flight`; docs/OBSERVABILITY.md "Flight recorder &
+request tracing"): each admitted request carries a trace_id and
+``ServeFuture.trace()`` returns its complete timeline; breaker trips
+and recoveries capture black-box dumps; every service tracks a
+per-tenant SLO with burn rates and slowest-K exemplars.
+
 Session integration: ``Comms.serve(...)`` constructs and registers a
 service; ``health_check()`` reports live services (breaker state and
 maintenance failures included), ``self_heal()`` recovers them, and

@@ -62,6 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import config
+from raft_tpu.core import flight
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import ServiceOverloadError, expects, fail
 from raft_tpu.serve.resilience import BreakerState
@@ -316,6 +317,16 @@ class ANNService(Service):
             # promotion signal: per-slot probe traffic (distinct slots
             # per batch, weighted by how many queries probed each)
             self._ooc_counters = np.zeros(index.n_slots, np.int64)
+            # tile-miss-storm detection baselines (maintenance-seam
+            # flight event, docs/OBSERVABILITY.md): cumulative batches
+            # and the registry's miss counter at the last check.  The
+            # miss baseline is seeded below, AFTER the pool exists —
+            # the pool-labeled counter is process-global and a reused
+            # service name must not inherit a dead incarnation's total
+            # as its own first-window delta
+            self._ooc_batches_total = 0
+            self._storm_batches0 = 0
+            self._storm_misses0 = 0.0
 
         if nprobe is None:
             nprobe = _knob_int("serve_ann_nprobe")
@@ -378,6 +389,7 @@ class ANNService(Service):
             self._ooc_pool = TilePool(self._ooc_tile_slots,
                                       self._ooc_pool_budget,
                                       name=self.name)
+            self._storm_misses0 = self._tile_misses_now()
             # initial hot set: slots of the biggest lists (the best
             # stand-in for probe traffic before any is observed);
             # promotion replaces it with the measured top-H
@@ -660,6 +672,7 @@ class ANNService(Service):
         if distinct.size and int(distinct[-1]) < c.size:
             c[distinct] += counts
         self._ooc_batches += 1
+        self._ooc_batches_total += 1
 
     def _ooc_promote_tick(self) -> None:
         """Maintenance hook: swap the hot set to the measured top-H
@@ -688,6 +701,9 @@ class ANNService(Service):
             "raft_tpu_tile_evictions_total",
             help="hot-set slots demoted by frequency promotion",
             labels=("pool",)).labels(pool=self.name).inc(int(evicted))
+        flight.record("hot_promote", service=self.name,
+                      promoted=int(fresh.size), evicted=int(evicted),
+                      hot_slots=int(self._ooc_hot_cap))
 
     def _ooc_remap_counters(self, old, new) -> None:
         """Carry the probe counters across a compaction's slot
@@ -788,15 +804,50 @@ class ANNService(Service):
 
     def _maintenance_tick(self) -> None:
         """Worker-loop hook: promote the out-of-core hot set when
-        probe traffic moved, and compact when the delta crosses the
-        threshold (never while draining — drain must serve out, not
-        start index rebuilds)."""
+        probe traffic moved, detect tile-miss storms, and compact when
+        the delta crosses the threshold (never while draining — drain
+        must serve out, not start index rebuilds)."""
         if self._ooc is not None:
+            self._ooc_storm_check()
             self._ooc_promote_tick()
         if (self._compact_rows
                 and self._delta_count >= self._compact_rows
                 and not self.batcher.draining()):
             self.compact()
+
+    def _tile_misses_now(self) -> float:
+        """Current value of this service's pool-labeled tile-miss
+        counter (0.0 before any miss) — the storm check's signal and
+        its construction-time baseline."""
+        fam = _metrics.default_registry().get("raft_tpu_tile_misses_total")
+        if fam is not None:
+            for labels, series in fam.series():
+                if labels.get("pool") == self.name:
+                    return float(series.value)
+        return 0.0
+
+    def _ooc_storm_check(self) -> None:
+        """Flag a tile-miss storm into the flight recorder: the
+        working set has outrun the hot set + staging window when the
+        recent per-batch tile-miss rate exceeds the whole staging
+        window (every batch re-streams more tiles than the double
+        buffer holds).  Off the hot path — reads the registry counter
+        on the maintenance seam only."""
+        if self._ooc_pool is None:
+            return
+        batches = self._ooc_batches_total - self._storm_batches0
+        if batches < 8:
+            return
+        misses = self._tile_misses_now()
+        delta = misses - self._storm_misses0
+        self._storm_batches0 = self._ooc_batches_total
+        self._storm_misses0 = misses
+        per_batch = delta / batches
+        if per_batch > 2.0 * self._ooc_tile_slots:
+            flight.record("tile_miss_storm", service=self.name,
+                          misses_per_batch=round(per_batch, 2),
+                          tile_slots=int(self._ooc_tile_slots),
+                          batches=int(batches))
 
     def compact(self) -> bool:
         """Re-cluster the delta segment into IVF slots and atomically
@@ -853,6 +904,8 @@ class ANNService(Service):
         _labeled("timer", "raft_tpu_serve_ann_compact_seconds",
                  "compaction latency (re-cluster + swap)",
                  self.name).observe(self._last_compact_s)
+        flight.record("compaction", service=self.name, rows=int(n0),
+                      seconds=round(self._last_compact_s, 6))
         return True
 
     # ------------------------------------------------------------------ #

@@ -41,6 +41,15 @@ Admission control happens at ``submit``: beyond the tenant's share of
 unbounded queue (shed, don't buffer — the queue would otherwise absorb
 the whole overload as latency).
 
+**Request tracing** (docs/OBSERVABILITY.md "Flight recorder & request
+tracing"): every admitted request is assigned a process-unique
+``trace_id`` and a :class:`~raft_tpu.core.flight.Trace` at admission —
+the ``admitted`` event carries the tenant's DRR share context (weight,
+queue depth, cap) so a later queue-wait number can be attributed to
+the share that produced it — and
+:meth:`ServeFuture.trace` hands the complete per-request timeline
+back after resolution.
+
 The clock is injectable (``clock=time.monotonic`` by default — note the
 function object is the default, the library never calls a wall clock
 ad hoc): deterministic tests drive a fake clock and the non-blocking
@@ -57,6 +66,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from raft_tpu.core import flight
 from raft_tpu.core.error import (
     CommTimeoutError,
     LogicError,
@@ -77,13 +87,14 @@ class ServeFuture:
     of threads may :meth:`result` / :meth:`wait` on it.
     """
 
-    __slots__ = ("_event", "_result", "_error", "_service")
+    __slots__ = ("_event", "_result", "_error", "_service", "_trace")
 
-    def __init__(self, service: str = "serve"):
+    def __init__(self, service: str = "serve", trace=None):
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
         self._service = service
+        self._trace = trace
 
     # -- worker side --------------------------------------------------- #
     def _set_result(self, value: Any) -> None:
@@ -126,12 +137,21 @@ class ServeFuture:
             raise self._unresolved(timeout)
         return self._error
 
+    def trace(self):
+        """The request's :class:`~raft_tpu.core.flight.Trace` — the
+        complete per-request timeline (admitted → queue wait → batch
+        id/rung → hedge outcome → execute bracket → terminal), built
+        as the request moves through the pipeline.  Complete once the
+        future is resolved (``trace().terminal()`` names how); None
+        when flight recording is disabled (``RAFT_TPU_FLIGHT=0``)."""
+        return self._trace
+
 
 class _Request:
     """One queued query block (rows of one submitter's array)."""
 
     __slots__ = ("payload", "rows", "enqueue_t", "deadline_t", "future",
-                 "requeued", "tenant", "tier", "seq", "taken")
+                 "requeued", "tenant", "tier", "seq", "taken", "trace")
 
     def __init__(self, payload, rows: int, enqueue_t: float,
                  deadline_t: Optional[float], service: str = "serve",
@@ -140,7 +160,11 @@ class _Request:
         self.rows = rows
         self.enqueue_t = enqueue_t
         self.deadline_t = deadline_t
-        self.future = ServeFuture(service)
+        # the request-scoped trace (None when flight recording is off):
+        # assigned HERE so the trace_id exists before any queue state
+        # does, and handed to the future for ServeFuture.trace()
+        self.trace = flight.default_recorder().new_trace(service, tenant)
+        self.future = ServeFuture(service, trace=self.trace)
         self.tenant = tenant
         self.tier = tier
         # FIFO tie-break within (tier, deadline); assigned at admission
@@ -371,6 +395,22 @@ class MicroBatcher:
                     retry_after_s=self._retry_after_locked())
             req.seq = self._seq
             self._seq += 1
+            # the admitted event is recorded BEFORE the request becomes
+            # visible to the worker (push/notify below): once pushed,
+            # an idle worker can form the batch and append
+            # batch_formed/resolved to this trace immediately — the
+            # timeline must already start with `admitted` or the
+            # queue-wait bracket renders out of order.  DRR share
+            # context is captured under the same lock the admission
+            # decision used (docs/OBSERVABILITY.md); the recorder lock
+            # is a leaf and nests safely under the cond lock.
+            flight.record(
+                "admitted", service=self.name, trace=req.trace,
+                rows=rows, tier=int(tier),
+                deadline_in_s=(None if deadline_t is None else
+                               round(deadline_t - req.enqueue_t, 6)),
+                depth=self._depth + 1, tenant_depth=tq.depth + 1,
+                tenant_weight=tq.weight, cap=cap)
             tq.push(req)
             self._arrivals.append(req)
             self._depth += 1

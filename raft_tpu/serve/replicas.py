@@ -43,8 +43,17 @@ Metrics (labels ``service=`` plus ``replica=`` where noted):
 ``raft_tpu_serve_hedge_cancelled_total`` (losers discarded/abandoned),
 ``raft_tpu_serve_replica_failovers_total`` (pre-hedge failure moved to
 another replica), ``raft_tpu_serve_replica_errors_total{replica=}``,
+``raft_tpu_serve_replica_exec_seconds{replica=}`` (per-replica
+execution latency — the per-replica split of the adaptive hedge
+threshold's signal; the traffic-shaping digest renders it),
 ``raft_tpu_serve_replica_state{replica=}`` (0=closed 1=open
 2=half-open), ``raft_tpu_serve_replicas_healthy``.
+
+Hedge decisions and winners are also recorded into the flight
+recorder (``replica_dispatch`` / ``hedge`` / ``hedge_win`` /
+``failover`` events, attached to every rider's trace via the worker's
+batch scope — docs/OBSERVABILITY.md "Flight recorder & request
+tracing").
 """
 
 from __future__ import annotations
@@ -53,12 +62,13 @@ import collections
 import contextlib
 import threading
 import time
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from raft_tpu.comms.faults import Fault, FaultInjector
+from raft_tpu.core import flight
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import (
     CALLER_BUG_ERRORS,
@@ -103,38 +113,106 @@ def _labeled(kind: str, name: str, help: str, service: str, **extra):
 
 
 class _LatencyTracker:
-    """Per-bucket-rung execution-latency window for the adaptive hedge
-    threshold.  Thread-safe (losing arms record from their own
+    """Execution-latency windows for the adaptive hedge threshold,
+    tracked BOTH per bucket rung (the PR 8 aggregate) and per
+    (replica, rung).  Thread-safe (losing arms record from their own
     threads); a rung with fewer than ``min_samples`` observations
     reports None — hedging stays off until the tracker has a real p99
-    to multiply."""
+    to multiply.
+
+    The per-replica split exists because the aggregate alone is wrong
+    under replica skew: one persistently slow replica inflates the
+    shared rung p99, which *raises* the hedge threshold exactly when
+    hedging should fire sooner.  :meth:`best_p99` — the minimum
+    per-replica p99 at the rung — tracks what a *healthy* replica can
+    do, so the threshold stays anchored to the latency a hedge could
+    actually achieve."""
 
     def __init__(self, window: int = 64, min_samples: int = 5):
         self._lock = threading.Lock()
         self._window = int(window)
         self._min = int(min_samples)
         self._rungs: dict = {}
+        self._replica_rungs: dict = {}   # (replica, rows) -> deque
 
-    def observe(self, rows: int, seconds: float) -> None:
+    def observe(self, rows: int, seconds: float,
+                replica: Optional[int] = None) -> None:
         with self._lock:
             dq = self._rungs.get(rows)
             if dq is None:
                 dq = self._rungs[rows] = collections.deque(
                     maxlen=self._window)
             dq.append(float(seconds))
+            if replica is not None:
+                key = (int(replica), rows)
+                rdq = self._replica_rungs.get(key)
+                if rdq is None:
+                    rdq = self._replica_rungs[key] = collections.deque(
+                        maxlen=self._window)
+                rdq.append(float(seconds))
+
+    @staticmethod
+    def _p99_of(dq) -> float:
+        s = sorted(dq)
+        return s[int(round(0.99 * (len(s) - 1)))]
 
     def p99(self, rows: int) -> Optional[float]:
         with self._lock:
             dq = self._rungs.get(rows)
             if dq is None or len(dq) < self._min:
                 return None
-            s = sorted(dq)
-            return s[int(round(0.99 * (len(s) - 1)))]
+            return self._p99_of(dq)
+
+    def replica_p99(self, replica: int, rows: int) -> Optional[float]:
+        with self._lock:
+            dq = self._replica_rungs.get((int(replica), rows))
+            if dq is None or len(dq) < self._min:
+                return None
+            return self._p99_of(dq)
+
+    def best_p99(self, rows: int,
+                 replicas: Optional[Sequence[int]] = None
+                 ) -> Optional[float]:
+        """The fastest replica's p99 at this rung (None until some
+        replica has ``min_samples`` there) — the adaptive hedge
+        threshold's anchor (class doc).
+
+        ``replicas`` restricts the minimum to those indices — the
+        caller passes the replicas currently IN ROTATION, because a
+        dead replica's frozen fast window would otherwise anchor the
+        threshold to a latency no survivor can meet (every batch would
+        hedge, doubling device work, until the dead replica's stale
+        window happened to be the slow one)."""
+        with self._lock:
+            allowed = None if replicas is None else set(replicas)
+            best = None
+            for (rep, r), dq in self._replica_rungs.items():
+                if allowed is not None and rep not in allowed:
+                    continue
+                if r == rows and len(dq) >= self._min:
+                    p = self._p99_of(dq)
+                    if best is None or p < best:
+                        best = p
+            return best
 
     def samples(self, rows: int) -> int:
         with self._lock:
             dq = self._rungs.get(rows)
             return len(dq) if dq is not None else 0
+
+    def per_replica(self) -> dict:
+        """{replica: {rung: {"p99_ms", "samples"}}} — the
+        traffic-shaping digest's per-replica latency table."""
+        with self._lock:
+            out: dict = {}
+            for (rep, rows), dq in sorted(self._replica_rungs.items()):
+                if not dq:
+                    continue
+                out.setdefault(rep, {})[rows] = {
+                    "p99_ms": round(self._p99_of(dq) * 1e3, 3),
+                    "samples": len(dq),
+                }
+            return out
 
 
 class _Replica:
@@ -322,12 +400,16 @@ class ReplicaSet:
                 for d in r.mesh.devices.ravel()}
 
     def describe(self) -> dict:
+        per_replica_lat = self.tracker.per_replica()
         return {
             "replicas": [
                 {"idx": r.idx,
                  "devices": [int(d.id) for d in r.mesh.devices.ravel()],
                  "state": ((BreakerState.CLOSED if r.breaker is None
-                            else r.breaker.state).name.lower())}
+                            else r.breaker.state).name.lower()),
+                 # per-(replica, rung) latency window — the signal the
+                 # adaptive hedge threshold anchors on (hedge_after)
+                 "latency": per_replica_lat.get(r.idx, {})}
                 for r in self.replicas],
             "hedge_ms": (None if self.hedge_s is None
                          else self.hedge_s * 1e3),
@@ -354,10 +436,23 @@ class ReplicaSet:
     def hedge_after(self, rows: int) -> Optional[float]:
         """Seconds to wait on the primary before hedging a ``rows``-row
         batch (None = never hedge: no fixed threshold and the tracker
-        has too few samples at this rung)."""
+        has too few samples at this rung).
+
+        Adaptive mode anchors on the FASTEST *in-rotation* replica's
+        per-(replica, rung) p99 rather than the shared rung aggregate
+        — one slow replica must not raise the threshold that decides
+        when to hedge *away from it* (the PR 8 residual), and a dead
+        replica's frozen fast window must not anchor a threshold no
+        survivor can meet.  The aggregate is the cold-start fallback
+        until any single replica has enough samples at the rung."""
         if self.hedge_s is not None:
             return self.hedge_s
-        p = self.tracker.p99(rows)
+        in_rotation = [r.idx for r in self.replicas
+                       if r.breaker is None
+                       or r.breaker.state is not BreakerState.OPEN]
+        p = self.tracker.best_p99(rows, replicas=in_rotation)
+        if p is None:
+            p = self.tracker.p99(rows)
         if p is None:
             return None
         return max(self.hedge_factor * p, self.hedge_min_s)
@@ -371,7 +466,12 @@ class ReplicaSet:
         if arm.error is None:
             if arm.seconds is not None:
                 self.tracker.observe(int(arm._payload.shape[0]),
-                                     arm.seconds)
+                                     arm.seconds, replica=r.idx)
+                _labeled("timer", "raft_tpu_serve_replica_exec_seconds",
+                         "batch execution latency per replica (the "
+                         "per-replica split of the hedge threshold's "
+                         "latency signal)", self.name,
+                         replica=r.idx).observe(arm.seconds)
             if r.breaker is not None:
                 r.breaker.record_success()
         else:
@@ -399,6 +499,10 @@ class ReplicaSet:
         primary = self._pick()
         if primary is None:
             self._shed_exhausted()
+        # attaches to every rider of the current batch (the worker's
+        # flight.batch_scope) — the trace's "which replica carried me"
+        flight.record_scoped("replica_dispatch", service=self.name,
+                             replica=primary.idx, rows=rows)
         threshold = self.hedge_after(rows)
         if threshold is None:
             # hedging cannot fire (adaptive threshold still cold): no
@@ -420,6 +524,9 @@ class ReplicaSet:
         _labeled("counter", "raft_tpu_serve_hedges_total",
                  "hedged re-dispatches fired on straggling batches",
                  self.name).inc()
+        flight.record_scoped("hedge", service=self.name,
+                             primary=primary.idx, hedge=hedge_rep.idx,
+                             threshold_s=round(threshold, 6))
         arm2 = _Arm(hedge_rep, padded, self._clock, race, self.name,
                     self._on_arm_finish)
         arms = (arm, arm2)
@@ -451,6 +558,10 @@ class ReplicaSet:
             _labeled("counter", "raft_tpu_serve_hedge_wins_total",
                      "hedged re-dispatches whose result beat the "
                      "straggling primary", self.name).inc()
+        flight.record_scoped("hedge_win", service=self.name,
+                             winner=winner.replica.idx,
+                             loser=loser.replica.idx,
+                             hedge_won=winner is arm2)
         return winner.out
 
     def _execute_blocking(self, replica: _Replica, padded, rows: int):
@@ -471,7 +582,13 @@ class ReplicaSet:
                 replica.breaker.record_failure(e)
             self._publish_states()
             raise
-        self.tracker.observe(rows, self._clock() - t0)
+        seconds = self._clock() - t0
+        self.tracker.observe(rows, seconds, replica=replica.idx)
+        _labeled("timer", "raft_tpu_serve_replica_exec_seconds",
+                 "batch execution latency per replica (the "
+                 "per-replica split of the hedge threshold's "
+                 "latency signal)", self.name,
+                 replica=replica.idx).observe(seconds)
         if replica.breaker is not None:
             replica.breaker.record_success()
         self._publish_states()
@@ -488,6 +605,9 @@ class ReplicaSet:
         _labeled("counter", "raft_tpu_serve_replica_failovers_total",
                  "batches moved to another replica after a primary "
                  "failure", self.name).inc()
+        flight.record_scoped("failover", service=self.name,
+                             failed=failed_idx, to=alt.idx,
+                             error=type(err).__name__)
         return self._execute_blocking(alt, padded, rows)
 
     def _run_inline(self, primary: _Replica, padded, rows: int):

@@ -61,6 +61,7 @@ import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 from raft_tpu.comms.faults import Fault, FaultInjector
+from raft_tpu.core import flight
 from raft_tpu.core.error import CALLER_BUG_ERRORS, expects
 from raft_tpu.serve.scheduler import ServeWorker, _counter, _gauge, _timer
 
@@ -172,6 +173,15 @@ class CircuitBreaker:
                  "circuit breaker trips (closed/half-open -> open)",
                  self.name).inc()
         self._publish_locked()
+        # the black box: the trip's postmortem tape is captured AT the
+        # trip — the last N flight events include the tripping batch's
+        # lifecycle (docs/OBSERVABILITY.md "Flight recorder & request
+        # tracing").  The recorder's lock nests safely under ours (it
+        # never takes a breaker lock).
+        flight.record("breaker_open", service=self.name,
+                      consecutive=self._consecutive)
+        flight.default_recorder().blackbox("breaker_trip",
+                                           service=self.name)
 
     def _to_half_open_locked(self) -> None:
         self._state = BreakerState.HALF_OPEN
@@ -179,12 +189,16 @@ class CircuitBreaker:
         self._probes_admitted = 0
         self._half_open_successes = 0
         self._publish_locked()
+        flight.record("breaker_half_open", service=self.name)
 
     def _close_locked(self) -> None:
+        was_open = self._state is not BreakerState.CLOSED
         self._state = BreakerState.CLOSED
         self._consecutive = 0
         self._outcomes.clear()
         self._publish_locked()
+        if was_open:
+            flight.record("breaker_closed", service=self.name)
 
     def _maybe_cooled_locked(self) -> None:
         if (self._state is BreakerState.OPEN
@@ -478,8 +492,16 @@ class RecoveryManager:
         with self._lock:
             t0 = self._clock()
             svcs = self._services()
+            # recovery phase events + the pre-recovery black box: the
+            # tape of the seconds leading INTO the failure is captured
+            # before the sequence mutates any state
+            flight.record("recovery_begin",
+                          services=[s.name for s in svcs],
+                          comms=bool(recover_comms))
+            flight.default_recorder().blackbox("recovery")
             for svc in svcs:
                 svc.pause()
+                flight.record("recovery_pause", service=svc.name)
             try:
                 # materialized first: all() over a generator would stop
                 # at the first wedged worker and leave later services
@@ -488,15 +510,19 @@ class RecoveryManager:
                     svc.worker.quiesce(timeout=quiesce_timeout)
                     for svc in svcs])
                 if recover_comms:
+                    flight.record("recovery_rebuild_comms")
                     self._session.recover(devices=devices, mesh=mesh)
                 for svc in svcs:
                     svc.post_recover()
                     if warmup:
                         svc.warmup()
+                        flight.record("recovery_warmup",
+                                      service=svc.name)
                     if (svc.worker.started()
                             and not svc.worker.is_alive()):
                         svc.worker.restart()
                     svc.resume()
+                    flight.record("recovery_readmit", service=svc.name)
                     _counter("raft_tpu_serve_recoveries_total",
                              "completed serving recoveries",
                              svc.name).inc()
@@ -516,6 +542,10 @@ class RecoveryManager:
                 _timer("raft_tpu_serve_recovery_seconds",
                        "pause-to-readmit recovery latency",
                        svc.name).observe(dt)
+            flight.record("recovery_done",
+                          services=[s.name for s in svcs],
+                          quiesced=bool(quiesced),
+                          recovery_s=round(dt, 6))
         return {"services": [s.name for s in svcs],
                 "comms_recovered": bool(recover_comms),
                 "quiesced": quiesced,

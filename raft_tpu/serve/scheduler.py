@@ -35,11 +35,22 @@ copies in the one case it could alias a caller's array).
 Every step feeds the ``raft_tpu_serve_*`` metric families (labeled
 ``service=<name>``) so ``metrics_snapshot()`` / ``tools/metrics_report.py``
 surface queue depth, batch fill, wait/exec latency, padding waste and
-per-bucket traffic without any serve-specific plumbing.
+per-bucket traffic without any serve-specific plumbing.  Every step
+ALSO records the request lifecycle into the flight recorder
+(docs/OBSERVABILITY.md "Flight recorder & request tracing"): batch
+formation (``batch_formed``: batch id, bucket rung, riders), the
+execute bracket (``execute_launch`` / ``execute_ready``), and exactly
+one terminal event per admitted request (``resolved`` / ``expired`` /
+``failed``; a recovery re-enqueue records a non-terminal
+``requeued``).  The device call runs under
+:func:`raft_tpu.core.flight.batch_scope` so deeper layers (replica
+hedging) attach their events to every rider's trace, and each
+resolution feeds the service's SLO tracker and slowest-K exemplars.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -47,6 +58,7 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import flight
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import CommTimeoutError, expects
 from raft_tpu.serve.batcher import MicroBatcher, _Request
@@ -54,21 +66,27 @@ from raft_tpu.serve.bucketing import BucketPolicy, coalesce, pad_rows
 
 __all__ = ["ServeWorker"]
 
+# process-global batch ids: unique across services, so one flight
+# stream never shows two concurrent batches sharing an id
+_batch_seq = itertools.count(1)
+
 
 class _Inflight:
     """One launched-but-unsplit batch (the pipeline register between
     the worker's start and finish halves)."""
 
     __slots__ = ("live", "spans", "bucket", "payload_rows", "out",
-                 "t_launch")
+                 "t_launch", "batch_id")
 
-    def __init__(self, live, spans, bucket, payload_rows, out, t_launch):
+    def __init__(self, live, spans, bucket, payload_rows, out, t_launch,
+                 batch_id=None):
         self.live = live
         self.spans = spans
         self.bucket = bucket
         self.payload_rows = payload_rows
         self.out = out
         self.t_launch = t_launch
+        self.batch_id = batch_id
 
 
 # -- registry helpers (resolved per use: cheap, and reset-proof — a test
@@ -161,6 +179,7 @@ class ServeWorker:
                  maintenance: Optional[Callable[[], None]] = None,
                  maintenance_interval_s: float = 0.05,
                  breaker=None,
+                 slo=None,
                  clock: Callable[[], float] = time.monotonic):
         self.name = name
         self._batcher = batcher
@@ -170,6 +189,13 @@ class ServeWorker:
         self._maintenance = maintenance
         self._maint_interval = float(maintenance_interval_s)
         self.breaker = breaker
+        # per-service SLO tracker (raft_tpu/core/flight.py) — fed one
+        # outcome per terminal request resolution; None = untracked
+        # (bare workers constructed outside a Service facade)
+        self.slo = slo
+        # the slowest-K exemplar reservoir, resolved once (the
+        # registry lookup must not ride the per-batch hot path)
+        self._exemplars = flight.exemplars_for(name)
         # last maintenance failure, surfaced via Service.stats():
         # {"type", "message", "at"} — "at" is the worker clock's
         # monotonic seconds (the only clock the library may read)
@@ -237,6 +263,7 @@ class ServeWorker:
             self._thread.start()
         _counter("raft_tpu_serve_worker_restarts_total",
                  "dead worker threads replaced", self.name).inc()
+        flight.record("worker_restart", service=self.name)
         return True
 
     def quiesce(self, timeout: Optional[float] = None) -> bool:
@@ -437,6 +464,12 @@ class ServeWorker:
             self.drain(timeout=timeout)
         leftovers = self._batcher.shutdown()
         for req in leftovers:
+            flight.record("expired", service=self.name, trace=req.trace,
+                          reason="close")
+            if self.slo is not None:
+                self.slo.observe(req.tenant,
+                                 self._clock() - req.enqueue_t,
+                                 deadline_ok=False)
             req.future._set_exception(CommTimeoutError(
                 "service %s closed before the request was served"
                 % self.name))
@@ -466,22 +499,42 @@ class ServeWorker:
         service_level = (self.breaker.record_failure(exc)
                          if self.breaker is not None else False)
         retry: List[_Request] = []
+        err_name = type(exc).__name__
         for req in live:
             if service_level and not req.requeued:
                 req.requeued = True
                 retry.append(req)
             else:
+                # terminal event before the future resolves (the
+                # trace-complete-at-resolution contract)
+                self._fail_terminal(req, err_name)
                 req.future._set_exception(exc)
         if retry:
             if self._batcher.requeue(retry):
                 _counter("raft_tpu_serve_requeued_total",
                          "riders re-enqueued once across a breaker "
                          "trip/recovery", self.name).inc(len(retry))
+                flight.record("requeued", service=self.name,
+                              traces=[r.trace for r in retry],
+                              error=err_name)
             else:
                 # queue already shut down: nobody will ever serve the
                 # re-enqueue — the exception is the only resolution
                 for req in retry:
+                    self._fail_terminal(req, err_name)
                     req.future._set_exception(exc)
+
+    def _fail_terminal(self, req: _Request, err_name: str) -> None:
+        """One request's terminal ``failed`` event + SLO miss (the
+        exactly-one-terminal contract's failure leg)."""
+        flight.record("failed", service=self.name, trace=req.trace,
+                      error=err_name,
+                      latency_s=round(
+                          max(0.0, self._clock() - req.enqueue_t), 6))
+        if self.slo is not None:
+            self.slo.observe(req.tenant,
+                             self._clock() - req.enqueue_t,
+                             deadline_ok=False)
 
     def _expire_locked_out(self, batch: List[_Request],
                            now: float) -> List[_Request]:
@@ -490,6 +543,14 @@ class ServeWorker:
         for req in batch:
             if req.deadline_t is not None and now >= req.deadline_t:
                 expired += 1
+                # terminal event before the future resolves (the
+                # trace-complete-at-resolution contract)
+                flight.record("expired", service=self.name,
+                              trace=req.trace, reason="deadline",
+                              waited_s=round(now - req.enqueue_t, 6))
+                if self.slo is not None:
+                    self.slo.observe(req.tenant, now - req.enqueue_t,
+                                     deadline_ok=False)
                 req.future._set_exception(CommTimeoutError(
                     "request exceeded its deadline after %.3fs in the "
                     "%s queue" % (now - req.enqueue_t, self.name)))
@@ -531,8 +592,14 @@ class ServeWorker:
             wait_t.observe(max(0.0, now - req.enqueue_t))
         payload_rows = sum(r.rows for r in live)
         launched = False
+        batch_id = next(_batch_seq)
+        rider_traces = [r.trace for r in live]
         try:
             bucket = self._policy.bucket_for(payload_rows)
+            flight.record("batch_formed", service=self.name,
+                          traces=rider_traces, batch=batch_id,
+                          rung=bucket, riders=len(live),
+                          rows=payload_rows)
             stacked, spans = coalesce([r.payload for r in live])
             padded = pad_rows(stacked, bucket)
             if (self.donate and len(live) == 1
@@ -551,23 +618,30 @@ class ServeWorker:
                    "payload rows in launched, not-yet-split device "
                    "calls", self.name).set(self._inflight_rows)
             t_launch = self._clock()
-            if self._retry_policy is not None:
-                # synchronous: each attempt must surface its own
-                # device failure INSIDE the retry loop, so block per
-                # attempt (module doc)
-                def attempt(p):
-                    res = self._execute(p)
-                    jax.block_until_ready(
-                        [x for x in jax.tree_util.tree_leaves(res)
-                         if hasattr(x, "shape")])
-                    return res
+            flight.record("execute_launch", service=self.name,
+                          traces=rider_traces, batch=batch_id,
+                          rung=bucket)
+            # batch_scope: deeper layers (replica rotation / hedging)
+            # attach their events to every rider's trace without the
+            # execute signature carrying trace handles
+            with flight.batch_scope(rider_traces):
+                if self._retry_policy is not None:
+                    # synchronous: each attempt must surface its own
+                    # device failure INSIDE the retry loop, so block
+                    # per attempt (module doc)
+                    def attempt(p):
+                        res = self._execute(p)
+                        jax.block_until_ready(
+                            [x for x in jax.tree_util.tree_leaves(res)
+                             if hasattr(x, "shape")])
+                        return res
 
-                out = self._retry_policy.call(
-                    attempt, padded, verb="serve.%s" % self.name)
-            else:
-                out = self._execute(padded)
+                    out = self._retry_policy.call(
+                        attempt, padded, verb="serve.%s" % self.name)
+                else:
+                    out = self._execute(padded)
             return _Inflight(live, spans, bucket, payload_rows, out,
-                             t_launch)
+                             t_launch, batch_id)
         except BaseException as e:  # noqa: BLE001 — relayed/requeued per rider
             self._fail_batch(live, e)
             if launched:
@@ -617,7 +691,30 @@ class ServeWorker:
                    "time the worker blocked on device results "
                    "(lower bound on device latency at split time)",
                    self.name).observe(max(0.0, t_ready - t_block))
+            flight.record("execute_ready", service=self.name,
+                          traces=[r.trace for r in live],
+                          batch=inflight.batch_id,
+                          exec_s=round(
+                              max(0.0, t_ready - inflight.t_launch), 6),
+                          block_s=round(max(0.0, t_ready - t_block), 6))
+            exemplars = self._exemplars
             for req, (start, stop) in zip(live, spans):
+                # terminal event + SLO/exemplar BEFORE the future
+                # resolves (the admitted-event ordering rule, mirrored
+                # at the other end): a caller woken by result() must
+                # already see the complete timeline
+                latency = max(0.0, t_ready - req.enqueue_t)
+                flight.record("resolved", service=self.name,
+                              trace=req.trace,
+                              batch=inflight.batch_id,
+                              latency_s=round(latency, 6))
+                if self.slo is not None:
+                    self.slo.observe(
+                        req.tenant, latency,
+                        deadline_ok=(req.deadline_t is None
+                                     or t_ready <= req.deadline_t))
+                if req.trace is not None:
+                    exemplars.observe(latency, req.trace.trace_id)
                 req.future._set_result(jax.tree_util.tree_map(
                     lambda leaf: leaf[start:stop], out))
         except BaseException as e:  # noqa: BLE001 — relayed/requeued per rider

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 
 from raft_tpu import config
 from raft_tpu.cache import VecCache
+from raft_tpu.core import flight
 from raft_tpu.core.error import (
     LogicError,
     ServiceOverloadError,
@@ -123,6 +124,22 @@ def _parse_tenant_weights(spec) -> Optional[dict]:
                 "serve_tenant_weights: %r is not name:weight" % tok
             ) from None
     return out or None
+
+
+def _parse_windows(spec) -> tuple:
+    """Resolve the ``serve_slo_windows_s`` knob's comma-separated
+    seconds list into an ascending float tuple."""
+    try:
+        out = tuple(sorted(float(tok) for tok in str(spec).split(",")
+                           if tok.strip()))
+    except ValueError:
+        raise ValueError(
+            "serve_slo_windows_s: %r is not a comma-separated number "
+            "list" % spec) from None
+    expects(len(out) > 0 and all(w > 0 for w in out),
+            "serve_slo_windows_s: %r resolves to no positive windows",
+            spec)
+    return out
 
 
 def _breaker_from_knobs(name: str, clock) -> Optional[CircuitBreaker]:
@@ -275,6 +292,22 @@ class Service:
         elif breaker is False:
             breaker = None
         self.breaker = breaker
+        # per-tenant SLO tracker (docs/OBSERVABILITY.md "Flight
+        # recorder & request tracing"): latency target +
+        # deadline-hit-rate with multi-window burn rates, fed by the
+        # worker per terminal request and surfaced through stats()
+        self.slo = flight.slo_for(
+            name,
+            target_s=_knob_float("serve_slo_target_ms") / 1e3,
+            objective=_knob_float("serve_slo_objective"),
+            windows_s=_parse_windows(
+                config.get("serve_slo_windows_s")),
+            clock=clock)
+        # fresh exemplars to match the fresh SLO tracker: a rebuilt
+        # service under a reused name must not report the dead
+        # incarnation's slowest trace_ids (cleared in place — the
+        # worker caches the same reservoir object)
+        flight.exemplars_for(name).clear()
         self.worker = ServeWorker(name, self.batcher, self.policy,
                                   execute, retry_policy=retry_policy,
                                   donate=donate_intent,
@@ -282,6 +315,7 @@ class Service:
                                   maintenance_interval_s=(
                                       maintenance_interval_s),
                                   breaker=breaker,
+                                  slo=self.slo,
                                   clock=clock)
         self.donate = self.worker.donate
         self._warmed: Tuple[int, ...] = ()
@@ -377,6 +411,8 @@ class Service:
                "devices the service's sharded index spans (0/absent = "
                "single-device)", self.name).set(
                    int(mesh.shape[self.axis]))
+        flight.record("repartition", service=self.name,
+                      devices=int(mesh.shape[self.axis]))
 
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> None:
@@ -446,6 +482,10 @@ class Service:
                 _tenant_counter("raft_tpu_serve_tenant_rejected_total",
                                 "requests shed by admission control, "
                                 "per tenant", self.name, e.tenant).inc()
+            # sheds precede admission, so no trace exists — a system
+            # event keeps them visible in the ordered stream anyway
+            flight.record("shed", service=self.name, tenant=e.tenant,
+                          reason="overload")
             raise
         _counter("raft_tpu_serve_submitted_total",
                  "admitted requests", self.name).inc()
@@ -459,6 +499,7 @@ class Service:
                  "requests shed because the service is broken or "
                  "healing (breaker open / dead worker / recovering)",
                  self.name).inc()
+        flight.record("shed", service=self.name, reason=reason)
         raise ServiceUnavailableError(message, self.name, reason,
                                       retry_after_s)
 
@@ -569,6 +610,11 @@ class Service:
             # a silently failing compactor/maintenance callback must be
             # visible here, not only as a bare counter
             "last_maintenance_error": self.worker.last_maintenance_error,
+            # per-tenant SLO state (hit ratio + multi-window burn) and
+            # the slowest-observation exemplars — a p99 complaint
+            # starts from stats() and ends at fut.trace() timelines
+            "slo": self.slo.snapshot(),
+            "exemplars": flight.exemplars_for(self.name).snapshot(),
         }
         if self.breaker is not None:
             out["breaker"] = self.breaker.describe()
@@ -885,6 +931,10 @@ class KNNService(Service):
                "devices the service's sharded index spans (0/absent = "
                "single-device)", self.name).set(
                    int(mesh.devices.size))
+        flight.record("repartition", service=self.name,
+                      devices=int(mesh.devices.size),
+                      replicas=(len(self._replica_set.replicas)
+                                if self._replica_set is not None else 0))
 
     def warmup(self) -> "Service":
         rs = self._replica_set

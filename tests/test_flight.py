@@ -1,0 +1,574 @@
+"""Flight recorder + request-scoped tracing (raft_tpu.core.flight;
+docs/OBSERVABILITY.md "Flight recorder & request tracing").
+
+The lifecycle invariant under test everywhere: every ADMITTED request
+yields exactly ONE terminal event (resolved/expired/failed) on a
+gapless, monotonically-timestamped timeline — across the plain path,
+deadline expiry, requeue-once over a breaker trip, hedged dispatch,
+recovery, and the out-of-core ANN path.  Plus: the ring-buffer memory
+bound holds under 16-thread sustained load with zero post-warmup
+compiles, breaker trips capture black-box dumps containing the
+tripping batch's events, SLO burn math is exact under a fake clock,
+and the trace_report renderings round-trip.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import config
+from raft_tpu.comms import faults
+from raft_tpu.core import flight
+from raft_tpu.core.flight import (
+    Exemplars,
+    FlightRecorder,
+    SLOTracker,
+    TERMINAL_KINDS,
+)
+from raft_tpu.core.metrics import default_registry
+from raft_tpu.core.profiler import compile_cache_stats
+from raft_tpu.serve import (
+    ANNService,
+    CircuitBreaker,
+    KNNService,
+    RecoveryManager,
+    inject_replica,
+    inject_worker,
+)
+from raft_tpu.spatial import ann
+
+pytestmark = pytest.mark.serve
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _flight_isolation():
+    """Each test starts from an empty recorder with recording ON and
+    leaves it that way (flight state is process-global)."""
+    flight.set_enabled(True)
+    flight.reset()
+    yield
+    flight.set_enabled(True)
+    flight.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def index(rng):
+    return jnp.asarray(rng.standard_normal((300, 16)), jnp.float32)
+
+
+def _total_misses():
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
+
+
+def _assert_wellformed(trace, expect_terminal=None):
+    """The per-trace invariants: non-empty, starts at admission, ends
+    terminal, exactly one terminal, timestamps monotonic (gapless in
+    the sense that every recorded step is present and ordered)."""
+    assert trace is not None
+    kinds = trace.kinds()
+    assert kinds, "empty timeline"
+    assert kinds[0] == "admitted"
+    terminals = [k for k in kinds if k in TERMINAL_KINDS]
+    assert len(terminals) == 1, "want exactly one terminal: %r" % kinds
+    assert kinds[-1] == terminals[0]
+    if expect_terminal is not None:
+        assert terminals[0] == expect_terminal
+    ts = [ev.ts for ev in trace.events]
+    assert ts == sorted(ts), "timeline not monotonic"
+    assert trace.dropped == 0
+    return kinds
+
+
+def _step(svc, fut, timeout=20.0):
+    t0 = time.monotonic()
+    while not fut.done():
+        svc.worker.run_once()
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("future did not resolve")
+        time.sleep(0.001)
+
+
+# ---------------------------------------------------------------------- #
+# recorder primitives
+# ---------------------------------------------------------------------- #
+class TestRecorder:
+    def test_ring_bound_and_order(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.record("tick", service="s", i=i)
+        assert len(rec) == 16
+        assert rec.capacity == 16
+        evs = rec.events()
+        assert [e.attrs["i"] for e in evs] == list(range(84, 100))
+        ts = [e.ts for e in evs]
+        assert ts == sorted(ts)
+
+    def test_filters(self):
+        rec = FlightRecorder(capacity=32)
+        rec.record("a", service="one")
+        rec.record("b", service="two")
+        rec.record("a", service="two")
+        assert [e.kind for e in rec.events(service="two")] == ["b", "a"]
+        assert len(rec.events(kind="a")) == 2
+        assert len(rec.events(last=1)) == 1
+
+    def test_trace_ids_unique_and_increasing(self):
+        rec = FlightRecorder(capacity=8)
+        ids = [rec.new_trace("s").trace_id for _ in range(5)]
+        assert ids == sorted(ids) and len(set(ids)) == 5
+
+    def test_blackbox_snapshot_and_dump(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        for i in range(12):
+            rec.record("tick", service="s", i=i)
+        box = rec.blackbox("unit_test", service="s", last=4)
+        assert box["reason"] == "unit_test"
+        assert [e["i"] for e in box["events"]] == [8, 9, 10, 11]
+        assert rec.blackbox_summaries()[0]["n_events"] == 4
+        path = tmp_path / "dump.json"
+        rec.dump_to(str(path))
+        data = json.loads(path.read_text())
+        assert data["capacity"] == 8
+        assert len(data["events"]) == 8
+        assert data["blackboxes"][0]["reason"] == "unit_test"
+
+    def test_disabled_is_noop(self):
+        rec = FlightRecorder(capacity=8)
+        flight.set_enabled(False)
+        assert rec.new_trace("s") is None
+        assert rec.record("tick") is None
+        assert len(rec) == 0
+
+    def test_capacity_knob(self):
+        with config.override(flight_events="7"):
+            rec = FlightRecorder()
+        assert rec.capacity == 7
+
+    def test_per_trace_cap_counts_drops(self):
+        rec = FlightRecorder(capacity=8)
+        tr = rec.new_trace("s")
+        for _ in range(flight.TRACE_MAX_EVENTS + 5):
+            rec.record("tick", trace=tr)
+        assert len(tr.events) == flight.TRACE_MAX_EVENTS
+        assert tr.dropped == 5
+
+
+# ---------------------------------------------------------------------- #
+# SLO + exemplars
+# ---------------------------------------------------------------------- #
+class TestSLO:
+    def test_hit_ratio_and_burn_windows(self):
+        clock = FakeClock(1000.0)
+        slo = SLOTracker("svc", target_s=0.1, objective=0.9,
+                         windows_s=(10.0, 100.0), clock=clock)
+        # 8 old hits, then 2 recent misses inside the short window
+        for _ in range(8):
+            slo.observe("t", 0.05)
+        clock.advance(50.0)
+        assert not slo.observe("t", 0.5)          # over target
+        assert not slo.observe("t", 0.05, deadline_ok=False)
+        snap = slo.snapshot()
+        st = snap["tenants"]["t"]
+        assert st["total"] == 10 and st["misses"] == 2
+        assert st["hit_ratio"] == pytest.approx(0.8)
+        # short window holds only the 2 misses -> miss rate 1.0,
+        # budget 0.1 -> burn 10; long window: 2/10 / 0.1 = 2
+        assert st["burn"]["10s"] == pytest.approx(10.0)
+        assert st["burn"]["100s"] == pytest.approx(2.0)
+        fam = default_registry().get("raft_tpu_serve_slo_burn_rate")
+        series = {tuple(sorted(lbl.items())): s.value
+                  for lbl, s in fam.series()}
+        assert series[(("service", "svc"), ("tenant", "t"),
+                       ("window", "10s"))] == pytest.approx(10.0)
+        misses = default_registry().get(
+            "raft_tpu_serve_slo_misses_total")
+        assert sum(s.value for _, s in misses.series()) == 2
+
+    def test_deadline_only_mode(self):
+        slo = SLOTracker("svc", target_s=0.0, objective=0.99,
+                         windows_s=(60.0,), clock=FakeClock())
+        assert slo.observe(None, 99.0)            # no target: a hit
+        assert not slo.observe(None, 0.01, deadline_ok=False)
+
+    def test_exemplars_keep_slowest(self):
+        ex = Exemplars(k=3)
+        for i, lat in enumerate([0.01, 0.5, 0.02, 0.9, 0.03, 0.4]):
+            ex.observe(lat, trace_id=i)
+        snap = ex.snapshot()
+        assert [e["trace_id"] for e in snap] == [3, 1, 5]
+        assert snap[0]["latency_ms"] == pytest.approx(900.0)
+
+
+# ---------------------------------------------------------------------- #
+# lifecycle through the serve pipeline
+# ---------------------------------------------------------------------- #
+class TestLifecycle:
+    def test_plain_resolution_timeline(self, index, rng):
+        clock = FakeClock()
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1.0,
+                         start=False, clock=clock)
+        try:
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            fut = svc.submit(q)
+            clock.advance(0.01)
+            assert svc.worker.run_once()
+            fut.result(timeout=0)
+            kinds = _assert_wellformed(fut.trace(), "resolved")
+            assert kinds == ["admitted", "batch_formed",
+                             "execute_launch", "execute_ready",
+                             "resolved"]
+            tl = fut.trace().timeline()
+            admitted = tl[0]
+            assert admitted["rows"] == 4 and admitted["depth"] == 1
+            formed = tl[1]
+            assert formed["rung"] == 8 and formed["riders"] == 1
+            assert "batch" in formed
+            ready = tl[3]
+            assert "exec_s" in ready and "block_s" in ready
+            assert tl[-1]["latency_s"] >= 0.0
+            # SLO fed: one hit for the default tenant
+            st = svc.stats()
+            assert st["slo"]["tenants"]["default"]["total"] == 1
+            assert st["exemplars"][0]["trace_id"] == \
+                fut.trace().trace_id
+        finally:
+            svc.close()
+
+    def test_deadline_expiry_terminal(self, index, rng):
+        clock = FakeClock()
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1.0,
+                         start=False, clock=clock)
+        try:
+            q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+            fut = svc.submit(q, timeout=0.5)
+            clock.advance(1.0)          # past deadline AND the window
+            svc.worker.run_once()
+            assert fut.exception(timeout=0) is not None
+            kinds = _assert_wellformed(fut.trace(), "expired")
+            assert "batch_formed" not in kinds  # expired pre-batch
+            tl = fut.trace().timeline()
+            assert tl[-1]["reason"] == "deadline"
+            assert svc.stats()["slo"]["tenants"]["default"][
+                "misses"] == 1
+        finally:
+            svc.close()
+
+    def test_close_expiry_terminal(self, index, rng):
+        clock = FakeClock()
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1e6,
+                         start=False, clock=clock)
+        q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+        fut = svc.submit(q)
+        svc.close(drain=False)
+        assert fut.exception(timeout=0) is not None
+        _assert_wellformed(fut.trace(), "expired")
+        assert fut.trace().timeline()[-1]["reason"] == "close"
+
+    def test_requeue_once_then_failed_and_blackbox(self, index, rng):
+        """Breaker trip path: first failure requeues (non-terminal
+        `requeued`), the second strike is the one terminal `failed`;
+        the trip captures a black box holding the tripping batch's
+        events."""
+        clock = FakeClock()
+        breaker = CircuitBreaker("flightknn", failure_threshold=1,
+                                 cooldown_s=0.2, clock=clock)
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1.0,
+                         start=False, clock=clock, breaker=breaker,
+                         name="flightknn")
+        try:
+            q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+            with inject_worker(svc.worker,
+                               faults.FailNth(1, persistent=True)):
+                fut = svc.submit(q)
+                clock.advance(0.01)
+                svc.worker.run_once()       # fails -> trip -> requeue
+                assert not fut.done()
+                assert "requeued" in fut.trace().kinds()
+                clock.advance(0.5)          # past cooldown: half-open
+                svc.worker.run_once()       # second strike -> failed
+                assert fut.exception(timeout=0) is not None
+            kinds = _assert_wellformed(fut.trace(), "failed")
+            assert kinds.count("requeued") == 1
+            assert kinds.count("batch_formed") == 2
+            # the trip's black box contains this batch's events
+            boxes = [b for b in
+                     flight.default_recorder().blackboxes()
+                     if b["reason"] == "breaker_trip"
+                     and b["service"] == "flightknn"]
+            assert boxes
+            box_kinds = [e["kind"] for e in boxes[0]["events"]
+                         if e.get("service") == "flightknn"]
+            assert "batch_formed" in box_kinds
+            assert "execute_launch" in box_kinds
+            # breaker transitions are in the ordered stream
+            sys_kinds = [e.kind for e in
+                         flight.default_recorder().events(
+                             service="flightknn")]
+            assert "breaker_open" in sys_kinds
+        finally:
+            svc.close()
+
+    def test_hedge_path_timeline(self, index, rng):
+        svc = KNNService(index, k=5, replicas=2, hedge_ms=60.0,
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            with inject_replica(svc, 0, faults.Delay(0.8)):
+                futs = [svc.submit(jnp.copy(q)) for _ in range(4)]
+                for f in futs:
+                    f.result(timeout=60)
+            time.sleep(1.0)   # abandoned losers wake and bail
+            hedged = [f for f in futs
+                      if "hedge" in f.trace().kinds()]
+            assert hedged, "no hedge event reached any trace"
+            for f in futs:
+                kinds = _assert_wellformed(f.trace(), "resolved")
+                assert "replica_dispatch" in kinds
+            tl = hedged[0].trace().timeline()
+            hedge_ev = next(e for e in tl if e["kind"] == "hedge")
+            assert {"primary", "hedge", "threshold_s"} <= set(hedge_ev)
+            assert any(e["kind"] == "hedge_win" for e in tl)
+        finally:
+            svc.close()
+
+    def test_recovery_events_and_survival(self, index, rng):
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            manager = RecoveryManager(services=[svc])
+            manager.recover()
+            sys_kinds = [e.kind
+                         for e in flight.default_recorder().events()]
+            for k in ("recovery_begin", "recovery_pause",
+                      "recovery_warmup", "recovery_readmit",
+                      "recovery_done"):
+                assert k in sys_kinds, k
+            boxes = flight.default_recorder().blackboxes()
+            assert any(b["reason"] == "recovery" for b in boxes)
+            # traffic still resolves cleanly post-recovery
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            fut = svc.submit(q)
+            fut.result(timeout=30)
+            _assert_wellformed(fut.trace(), "resolved")
+        finally:
+            svc.close()
+
+    def test_ooc_path_timeline_and_events(self, rng):
+        X = jnp.asarray(rng.standard_normal((2500, 24)), jnp.float32)
+        idx = ann.ivf_flat_build(
+            X, ann.IVFFlatParams(nlist=24, nprobe=6), seed=SEED)
+        store_bytes = int(np.asarray(idx.slot_vecs).nbytes)
+        svc = ANNService(idx, k=10, ooc=True,
+                         device_budget_bytes=max(1, store_bytes // 3),
+                         max_batch_rows=32, bucket_rungs=(8, 32),
+                         max_wait_ms=1.0, nprobe_ladder=(4, 8),
+                         delta_cap=64, compact_rows=0, start=False)
+        try:
+            q = jnp.asarray(rng.standard_normal((4, 24)), jnp.float32)
+            fut = svc.submit(q)
+            _step(svc, fut)
+            _assert_wellformed(fut.trace(), "resolved")
+            # compaction lands in the same ordered stream
+            svc.insert(np.arange(8) + 10_000,
+                       rng.standard_normal((8, 24)).astype(np.float32))
+            assert svc.compact()
+            kinds = [e.kind for e in
+                     flight.default_recorder().events(
+                         service=svc.name)]
+            assert "compaction" in kinds
+        finally:
+            svc.close()
+
+    def test_shed_records_system_event(self, index, rng):
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1e6,
+                         queue_cap=1, start=False, clock=FakeClock())
+        try:
+            q = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+            svc.submit(q)
+            with pytest.raises(Exception):
+                svc.submit(q)
+            sheds = flight.default_recorder().events(kind="shed")
+            assert sheds and sheds[-1].attrs["reason"] == "overload"
+            assert sheds[-1].trace_id is None
+        finally:
+            svc.close(drain=False)
+
+    def test_disabled_recording_end_to_end(self, index, rng):
+        flight.set_enabled(False)
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1.0)
+        try:
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            fut = svc.submit(q)
+            fut.result(timeout=30)
+            assert fut.trace() is None
+            assert len(flight.default_recorder()) == 0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# sustained concurrent load: bound + exactly-once + zero compiles
+# ---------------------------------------------------------------------- #
+class TestSustainedLoad:
+    def test_16_threads_bounded_ring_zero_compiles(self, index, rng):
+        svc = KNNService(index, k=5, max_batch_rows=64,
+                         bucket_rungs=(8, 16, 64), max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            m0 = _total_misses()
+            pool = [jnp.asarray(rng.standard_normal((2, 16)),
+                                jnp.float32) for _ in range(8)]
+            futs = []
+            lock = threading.Lock()
+
+            def client(tid):
+                mine = []
+                for i in range(25):
+                    f = svc.submit(jnp.copy(pool[(tid + i) % 8]))
+                    f.result(timeout=60)
+                    mine.append(f)
+                with lock:
+                    futs.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive()
+            assert len(futs) == 16 * 25
+            rec = flight.default_recorder()
+            assert len(rec) <= rec.capacity
+            for f in futs:
+                _assert_wellformed(f.trace(), "resolved")
+            assert _total_misses() == m0
+            snap = svc.stats()["slo"]["tenants"]["default"]
+            assert snap["total"] > 0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# snapshot + renderings + lint self-tests
+# ---------------------------------------------------------------------- #
+class TestSurfaces:
+    def test_metrics_snapshot_flight_section(self, index, rng):
+        from raft_tpu.session import metrics_snapshot
+
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1.0)
+        try:
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            svc.submit(q).result(timeout=30)
+            svc.stats()   # publishes SLO gauges
+        finally:
+            svc.close()
+        fl = metrics_snapshot()["flight"]
+        assert fl["enabled"] is True
+        assert 0 < fl["events"] <= fl["capacity"]
+        assert svc.name in fl["slo"]
+        assert svc.name in fl["exemplars"]
+
+    def test_trace_report_renderings(self, index, rng, tmp_path):
+        sys.path.insert(0, REPO)
+        from tools.trace_report import (
+            load_events,
+            render_waterfall,
+            to_chrome_trace,
+            trace_ids,
+        )
+
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=1.0)
+        try:
+            q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+            fut = svc.submit(q)
+            fut.result(timeout=30)
+        finally:
+            svc.close()
+        timeline = fut.trace().timeline()
+        water = render_waterfall(timeline)
+        for kind in ("admitted", "execute_ready", "resolved"):
+            assert kind in water
+        chrome = to_chrome_trace(timeline)
+        phases = {e["ph"] for e in chrome}
+        assert "X" in phases and "i" in phases
+        names = {e["name"] for e in chrome}
+        assert {"queue", "execute", "request"} <= names
+        # dump -> load round trip
+        path = tmp_path / "dump.json"
+        flight.default_recorder().dump_to(str(path))
+        events = load_events(json.loads(path.read_text()))
+        assert fut.trace().trace_id in trace_ids(events)
+        json.dumps(chrome)   # valid JSON payload
+
+    def test_loadgen_slow_trace_capture(self, index):
+        sys.path.insert(0, REPO)
+        from tools.loadgen import run_load
+
+        svc = KNNService(index, k=5, max_batch_rows=32,
+                         bucket_rungs=(8, 32), max_wait_ms=0.5)
+        try:
+            svc.warmup()
+            rep = run_load(svc, mode="closed", duration=1.0,
+                           concurrency=2, rows=2, trace_k=2)
+        finally:
+            svc.close()
+        slow = rep["slow_traces"]
+        assert 1 <= len(slow) <= 2
+        assert slow[0]["latency_ms"] >= slow[-1]["latency_ms"]
+        assert slow[0]["timeline"][0]["kind"] == "admitted"
+        assert slow[0]["timeline"][-1]["kind"] == "resolved"
+
+    def test_style_check_metric_lint_selftest(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "ci",
+                                          "style_check.py"),
+             "--selftest"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+
+    def test_health_check_surfaces_blackboxes(self):
+        flight.default_recorder().record("tick", service="s")
+        flight.default_recorder().blackbox("unit", service="s")
+        # session-free surface: the summaries feed health_check
+        summaries = flight.default_recorder().blackbox_summaries()
+        assert summaries[0]["reason"] == "unit"

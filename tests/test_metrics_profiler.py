@@ -917,8 +917,12 @@ class TestSessionSnapshot:
         loaded = json.loads(path.read_text())
         assert set(loaded) == {"metrics", "compile_cache",
                                "profiler_tree", "profiler_report",
-                               "event_counters"}
+                               "event_counters", "flight"}
         assert loaded["metrics"].keys() == written["metrics"].keys()
+        # the flight section (docs/OBSERVABILITY.md "Flight recorder &
+        # request tracing") rides in every artifact
+        assert {"enabled", "events", "capacity", "blackboxes", "slo",
+                "exemplars"} <= set(loaded["flight"])
 
     def test_module_level_snapshot_matches_session(self):
         from raft_tpu import session as session_mod

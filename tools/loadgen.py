@@ -61,6 +61,14 @@ request resolves exactly once**, with a result or a *typed* error
 (``RaftError`` taxonomy).  Lost futures or untyped errors fail the run
 (exit 1).  ``stress.sh chaos N`` loops it with rotating seeds.
 
+``--trace [K]`` captures the flight-recorder timelines of the K
+slowest requests (default 3) and prints their waterfalls next to the
+p99 row (docs/OBSERVABILITY.md "Flight recorder & request tracing");
+``--trace-dump PATH`` writes the whole recorder (ring + black boxes)
+for ``tools/trace_report.py``.  A chaos run that FAILS its acceptance
+assertion always dumps the black-box buffer to a
+``flight_*_seed<N>.json`` file — the postmortem starts from the tape.
+
 Importable: :func:`run_load` / :func:`run_chaos` return the report
 dict (bench.py's ``serve`` rungs and tests reuse them).
 """
@@ -332,7 +340,7 @@ def _ground_truth_for_pool(service, pool, k):
 
 def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
              qps=100.0, rows=4, seed=0, deadline=None, recall=False,
-             query_pool=None, tenant=None):
+             query_pool=None, tenant=None, trace_k=0):
     """Drive ``service`` for ``duration`` seconds; returns the report.
 
     Latencies are client-observed submit→result seconds.  Rejected
@@ -347,7 +355,16 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
     :func:`make_query_pool` for data-aligned queries).  ``tenant``
     tags every submit (traffic shaping; the per-tenant solo baseline
     the mixed-tenant scenario compares against).
+
+    ``trace_k > 0`` keeps the flight-recorder timelines of the K
+    slowest completed requests (docs/OBSERVABILITY.md "Flight recorder
+    & request tracing"): the report gains ``slow_traces`` — each with
+    its trace_id and full timeline — so the p99 row links directly to
+    the requests behind it (``--trace`` prints their waterfalls).
     """
+    import heapq
+    import itertools
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -377,6 +394,10 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
     latencies = []
     counts = {"ok": 0, "rejected": 0, "errors": 0}
     recall_acc = {"sum": 0.0, "n": 0}
+    # slowest-K capture: a min-heap of (latency, seq, future) so the
+    # run retains at most K futures (and their traces), not all
+    slow_heap = []
+    slow_seq = itertools.count()
     stop_t = time.monotonic() + duration
 
     def one_request(i):
@@ -394,6 +415,13 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
                 counts["errors"] += 1
             return
         dt = time.monotonic() - t0
+        if trace_k:
+            with lock:
+                item = (dt, next(slow_seq), fut)
+                if len(slow_heap) < trace_k:
+                    heapq.heappush(slow_heap, item)
+                elif dt > slow_heap[0][0]:
+                    heapq.heapreplace(slow_heap, item)
         r = None
         if gt is not None:
             got = np.asarray(out[1])
@@ -475,6 +503,16 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
             round(recall_acc["sum"] / recall_acc["n"], 4)
             if recall_acc["n"] else 0.0)
         report["recall_k"] = int(recall_k)
+    if trace_k:
+        slow = []
+        for dt, _, fut in sorted(slow_heap, reverse=True):
+            tr = fut.trace()
+            slow.append({
+                "latency_ms": round(dt * 1e3, 3),
+                "trace_id": tr.trace_id if tr is not None else None,
+                "timeline": tr.timeline() if tr is not None else [],
+            })
+        report["slow_traces"] = slow
     report.update(_registry_serve_stats(service.name,
                                         ooc_base=ooc_base))
     return report
@@ -942,6 +980,28 @@ def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
     return report
 
 
+def _dump_flight(path):
+    """Write the flight recorder's full state (ring + black boxes) to
+    ``path`` and say so — the chaos postmortem artifact
+    (tools/trace_report.py renders it)."""
+    from raft_tpu.core import flight
+
+    flight.default_recorder().dump_to(path)
+    print("flight recorder dumped to %s (render with "
+          "tools/trace_report.py)" % path, file=sys.stderr)
+
+
+def _print_waterfalls(slow_traces):
+    """The slowest-K waterfalls next to the p99 row (--trace)."""
+    from tools.trace_report import render_waterfall
+
+    for entry in slow_traces:
+        print("-- slow request: %.3fms (trace %s) --"
+              % (entry["latency_ms"], entry["trace_id"]))
+        if entry["timeline"]:
+            print(render_waterfall(entry["timeline"]))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--service", choices=("knn", "pairwise", "ann"),
@@ -1036,6 +1096,16 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline", type=float, default=None,
                     help="per-request deadline seconds")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", type=int, nargs="?", const=3, default=0,
+                    metavar="K",
+                    help="capture flight timelines for the K slowest "
+                         "requests (default 3) and print their "
+                         "waterfalls next to the latency rows "
+                         "(docs/OBSERVABILITY.md)")
+    ap.add_argument("--trace-dump", metavar="PATH", default=None,
+                    help="write the whole flight recorder (ring + "
+                         "black boxes) to PATH after the run "
+                         "(tools/trace_report.py renders it)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw report dict as JSON")
     args = ap.parse_args(argv)
@@ -1074,6 +1144,14 @@ def main(argv=None) -> int:
         opts["merge"] = args.merge
     if args.kill_shard and (args.mesh is None or args.mesh < 2):
         raise SystemExit("--kill-shard requires --mesh >= 2")
+    if args.trace and (args.chaos or args.hedge_chaos or args.tenants):
+        # slow-request capture rides the plain load loop only; a
+        # silently ignored flag would read as "tracing is broken" to
+        # exactly the user debugging a chaos run
+        raise SystemExit("--trace applies to plain load runs; chaos/"
+                         "tenant scenarios capture the whole recorder "
+                         "instead — use --trace-dump PATH (failed "
+                         "chaos assertions dump it automatically)")
     if args.hedge_chaos and (args.replicas is None or args.replicas < 2):
         raise SystemExit("--hedge-chaos requires --replicas >= 2")
     if args.hedge_ms is not None:
@@ -1111,6 +1189,11 @@ def main(argv=None) -> int:
                         "chaos_ok"):
                 if key in report:
                     print("  %-20s %s" % (key, report[key]))
+        if args.trace_dump:
+            _dump_flight(args.trace_dump)
+        elif not report["chaos_ok"]:
+            # a failed chaos assertion always leaves the tape behind
+            _dump_flight("flight_hedge_chaos_seed%d.json" % args.seed)
         return 0 if report["chaos_ok"] else 1
     if args.tenants:
         try:
@@ -1167,6 +1250,11 @@ def main(argv=None) -> int:
                         "post_recovery_exact", "chaos_ok"):
                 if key in report:
                     print("  %-20s %s" % (key, report[key]))
+        if args.trace_dump:
+            _dump_flight(args.trace_dump)
+        elif not report["chaos_ok"]:
+            # a failed chaos assertion always leaves the tape behind
+            _dump_flight("flight_chaos_seed%d.json" % args.seed)
         return 0 if report["chaos_ok"] else 1
     want_recall = args.recall or args.service == "ann"
     pool = None
@@ -1187,9 +1275,11 @@ def main(argv=None) -> int:
                           concurrency=args.concurrency, qps=args.qps,
                           rows=args.rows, seed=args.seed,
                           deadline=args.deadline, recall=want_recall,
-                          query_pool=pool)
+                          query_pool=pool, trace_k=args.trace)
     finally:
         service.close()
+    if args.trace_dump:
+        _dump_flight(args.trace_dump)
     report["warmup_s"] = round(warmup_s, 3)
     report["buckets"] = list(service.policy.rungs)
     if getattr(service, "axis", None) is not None:
@@ -1224,6 +1314,8 @@ def main(argv=None) -> int:
             if isinstance(val, float):
                 val = "%.3f" % val
             print("  %-20s %s" % (key, val))
+    if report.get("slow_traces"):
+        _print_waterfalls(report["slow_traces"])
     return 0
 
 

@@ -72,6 +72,11 @@ def render_report(snap: dict) -> str:
         lines.append("== serving (per service: traffic / batching / "
                      "waste / latency) ==")
         lines.extend(serve)
+    slo = _serve_slo_summary(metrics, snap.get("flight", {}))
+    if slo:
+        lines.append("== SLO burn & exemplars (docs/OBSERVABILITY.md "
+                     "\"Flight recorder & request tracing\") ==")
+        lines.extend(slo)
     cc = snap.get("compile_cache", {})
     if cc:
         lines.append("== jit compile cache (per fn: shapes / hits / "
@@ -173,6 +178,58 @@ def _serve_summary(metrics: dict) -> list:
     return lines
 
 
+def _serve_slo_summary(metrics: dict, flight: dict) -> list:
+    """SLO digest: per-(service, tenant) hit ratio, misses, and the
+    multi-window burn rates from the ``raft_tpu_serve_slo_*`` gauges,
+    plus the slowest-observation exemplars from the snapshot's
+    ``flight`` section — each p99 complaint gets the trace_ids to pull
+    with ``tools/trace_report.py``."""
+    hit = {}
+    for s in metrics.get("raft_tpu_serve_slo_hit_ratio",
+                         {}).get("series", []):
+        key = (s["labels"].get("service"), s["labels"].get("tenant"))
+        if key[0] is not None:
+            hit[key] = s["value"]
+    burns = {}
+    for s in metrics.get("raft_tpu_serve_slo_burn_rate",
+                         {}).get("series", []):
+        key = (s["labels"].get("service"), s["labels"].get("tenant"))
+        if key[0] is not None:
+            burns.setdefault(key, []).append(
+                (s["labels"].get("window"), s["value"]))
+    misses = {}
+    for s in metrics.get("raft_tpu_serve_slo_misses_total",
+                         {}).get("series", []):
+        key = (s["labels"].get("service"), s["labels"].get("tenant"))
+        if key[0] is not None:
+            misses[key] = int(s["value"])
+    lines = []
+    for key in sorted(set(hit) | set(burns) | set(misses)):
+        svc, tenant = key
+        burn_s = "  ".join(
+            "burn[%s]=%.2f" % bw
+            for bw in sorted(burns.get(key, []), key=lambda t: str(t[0])))
+        lines.append(
+            "  %-24s tenant=%-12s hit_ratio=%-8.4f misses=%-6d %s"
+            % (svc, tenant, hit.get(key, 1.0), misses.get(key, 0),
+               burn_s))
+    for svc, exemplars in sorted((flight or {}).get("exemplars",
+                                                    {}).items()):
+        if exemplars:
+            lines.append(
+                "  %-24s   slowest: %s" % (svc, "  ".join(
+                    "%.1fms(trace %d)" % (e["latency_ms"],
+                                          e["trace_id"])
+                    for e in exemplars[:5])))
+    bbs = (flight or {}).get("blackboxes", [])
+    if bbs:
+        lines.append("  black boxes: %s" % "  ".join(
+            "%s@%.1f(%s, %d events)"
+            % (b["reason"], b["at"], b.get("service") or "-",
+               b["n_events"]) for b in bbs))
+    return lines
+
+
 def _serve_traffic_summary(metrics: dict) -> list:
     """Traffic-shaping digest (docs/SERVING.md "Traffic shaping"):
     per-tenant served rows / requests / sheds, hedged-dispatch ledger
@@ -241,6 +298,23 @@ def _serve_traffic_summary(metrics: dict) -> list:
     for svc, reps in sorted(rep_states.items()):
         lines.append("  %-24s   rotation: %s" % (
             svc, "  ".join("r%s=%s" % r for r in sorted(reps))))
+    # per-replica execution latency (the per-replica split the
+    # adaptive hedge threshold anchors on — one slow replica must be
+    # VISIBLE here, not averaged into the rung aggregate)
+    rep_lat = {}
+    for s in metrics.get("raft_tpu_serve_replica_exec_seconds",
+                         {}).get("series", []):
+        svc = s["labels"].get("service")
+        rep = s["labels"].get("replica")
+        if svc is not None and rep is not None and s["count"]:
+            rep_lat.setdefault(svc, []).append(
+                (str(rep), s["p50"], s["p95"], s["count"]))
+    for svc, reps in sorted(rep_lat.items()):
+        lines.append("  %-24s   replica exec: %s" % (
+            svc, "  ".join(
+                "r%s p50=%s p95=%s (n=%d)"
+                % (r, _fmt_s(p50), _fmt_s(p95), n)
+                for r, p50, p95, n in sorted(reps))))
     return lines
 
 

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Render raft_tpu flight-recorder traces (docs/OBSERVABILITY.md
+"Flight recorder & request tracing").
+
+Two renderings of the same event stream:
+
+- **Chrome trace-event JSON** (``--chrome out.json``): the format
+  chrome://tracing and Perfetto (https://ui.perfetto.dev) open
+  directly.  Request brackets (queue wait = admitted→batch_formed,
+  execute = execute_launch→execute_ready, total = admitted→terminal)
+  become complete ("X") slices, one track per trace_id; everything
+  else (hedges, requeues, breaker transitions, compactions) becomes
+  instant events; system events without a trace_id land on a
+  per-service ``system`` track.
+- **Terminal waterfall** (``--trace-id N``): one request's timeline as
+  an offset-annotated bar chart — the "why was THIS request slow"
+  screen (``tools/loadgen.py --trace`` prints the same rendering for
+  the slowest requests of a run).
+
+Input is any flight dump JSON: ``FlightRecorder.dump_to()`` output
+(``{"events": [...], "blackboxes": [...]}``), a single black-box dump
+(``{"reason", "events"}``), or a bare event list.  Events are dicts
+with at least ``ts`` (monotonic seconds) and ``kind``; see the event
+vocabulary table in docs/OBSERVABILITY.md.
+
+Usage:
+    python tools/trace_report.py dump.json                # summary
+    python tools/trace_report.py dump.json --trace-id 17  # waterfall
+    python tools/trace_report.py dump.json --chrome trace.json
+
+Importable: :func:`to_chrome_trace`, :func:`render_waterfall`,
+:func:`trace_ids` (loadgen and tests reuse them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# bracket pairs rendered as complete slices: name -> (open kind, close
+# kind); "total" additionally closes on any terminal kind
+BRACKETS = {
+    "queue": ("admitted", "batch_formed"),
+    "execute": ("execute_launch", "execute_ready"),
+}
+TERMINALS = ("resolved", "expired", "failed")
+
+
+def load_events(obj) -> List[dict]:
+    """Events out of any flight dump shape (module doc)."""
+    if isinstance(obj, list):
+        return list(obj)
+    if isinstance(obj, dict):
+        if "events" in obj:
+            return list(obj["events"])
+        if "ring" in obj:
+            return list(obj["ring"])
+    raise SystemExit("unrecognized flight dump shape (want a list of "
+                     "events, or a dict with 'events')")
+
+
+def event_trace_ids(ev: dict) -> List[int]:
+    """The trace ids an event belongs to: its own ``trace_id``, or —
+    for a shared batch-level ring event — the rider list the recorder
+    stamped as ``traces`` (empty = a system event)."""
+    tid = ev.get("trace_id")
+    if tid is not None:
+        return [int(tid)]
+    return [int(t) for t in ev.get("traces", ())]
+
+
+def trace_ids(events: List[dict]) -> List[int]:
+    """Distinct trace ids present, admission order."""
+    seen: Dict[int, None] = {}
+    for ev in events:
+        for tid in event_trace_ids(ev):
+            seen.setdefault(tid, None)
+    return list(seen)
+
+
+def _by_trace(events: List[dict]) -> Dict[int, List[dict]]:
+    out: Dict[int, List[dict]] = {}
+    for ev in events:
+        for tid in event_trace_ids(ev):
+            out.setdefault(tid, []).append(ev)
+    return out
+
+
+def to_chrome_trace(events: List[dict]) -> List[dict]:
+    """Chrome trace-event JSON objects (the ``traceEvents`` array;
+    Perfetto accepts the bare array too).  Timestamps are microseconds
+    relative to the earliest event."""
+    if not events:
+        return []
+    t0 = min(float(ev["ts"]) for ev in events)
+
+    def us(ts: float) -> float:
+        return round((float(ts) - t0) * 1e6, 1)
+
+    out: List[dict] = []
+    for tid, evs in sorted(_by_trace(events).items()):
+        svc = next((e.get("service") for e in evs
+                    if e.get("service")), "serve")
+        track = "trace %d" % tid
+        opens: Dict[str, float] = {}
+        first_ts = float(evs[0]["ts"])
+        for ev in evs:
+            kind = ev["kind"]
+            for name, (ko, kc) in BRACKETS.items():
+                if kind == ko:
+                    opens[name] = float(ev["ts"])
+                elif kind == kc and name in opens:
+                    start = opens.pop(name)
+                    out.append({"name": name, "ph": "X", "pid": svc,
+                                "tid": track, "ts": us(start),
+                                "dur": round(
+                                    (float(ev["ts"]) - start) * 1e6,
+                                    1)})
+            args = {k: v for k, v in ev.items()
+                    if k not in ("ts", "kind")}
+            out.append({"name": kind, "ph": "i", "s": "t", "pid": svc,
+                        "tid": track, "ts": us(ev["ts"]), "args": args})
+            if kind in TERMINALS:
+                out.append({"name": "request", "ph": "X", "pid": svc,
+                            "tid": track, "ts": us(first_ts),
+                            "dur": round(
+                                (float(ev["ts"]) - first_ts) * 1e6, 1),
+                            "args": {"terminal": kind}})
+    for ev in events:
+        if not event_trace_ids(ev):
+            svc = ev.get("service") or "system"
+            args = {k: v for k, v in ev.items()
+                    if k not in ("ts", "kind")}
+            out.append({"name": ev["kind"], "ph": "i", "s": "g",
+                        "pid": svc, "tid": "system",
+                        "ts": us(ev["ts"]), "args": args})
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def render_waterfall(timeline: List[dict], width: int = 48) -> str:
+    """One trace's timeline as a terminal waterfall: per event, the
+    offset from admission, a position marker scaled over the request's
+    total duration, the kind, and the load-bearing attrs."""
+    if not timeline:
+        return "(empty trace)"
+    t0 = float(timeline[0]["ts"])
+    t1 = float(timeline[-1]["ts"])
+    span = max(t1 - t0, 1e-9)
+    head = timeline[0]
+    lines = ["trace %s  service=%s tenant=%s  total=%.3fms"
+             % (head.get("trace_id", "?"), head.get("service", "?"),
+                head.get("tenant", "?"), span * 1e3)]
+    for ev in timeline:
+        off = float(ev["ts"]) - t0
+        pos = min(width - 1, int(round(off / span * (width - 1))))
+        bar = "·" * pos + "█"
+        attrs = {k: v for k, v in ev.items()
+                 if k not in ("ts", "kind", "service", "tenant",
+                              "trace_id", "traces") and v is not None}
+        attr_s = " ".join("%s=%s" % kv for kv in sorted(attrs.items()))
+        lines.append("  %9.3fms  %-*s %-16s %s"
+                     % (off * 1e3, width + 1, bar, ev["kind"], attr_s))
+    return "\n".join(lines)
+
+
+def summarize(events: List[dict]) -> str:
+    """Per-trace one-liners plus the system-event tail — the index a
+    postmortem starts from."""
+    lines = []
+    traces = _by_trace(events)
+    if traces:
+        lines.append("== traces (%d) ==" % len(traces))
+        for tid, evs in sorted(traces.items()):
+            term = next((e["kind"] for e in reversed(evs)
+                         if e["kind"] in TERMINALS), "in-flight")
+            dur = (float(evs[-1]["ts"]) - float(evs[0]["ts"])) * 1e3
+            lines.append(
+                "  trace %-8d %-10s %-9s %8.3fms  %d events"
+                % (tid, evs[0].get("service", "?"), term, dur,
+                   len(evs)))
+    system = [e for e in events if not event_trace_ids(e)]
+    if system:
+        lines.append("== system events (%d) ==" % len(system))
+        for ev in system[-40:]:
+            attrs = {k: v for k, v in ev.items()
+                     if k not in ("ts", "kind", "service")}
+            lines.append("  %14.6f  %-18s %-10s %s"
+                         % (float(ev["ts"]), ev["kind"],
+                            ev.get("service", "-"),
+                            " ".join("%s=%s" % kv
+                                     for kv in sorted(attrs.items()))))
+    return "\n".join(lines) if lines else "(no events)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="flight dump JSON "
+                                 "(FlightRecorder.dump_to / black-box "
+                                 "file / bare event list)")
+    ap.add_argument("--trace-id", type=int, default=None,
+                    help="render one trace's terminal waterfall")
+    ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                    help="write Chrome trace-event JSON "
+                         "(chrome://tracing / Perfetto)")
+    args = ap.parse_args(argv)
+
+    with open(args.dump, encoding="utf-8") as f:
+        events = load_events(json.load(f))
+
+    if args.chrome:
+        chrome = to_chrome_trace(events)
+        with open(args.chrome, "w", encoding="utf-8") as f:
+            json.dump({"traceEvents": chrome}, f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+        print("wrote %d chrome events to %s"
+              % (len(chrome), args.chrome))
+        if args.trace_id is None:
+            return 0
+    if args.trace_id is not None:
+        timeline = [e for e in events
+                    if args.trace_id in event_trace_ids(e)]
+        if not timeline:
+            print("trace %d not in the dump (have: %s)"
+                  % (args.trace_id,
+                     ", ".join(map(str, trace_ids(events)[:20]))),
+                  file=sys.stderr)
+            return 1
+        print(render_waterfall(timeline))
+        return 0
+    print(summarize(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
