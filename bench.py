@@ -1503,6 +1503,92 @@ def _bench_sparse_pairwise(m, n_cols, nnz_row, iters, batch_size_k):
     }
 
 
+def _import_autotune():
+    """Load tools/autotune.py as a module (bench reuses its cell
+    runners so the sweep and the rung can never time different
+    workloads)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "autotune.py")
+    spec = importlib.util.spec_from_file_location("raft_tpu_autotune",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_autotune_smoke():
+    """Sweep-path rot guard: one tiny cell per op through the FULL
+    timed sweep (registry enumeration, profiled_jit warmup, best-of-N,
+    post-warmup-compile assertion) — if tools/autotune.py breaks, this
+    rung breaks the same round, not the next tuning day."""
+    at = _import_autotune()
+    table = at.run_sweep(smoke=True, log=lambda *_: None)
+    exact = [e for e in table["entries"]
+             if e.get("shape_class") != "*"]
+    return {
+        "cells": len(exact),
+        "winners": {"%s/%s" % (e["op"], e["knob"]): e["winner"]
+                    for e in exact},
+        "post_warmup_compiles": sum(
+            n for e in exact
+            for n in e.get("post_warmup_compiles", {}).values()),
+        "note": "smoke cells are tiny; winners here prove the sweep "
+                "path, not the venue",
+    }
+
+
+def _bench_tuned_vs_default():
+    """What is the tuning table worth on this venue?  Loads the
+    checked-in table matching this backend's fingerprint (CPU ladder),
+    or sweeps a fresh smoke table in-process when no venue table is
+    checked in yet (the TPU ladder until its first tuned round) — then
+    re-times winner vs config-default for every swept cell through the
+    same runners the sweep used.  Every ratio must hold >= 1.0 (the
+    autotuner's min-margin conservatism is exactly this rung's
+    guarantee); post_warmup_compiles must be 0."""
+    from raft_tpu import config
+    from raft_tpu.core import metrics as _metrics
+
+    at = _import_autotune()
+    # the table install is scoped to THIS rung (try/finally below):
+    # every other rung must keep measuring the documented defaults, or
+    # round-over-round comparability silently dies the first tuned
+    # round
+    path = config.discover_tuning_table()
+    try:
+        if path is not None:
+            with open(path, encoding="utf-8") as f:
+                table = json.load(f)
+            config.load_tuning_table(path)
+            source = os.path.basename(path)
+        else:
+            table = at.run_sweep(smoke=True, log=lambda *_: None)
+            config.install_tuning_table(table)
+            source = "fresh-smoke-sweep (no checked-in table for this "
+            source += "fingerprint; persist one with tools/autotune.py)"
+        res = at.tuned_vs_default(table, iters=3, log=lambda *_: None)
+    finally:
+        config.clear_tuning_table()
+    gauge = _metrics.default_registry().gauge(
+        "raft_tpu_tuning_tuned_vs_default_ratio",
+        help="tuned-vs-default speedup per swept cell",
+        labels=("op", "cell"))
+    for c in res["cells"]:
+        gauge.labels(op=c["op"], cell=c["cell"]).set(c["ratio"])
+    return {
+        "table": source,
+        "fingerprint": table.get("fingerprint"),
+        "cells": res["cells"],
+        "min_ratio": res["min_ratio"],
+        "max_ratio": res["max_ratio"],
+        "post_warmup_compiles": res["post_warmup_compiles"],
+        "all_cells_at_least_1x": (res["min_ratio"] is not None
+                                  and res["min_ratio"] >= 1.0),
+    }
+
+
 def _bench_ivf_flat(n_index, n_query, iters):
     """IVF-Flat ANN (reference approx_knn IVFFlat path)."""
     from raft_tpu.spatial.ann import (IVFFlatParams, ivf_flat_build,
@@ -1734,6 +1820,12 @@ def child_main():
             ("pairwise_2k", 40, lambda: _bench_pairwise(2048, 128, 4)),
             ("linalg_bundle", 30, lambda: _bench_linalg_bundle(1024, 2)),
             ("knn_100k", 70, lambda: _bench_knn(100_000, 512, 2, "xla")),
+            # what the checked-in tuning table is worth on this venue:
+            # tuned-vs-default A/B per swept cell (>= 1.0x everywhere
+            # by the autotuner's min-margin conservatism)
+            ("tuned_vs_default", 150, _bench_tuned_vs_default),
+            # sweep-path rot guard: tools/autotune.py --smoke inline
+            ("autotune_smoke", 90, _bench_autotune_smoke),
             ("spectral", 40, _bench_spectral),
             # scaled-down column-tiled sparse engine evidence even on a
             # no-hardware round
@@ -1883,6 +1975,12 @@ def child_main():
              lambda: _bench_ivf_pq(100_000, 4096, 4)),
             ("ivf_sq_100k", 90,
              lambda: _bench_ivf_sq(100_000, 4096, 4)),
+            # tuning-table value on the TPU venue: no checked-in table
+            # until the first tuned TPU round, so this sweeps a fresh
+            # smoke table in-process and reports tuned-vs-default on
+            # it (est covers the smoke sweep's kernel compiles)
+            ("tuned_vs_default", 180, _bench_tuned_vs_default),
+            ("autotune_smoke", 120, _bench_autotune_smoke),
             # the serving-layer number the north star is about: whole
             # request path (queue→coalesce→padded call→split) against a
             # warmed service; est covers the per-bucket warmup compiles

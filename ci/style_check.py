@@ -156,7 +156,134 @@ METRIC_DOC_MARKER = "metric-doc-ok"
 METRIC_NAME_RE = re.compile(r"^raft_tpu_[a-z0-9_]+$")
 METRIC_CALL_HINTS = ("counter", "gauge", "timer", "labeled")
 
+# tuning-registry drift lint: every config._KNOBS entry with a non-None
+# choices whitelist is a registry-owned impl knob and MUST have a
+# register(...) entry in raft_tpu/core/tuning.py (the sweep's search
+# space and the consumers' validation would otherwise skew from the
+# config surface); `tune-reg-ok` on the _KNOBS entry line escapes.
+# Companion per-file rule: no consumer in raft_tpu/ may carry a local
+# tuple/list literal equal to a registry-owned knob's candidate set —
+# the registry is the ONE owner (consumers re-export via
+# tuning.candidates(knob)); `tune-reg-ok` marks a deliberate copy.
+TUNE_REG_MARKER = "tune-reg-ok"
+TUNE_CONFIG = os.path.join("raft_tpu", "config.py")
+TUNE_REGISTRY = os.path.join("raft_tpu", "core", "tuning.py")
+TUNE_EXEMPT = (TUNE_CONFIG, TUNE_REGISTRY)
+
 _metric_doc_text = None
+_tune_sets_cache = None
+
+
+def _knob_choice_entries(config_src=None):
+    """[(knob, frozenset(choices), lineno, marked)] parsed statically
+    from config.py's ``_KNOBS`` dict literal (choices = the non-None
+    third tuple element).  ``config_src`` injects synthetic source for
+    the self-tests; the real file is parsed once and cached."""
+    global _tune_sets_cache
+    if config_src is None:
+        if _tune_sets_cache is not None:
+            return _tune_sets_cache
+        try:
+            with open(os.path.join(REPO, TUNE_CONFIG),
+                      encoding="utf-8") as f:
+                config_src = f.read()
+        except OSError:
+            _tune_sets_cache = []
+            return _tune_sets_cache
+        out = _parse_knob_entries(config_src)
+        _tune_sets_cache = out
+        return out
+    return _parse_knob_entries(config_src)
+
+
+def _parse_knob_entries(src):
+    out = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        # both the bare and the annotated (_KNOBS: Dict[...] = {...})
+        # assignment forms
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not (any(isinstance(t, ast.Name) and t.id == "_KNOBS"
+                    for t in targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for key, val in zip(node.value.keys, node.value.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and isinstance(val, ast.Tuple)
+                    and len(val.elts) >= 3):
+                continue
+            choices_node = val.elts[2]
+            choices = None
+            if isinstance(choices_node, ast.Tuple):
+                cs = [e.value for e in choices_node.elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)]
+                if cs:
+                    choices = frozenset(cs)
+            marked = TUNE_REG_MARKER in lines[key.lineno - 1]
+            out.append((key.value, choices, key.lineno, marked))
+    return out
+
+
+def _registry_knob_names(tuning_src=None):
+    """Knob-name string literals passed to ``register(...)`` in the
+    candidate registry (2nd positional arg or ``knob=`` keyword)."""
+    if tuning_src is None:
+        try:
+            with open(os.path.join(REPO, TUNE_REGISTRY),
+                      encoding="utf-8") as f:
+                tuning_src = f.read()
+        except OSError:
+            return set()
+    names = set()
+    try:
+        tree = ast.parse(tuning_src)
+    except SyntaxError:
+        return names
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Name)
+                      and node.func.id == "register")
+                     or (isinstance(node.func, ast.Attribute)
+                         and node.func.attr == "register"))):
+            continue
+        if (len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            names.add(node.args[1].value)
+        for kw in node.keywords:
+            if (kw.arg == "knob" and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                names.add(kw.value.value)
+    return names
+
+
+def check_tuning_registry(config_src=None, tuning_src=None):
+    """Cross-file drift check (module-doc TUNE_REG block): choices
+    knobs in config.py vs register() entries in core/tuning.py."""
+    problems = []
+    registered = _registry_knob_names(tuning_src)
+    for knob, choices, lineno, marked in _knob_choice_entries(
+            config_src):
+        if choices and knob not in registered and not marked:
+            problems.append(
+                "%s:%d: knob %s has a choices whitelist but no "
+                "candidate-registry entry in %s — register it (the "
+                "sweep's search space and consumer validation must "
+                "not skew from config), or mark the entry line "
+                "`%s`" % (TUNE_CONFIG, lineno, knob, TUNE_REGISTRY,
+                          TUNE_REG_MARKER))
+    return problems
 
 
 def _metric_doc(doc_text=None):
@@ -243,7 +370,30 @@ def check_file(path, doc_text=None, repo_root=None):
     in_serve_exc_scope = rel.startswith(SERVE_EXC_DIR)
     in_mnmg_jit_scope = rel in MNMG_JIT_FILES
     in_ooc_put_scope = rel in OOC_PUT_FILES
+    in_tune_scope = (rel.startswith("raft_tpu" + os.sep)
+                     and rel not in TUNE_EXEMPT)
     src_lines = src.splitlines()
+    if in_tune_scope:
+        owned = {choices: knob for knob, choices, _, _
+                 in _knob_choice_entries()
+                 if choices and len(choices) >= 2}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Tuple, ast.List)):
+                continue
+            vals = [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            if len(vals) != len(node.elts) or len(vals) < 2:
+                continue
+            knob = owned.get(frozenset(vals))
+            if (knob is not None
+                    and TUNE_REG_MARKER not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: local candidate literal for "
+                    f"registry-owned knob {knob} — consumers resolve/"
+                    "validate through raft_tpu.core.tuning (re-export "
+                    f"via tuning.candidates({knob!r})); mark a "
+                    f"deliberate copy `{TUNE_REG_MARKER}`")
     if rel.startswith("raft_tpu" + os.sep):
         doc = _metric_doc(doc_text)
         for mname, lineno in _metric_literals(tree):
@@ -482,6 +632,69 @@ def selftest():
                       file=sys.stderr)
     print("metric-doc lint selftest: %d fixtures, %d failures"
           % (len(cases), failures), file=sys.stderr)
+    failures += _selftest_tuning()
+    return failures
+
+
+def _selftest_tuning():
+    """Executable fixtures for the tuning-registry lints: (a) a
+    choices knob missing from the registry is flagged, registered/
+    marked ones pass; (b) a consumer-local candidate literal is
+    flagged, the marker escapes, an unrelated tuple passes."""
+    import tempfile
+
+    failures = 0
+    # (a) cross-file drift, synthetic sources through the REAL checker
+    cfg_missing = ('_KNOBS = {\n'
+                   '    "lint_fixture_impl": ("E", "a", ("a", "b")),\n'
+                   '}\n')
+    cfg_marked = ('_KNOBS = {\n'
+                  '    "lint_fixture_impl":'
+                  '  # tune-reg-ok: fixture\n'
+                  '        ("E", "a", ("a", "b")),\n'
+                  '}\n')
+    cfg_freeform = ('_KNOBS = {\n'
+                    '    "lint_fixture_impl": ("E", "a", None),\n'
+                    '}\n')
+    reg_has = 'register("op", "lint_fixture_impl", ("a", "b"))\n'
+    reg_empty = "\n"
+    drift_cases = [
+        (cfg_missing, reg_empty, True),
+        (cfg_missing, reg_has, False),
+        (cfg_marked, reg_empty, False),
+        (cfg_freeform, reg_empty, False),
+    ]
+    for i, (cfg, regsrc, expect) in enumerate(drift_cases):
+        got = bool(check_tuning_registry(config_src=cfg,
+                                         tuning_src=regsrc))
+        if got != expect:
+            failures += 1
+            print("tuning drift fixture %d: expected flagged=%s"
+                  % (i, expect), file=sys.stderr)
+    # (b) consumer-literal rule, fixture files against the REAL
+    # config.py candidate sets (spmv_impl is registry-owned)
+    lit_cases = [
+        ('IMPLS = ("segment", "cumsum", "sortscan")\n', True),
+        ('IMPLS = ("segment", "cumsum", "sortscan")'
+         '  # tune-reg-ok: fixture\n', False),
+        ('OTHER = ("alpha", "beta", "gamma")\n', False),
+    ]
+    with tempfile.TemporaryDirectory() as tmp:
+        fixdir = os.path.join(tmp, "raft_tpu")
+        os.makedirs(fixdir)
+        for i, (srcf, expect) in enumerate(lit_cases):
+            path = os.path.join(fixdir, "tunefix%d.py" % i)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(srcf)
+            probs = [p for p in check_file(path, repo_root=tmp)
+                     if "registry-owned" in p]
+            if bool(probs) != expect:
+                failures += 1
+                print("tuning literal fixture %d: expected flagged=%s,"
+                      " got %r" % (i, expect, probs), file=sys.stderr)
+    print("tuning-registry lint selftest: %d fixtures, %d failures"
+          % (len(drift_cases) + len(lit_cases), failures),
+          file=sys.stderr)
     return failures
 
 
@@ -498,6 +711,8 @@ def main():
     problems = []
     for f in files:
         problems.extend(check_file(os.path.join(REPO, f)))
+    # cross-file: config choices-knobs vs the candidate registry
+    problems.extend(check_tuning_registry())
     for p in problems:
         print(p)
     print(f"checked {len(files)} files, {len(problems)} problems",

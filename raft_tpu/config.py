@@ -11,7 +11,21 @@ Resolution order (first hit wins):
 2. an active :func:`override` context, innermost first;
 3. a value set by :func:`configure`;
 4. the knob's env var (``RAFT_TPU_*`` — the historical spelling);
-5. the built-in default.
+5. a loaded **tuning table** (shape-class-aware lookups through
+   :func:`tuned` only — the persisted winners of the
+   ``tools/autotune.py`` sweep, opt-in via
+   :func:`load_tuning_table` / ``RAFT_TPU_TUNING_TABLE``;
+   docs/TUNING.md "Bench-driven autotuning");
+6. the built-in default.
+
+Impl-choice knobs (those with a ``choices`` whitelist below) are OWNED
+by the candidate registry (:mod:`raft_tpu.core.tuning`): consumers
+resolve them through ``tuning.resolve(knob, ...)`` — which calls
+:func:`tuned` here — and validation/legality lives in the registry, not
+at call sites.  Free-form numeric/list knobs read through the typed
+helpers (:func:`get_int` / :func:`get_float` / the ``_list`` variants)
+so a malformed env value fails as a :class:`LogicError` naming the knob
+and its env var, not a bare ``ValueError`` deep inside construction.
 
 THE executable-cache caveat, stated once: knobs are consumed at *trace*
 time.  ``jax.jit`` caches executables by shape+dtype, so consumers
@@ -168,13 +182,20 @@ serve_slo_windows_s
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import warnings
 from contextlib import contextmanager
 from typing import Dict, Iterator, Optional, Tuple
 
-__all__ = ["configure", "override", "get", "describe"]
+__all__ = [
+    "configure", "override", "get", "describe", "tuned",
+    "knob_default", "get_int", "get_float", "get_int_list",
+    "get_float_list", "load_tuning_table", "install_tuning_table",
+    "clear_tuning_table", "suspend_tuning", "tuning_table_info",
+    "discover_tuning_table",
+]
 
 # knob -> (env alias, default, legal values settable via configure);
 # choices None = free-form (the consumer validates — numeric/list knobs
@@ -259,37 +280,400 @@ def _frames():
     return getattr(_tls, "frames", ())
 
 
-def _resolve(name: str) -> Optional[str]:
-    """One knob through the full layer order (module doc): innermost
-    override frame → configure() value → env → default.  _UNSET means
-    no layer claimed it; a literal None in a frame is the scoped
-    "revert to env/default" (configure(knob=None) pops its entry; a
-    scoped frame cannot pop, so the revert is interpreted here).  The
-    single copy of this dance — get() and describe() must never skew."""
-    env, default, _ = _KNOBS[name]
+def _walk(name: str) -> Tuple[object, Optional[str]]:
+    """One knob through the PRE-TABLE layer order (module doc):
+    innermost override frame → configure() value → env.  Returns
+    ``(value, layer)``; ``(_UNSET, None)`` means no pre-table layer
+    claimed it (the caller finishes with table and/or default).  A
+    literal None in a frame is the scoped "revert to
+    env/table/default" (configure(knob=None) pops its entry; a scoped
+    frame cannot pop, so the revert is interpreted here — it skips
+    configure() too).  THE single copy of this dance — get(), tuned()
+    and describe() share it and must never skew."""
+    env, _, _ = _KNOBS[name]
     val = _UNSET
     for frame in reversed(_frames()):
         if name in frame:
             val = frame[name]
             break
     if val is _UNSET and name in _values:
-        val = _values[name]
-    if val is _UNSET or val is None:
-        val = os.environ.get(env, default)
-    return val
+        return _values[name], "configure"
+    if val is not _UNSET and val is not None:
+        return val, "override"
+    ev = os.environ.get(env)
+    if ev is not None:
+        return ev, "env"
+    return _UNSET, None
+
+
+def _resolve(name: str) -> Optional[str]:
+    """:func:`_walk` finished with the default rung (NO table — the
+    shape-aware :func:`tuned` is the table-consulting entry)."""
+    val, _ = _walk(name)
+    return _KNOBS[name][1] if val is _UNSET else val
 
 
 def get(name: str) -> Optional[str]:
-    """Resolve a knob (module-doc order) and mark it consumed.
+    """Resolve a knob (module-doc order, WITHOUT the tuning-table
+    layer — :func:`tuned` is the shape-aware entry) and mark it
+    consumed.
 
     Returns the raw string (or None for an unset no-default knob);
-    call sites keep their own whitelists so an env-var typo fails with
-    the site's error message, exactly as before.
+    registry-owned knobs validate through
+    :mod:`raft_tpu.core.tuning`, free-form knobs at their call sites.
     """
     val = _resolve(name)
     with _lock:
         _consumed.setdefault(name, set()).add(val)
     return val
+
+
+def knob_default(name: str) -> Optional[str]:
+    """The built-in default of ``name`` (the bottom resolution rung)."""
+    if name not in _KNOBS:
+        raise ValueError(
+            f"raft_tpu.config: unknown knob {name!r} "
+            f"(have: {', '.join(sorted(_KNOBS))})")
+    return _KNOBS[name][1]
+
+
+def tuned(name: str, op: Optional[str] = None,
+          dtype: Optional[str] = None,
+          dims: Optional[Dict[str, int]] = None
+          ) -> Tuple[Optional[str], str]:
+    """Shape-class-aware resolution: the full module-doc ladder
+    INCLUDING the tuning table (override → configure → env → table →
+    default).  Returns ``(value, layer)`` where ``layer`` names the
+    rung that answered (``"override" | "configure" | "env" | "table" |
+    "default"``) — the registry (:mod:`raft_tpu.core.tuning`) is the
+    intended caller and needs the layer to treat table answers as
+    advisory.  Marks the knob consumed exactly like :func:`get` (the
+    executable-cache caveat applies unchanged).
+    """
+    if name not in _KNOBS:
+        raise ValueError(
+            f"raft_tpu.config: unknown knob {name!r} "
+            f"(have: {', '.join(sorted(_KNOBS))})")
+    val, layer = _walk(name)
+    if val is _UNSET:
+        # nothing above claimed it (incl. the scoped revert
+        # override(knob=None)): the table answers before the default,
+        # so a revert restores the TABLE's value, not the built-in
+        tv = _table_answer(name, op, dtype, dims)
+        if tv is not None:
+            val, layer = tv, "table"
+        else:
+            val, layer = _KNOBS[name][1], "default"
+    with _lock:
+        _consumed.setdefault(name, set()).add(val)
+    return val, layer
+
+
+# --------------------------------------------------------------------- #
+# typed knob parsing — free-form numeric/list knobs fail HERE, as a
+# LogicError naming the knob and its env var, not as a bare ValueError
+# deep inside service construction (the ad-hoc-parse bug class)
+# --------------------------------------------------------------------- #
+def _parse_error(name: str, raw, kind: str):
+    from raft_tpu.core.error import LogicError
+
+    env = _KNOBS[name][0]
+    return LogicError(
+        f"raft_tpu.config: {name}={raw!r} is not a valid {kind} "
+        f"(knob {name}, env var {env})")
+
+
+def get_int(name: str) -> int:
+    """:func:`get` + int parse; malformed → :class:`LogicError`."""
+    raw = get(name)
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise _parse_error(name, raw, "integer") from None
+
+
+def get_float(name: str) -> float:
+    """:func:`get` + float parse; malformed → :class:`LogicError`."""
+    raw = get(name)
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise _parse_error(name, raw, "number") from None
+
+
+def _split_list(raw) -> Tuple[str, ...]:
+    return tuple(tok.strip() for tok in str(raw).split(",")
+                 if tok.strip())
+
+
+def get_int_list(name: str) -> Tuple[int, ...]:
+    """:func:`get` + comma-separated int-list parse; malformed →
+    :class:`LogicError` naming the knob and env var."""
+    raw = get(name)
+    try:
+        return tuple(int(tok) for tok in _split_list(raw))
+    except (TypeError, ValueError):
+        raise _parse_error(name, raw, "comma-separated integer list"
+                           ) from None
+
+
+def get_float_list(name: str) -> Tuple[float, ...]:
+    """:func:`get` + comma-separated float-list parse; malformed →
+    :class:`LogicError` naming the knob and env var."""
+    raw = get(name)
+    try:
+        return tuple(float(tok) for tok in _split_list(raw))
+    except (TypeError, ValueError):
+        raise _parse_error(name, raw, "comma-separated number list"
+                           ) from None
+
+
+# --------------------------------------------------------------------- #
+# the tuning-table layer (docs/TUNING.md "Bench-driven autotuning")
+#
+# Opt-in by design: with no table loaded, resolution is byte-identical
+# to the pre-table ladder.  A table is installed explicitly
+# (load_tuning_table / install_tuning_table) or via the
+# RAFT_TPU_TUNING_TABLE env var ("auto" = discover the checked-in
+# table matching this backend's fingerprint under raft_tpu/tuning/).
+# --------------------------------------------------------------------- #
+TUNING_TABLE_VERSION = 1
+TUNING_TABLE_ENV = "RAFT_TPU_TUNING_TABLE"
+
+_table: Optional[Dict] = None          # validated+indexed table
+_table_env_checked = False
+_table_warned: set = set()             # one-time stale warnings, by key
+
+
+def _tables_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tuning")
+
+
+def _fingerprint_matches(fp: Dict) -> bool:
+    from raft_tpu.core.tuning import backend_fingerprint
+
+    live = backend_fingerprint()
+    return all(fp.get(k) == live[k] for k in
+               ("platform", "device_kind", "device_count"))
+
+
+def _warn_stale_once(key: str, msg: str) -> None:
+    with _lock:
+        if key in _table_warned:
+            return
+        _table_warned.add(key)
+    warnings.warn(msg, stacklevel=3)
+
+
+def _index_table(doc: Dict, source: str) -> Dict:
+    """Validate a parsed table document and build its lookup index;
+    corrupt tables fail LOUDLY (a silently half-read table would pin
+    impls nobody swept)."""
+    from raft_tpu.core.error import LogicError
+
+    def bad(why):
+        return LogicError(
+            "raft_tpu.config: corrupt tuning table %s — %s"
+            % (source, why))
+
+    if not isinstance(doc, dict):
+        raise bad("top level is not an object")
+    if doc.get("version") != TUNING_TABLE_VERSION:
+        raise bad("version=%r (this build reads version %d)"
+                  % (doc.get("version"), TUNING_TABLE_VERSION))
+    fp = doc.get("fingerprint")
+    if (not isinstance(fp, dict)
+            or not all(k in fp for k in
+                       ("platform", "device_kind", "device_count"))):
+        raise bad("fingerprint missing platform/device_kind/"
+                  "device_count")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise bad("entries is not a list")
+    index: Dict[Tuple, Dict] = {}
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or not all(
+                k in e for k in ("op", "knob", "shape_class", "dtype",
+                                 "winner")):
+            raise bad("entry %d missing op/knob/shape_class/dtype/"
+                      "winner" % i)
+        index[(e["op"], e["knob"], e["shape_class"], e["dtype"])] = e
+    return {"doc": doc, "index": index, "source": source,
+            "fingerprint": fp}
+
+
+def install_tuning_table(doc: Dict, *, source: str = "<memory>",
+                         check_fingerprint: bool = True) -> bool:
+    """Install a parsed table document as THE active table.  Returns
+    False (one-time warning, table not installed) when the fingerprint
+    does not match the live backend and ``check_fingerprint`` holds —
+    a stale table must never silently tune a different venue."""
+    global _table
+    t = _index_table(doc, source)
+    if check_fingerprint and not _fingerprint_matches(t["fingerprint"]):
+        from raft_tpu.core.tuning import backend_fingerprint
+
+        _warn_stale_once(
+            "fp:%s" % source,
+            "raft_tpu.config: tuning table %s has stale fingerprint "
+            "%r (live backend: %r) — table IGNORED; re-run "
+            "tools/autotune.py on this venue" % (
+                source, t["fingerprint"], backend_fingerprint()))
+        return False
+    _table = t
+    return True
+
+
+def load_tuning_table(path: str, *,
+                      check_fingerprint: bool = True) -> bool:
+    """Load a table file produced by ``tools/autotune.py``.  Unreadable
+    or corrupt files raise :class:`LogicError`; a stale fingerprint
+    warns once and returns False (module policy above)."""
+    from raft_tpu.core.error import LogicError
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise LogicError(
+            "raft_tpu.config: corrupt/unreadable tuning table %s — %s"
+            % (path, e)) from None
+    return install_tuning_table(doc, source=path,
+                                check_fingerprint=check_fingerprint)
+
+
+def clear_tuning_table() -> None:
+    """Remove the active table (resolution reverts to env/default)."""
+    global _table
+    _table = None
+
+
+def discover_tuning_table() -> Optional[str]:
+    """Path of the checked-in table under ``raft_tpu/tuning/`` whose
+    fingerprint matches the live backend, or None.  Discovery never
+    warns: no matching venue simply means no table."""
+    d = _tables_dir()
+    if not os.path.isdir(d):
+        return None
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".json"):
+            continue
+        path = os.path.join(d, fname)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            fp = doc.get("fingerprint", {})
+        except (OSError, ValueError):
+            continue
+        if isinstance(fp, dict) and _fingerprint_matches(fp):
+            return path
+    return None
+
+
+def _auto_load_table() -> None:
+    """First-consult hook: honor RAFT_TPU_TUNING_TABLE once.  ``"0"``/
+    empty = explicitly off; ``"auto"`` = discover by fingerprint; any
+    other value = a path (stale → one-time warning, resolution
+    continues untuned)."""
+    global _table_env_checked
+    if _table_env_checked:
+        return
+    _table_env_checked = True
+    spec = os.environ.get(TUNING_TABLE_ENV)
+    if not spec or spec == "0":
+        return
+    if spec == "auto":
+        path = discover_tuning_table()
+        if path is not None:
+            load_tuning_table(path)
+        return
+    load_tuning_table(spec)
+
+
+def _suspend_depth() -> int:
+    return getattr(_tls, "table_suspended", 0)
+
+
+@contextmanager
+def suspend_tuning() -> Iterator[None]:
+    """Scoped table bypass: resolution inside the block behaves as if
+    no table were loaded (the bench's untuned A/B arm and the sweep's
+    candidate timing).  THREAD-LOCAL, like override frames: a
+    suspension neither leaks into concurrent request threads nor races
+    another thread's depth (a lost global increment would have left
+    the table disabled process-wide, silently, forever)."""
+    _tls.table_suspended = _suspend_depth() + 1
+    try:
+        yield
+    finally:
+        _tls.table_suspended = _suspend_depth() - 1
+
+
+def _active_table() -> Optional[Dict]:
+    if _suspend_depth():
+        return None
+    if _table is None:
+        _auto_load_table()
+    return _table
+
+
+def _count_table(outcome: str, knob: str) -> None:
+    # lazy + best-effort: config must stay importable before the
+    # metrics registry (raft_tpu/__init__ import order)
+    try:
+        from raft_tpu.core import metrics as _metrics
+
+        _metrics.default_registry().counter(
+            "raft_tpu_tuning_table_lookups_total",
+            help="tuning-table lookups by outcome",
+            labels=("outcome", "knob")).labels(
+                outcome=outcome, knob=knob).inc()
+    except Exception:
+        pass
+
+
+def _table_answer(name: str, op: Optional[str],
+                  dtype: Optional[str],
+                  dims: Optional[Dict[str, int]]) -> Optional[str]:
+    t = _active_table()
+    if t is None:
+        return None
+    from raft_tpu.core.tuning import shape_class
+
+    cls = shape_class(dims or {})
+    dt = dtype or "*"
+    o = op or "*"
+    index = t["index"]
+    for key in ((o, name, cls, dt), (o, name, cls, "*"),
+                (o, name, "*", dt), (o, name, "*", "*")):
+        e = index.get(key)
+        if e is not None:
+            _count_table("hit", name)
+            return e["winner"]
+    _count_table("miss", name)
+    return None
+
+
+def _table_entries_for(name: str):
+    t = _active_table()
+    if t is None:
+        return ()
+    return tuple(e for e in t["index"].values() if e["knob"] == name)
+
+
+def tuning_table_info() -> Optional[Dict]:
+    """Summary of the active table (None when untuned): source path,
+    fingerprint, cell count, per-knob cell counts.  The observability
+    digest (``tools/metrics_report.py``) renders this."""
+    t = _active_table()
+    if t is None:
+        return None
+    per_knob: Dict[str, int] = {}
+    for e in t["index"].values():
+        per_knob[e["knob"]] = per_knob.get(e["knob"], 0) + 1
+    return {"source": t["source"], "fingerprint": dict(t["fingerprint"]),
+            "cells": len(t["index"]), "knobs": per_knob}
 
 
 def _check(name: str, value: Optional[str]) -> None:
@@ -358,6 +742,39 @@ def override(**knobs: Optional[str]) -> Iterator[None]:
         _tls.frames = tuple(frames[:-1])
 
 
-def describe() -> Dict[str, Optional[str]]:
-    """Current effective value of every knob (no consumption mark)."""
-    return {name: _resolve(name) for name in _KNOBS}
+def _attribute(name: str) -> Tuple[Optional[str], str]:
+    """(value, layer) of a knob WITHOUT consumption marking — the
+    describe() twin of :func:`tuned`.  Table attribution is shape-less
+    here: the layer reads ``"table"`` when the active table holds any
+    cell for the knob and no higher layer claims it; the value is the
+    unanimous winner, or the literal ``"per-shape"`` when cells
+    disagree across shape classes."""
+    val, layer = _walk(name)
+    if val is not _UNSET:
+        return val, layer
+    cells = _table_entries_for(name)
+    if cells:
+        winners = {e["winner"] for e in cells}
+        return (winners.pop() if len(winners) == 1
+                else "per-shape"), "table"
+    return _KNOBS[name][1], "default"
+
+
+def describe(layers: bool = False) -> Dict:
+    """Current effective value of every knob (no consumption mark),
+    INCLUDING the tuning-table rung — what consumers will actually
+    receive (a knob whose table cells disagree across shape classes
+    reads the literal ``"per-shape"``).
+
+    ``layers=True`` additionally attributes each knob to the
+    resolution rung that answered:
+    ``{knob: {"value": ..., "layer": "override" | "configure" |
+    "env" | "table" | "default"}}``.
+    """
+    if not layers:
+        return {name: _attribute(name)[0] for name in _KNOBS}
+    out = {}
+    for name in _KNOBS:
+        value, layer = _attribute(name)
+        out[name] = {"value": value, "layer": layer}
+    return out

@@ -227,7 +227,7 @@ class FlightRecorder:
         if capacity is None:
             from raft_tpu import config
 
-            capacity = int(config.get("flight_events"))
+            capacity = config.get_int("flight_events")
         if capacity < 1:
             raise ValueError("FlightRecorder: capacity=%d" % capacity)
         self._lock = threading.Lock()

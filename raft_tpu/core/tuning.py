@@ -1,0 +1,454 @@
+"""Candidate registry: every impl knob self-describes in ONE place.
+
+The reference hand-specializes its kernel dispatch per GPU arch
+(selection_faiss.cuh's k-template ladder, ann_common.h's algo enums);
+raft_tpu's port accumulated the same problem as per-file whitelists —
+``select_k`` carried its own impl tuple, ``SparseMatrix.__init__`` its
+own spmv guard, the fused-kNN merge its own pin plumbing.  This module
+is the replacement: every implementation choice registers its
+
+    (op, knob, candidates, legality(value, ctx))
+
+here, and consumers resolve/validate through :func:`resolve` /
+:func:`check` instead of carrying local literals.  The registry is also
+the search space of the bench-driven sweep (``tools/autotune.py``): the
+sweep enumerates :func:`specs`, times every candidate that is legal for
+a cell, and persists winners to the tuning table that
+:func:`raft_tpu.config.tuned` consults between env and default
+(docs/TUNING.md "Bench-driven autotuning").
+
+Vocabulary
+----------
+cell
+    One (backend, op, shape-class, dtype) point of the tuning space.
+shape class
+    :func:`shape_class`: the relevant dims of a call site, each rounded
+    to its nearest power of two — the quantization that lets a sweep at
+    (n=131072, k=128) answer a query at (n=100000, k=100).
+legality
+    ``legality(value, ctx) -> Optional[str]``: None when the candidate
+    is legal for the cell described by ``ctx`` (dims, ``dtype``,
+    ``purpose``), else a human reason.  ``purpose`` is ``"use"``
+    (consumer resolution — only genuine correctness limits apply) or
+    ``"sweep"`` (the autotuner additionally rejects candidates that are
+    not production-meaningful on this backend, e.g. interpreted Pallas
+    kernels off-TPU).
+arg-only candidate
+    Legal only as an explicit function argument, never from
+    config/env/table — e.g. the ``knn_tile_merge`` ``"skip"``
+    attribution probe that returns wrong results by design.
+no-sweep candidate
+    Settable, but excluded from the timed sweep because a time-only
+    comparison would be unfair — the deliberately approximate modes
+    (``approx95``) and the precision-caveated ``cumsum`` SpMV.
+
+Error contract: every validation failure raises
+:class:`~raft_tpu.core.error.LogicError` through ONE message shape
+(:func:`check`) naming the site, the knob, the rejected value, the
+legal set, and why it is illegal for this cell — the scattered
+per-file messages this registry replaced each said less.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+from raft_tpu.core.error import LogicError
+
+__all__ = [
+    "register", "spec", "specs", "candidates", "check", "resolve",
+    "legal_candidates", "shape_class", "backend_fingerprint",
+    "fingerprint_slug",
+]
+
+# ctx -> None (legal) | reason string (illegal for this cell)
+Legality = Callable[[str, Mapping], Optional[str]]
+
+
+class KnobSpec:
+    """One registered impl choice (module doc for the field semantics).
+
+    ``config_knob`` — True when the knob resolves through
+    :mod:`raft_tpu.config` (override/configure/env/table/default);
+    False for registry-only knobs (``merge_select_impl``,
+    ``mnmg_group_size``) whose default is pinned here precisely so a
+    process-wide config change cannot reach them silently.
+    ``dims`` — the ctx dims that define this knob's shape class (both
+    the consumers and the sweep key cells on exactly these).
+    """
+
+    __slots__ = ("op", "knob", "candidates", "arg_only", "no_sweep",
+                 "legality", "config_knob", "default", "auto_default",
+                 "dims", "doc")
+
+    def __init__(self, op, knob, candidates, *, arg_only=(),
+                 no_sweep=None, legality=None, config_knob=True,
+                 default=None, auto_default=None, dims=(), doc=""):
+        self.op = op
+        self.knob = knob
+        self.candidates = tuple(candidates) if candidates else None
+        self.arg_only = tuple(arg_only)
+        self.no_sweep = dict(no_sweep or {})
+        self.legality = legality
+        self.config_knob = config_knob
+        self.default = default
+        # what an UNSET knob effectively runs (the consumer's auto
+        # dispatch, e.g. fused_knn_impl None -> "xla"): the sweep's
+        # comparison baseline for knobs whose config default is None
+        self.auto_default = auto_default
+        self.dims = tuple(dims)
+        self.doc = doc
+
+    def illegal_reason(self, value, ctx: Mapping) -> Optional[str]:
+        """Why ``value`` is illegal for the cell ``ctx`` (None = legal).
+        Membership (including the arg-only rule) first, then the
+        spec's own legality predicate."""
+        if self.candidates is not None:
+            allowed = self.candidates + (
+                self.arg_only if ctx.get("explicit") else ())
+            if value not in allowed:
+                if value in self.arg_only:
+                    return ("argument-only (an attribution probe must "
+                            "never be reachable from config/env/table)")
+                return "unknown impl (not a registered candidate)"
+        if ctx.get("purpose") == "sweep" and value in self.no_sweep:
+            return self.no_sweep[value]
+        if self.legality is not None:
+            return self.legality(value, ctx)
+        return None
+
+
+_SPECS: Dict[str, KnobSpec] = {}
+
+
+def register(op: str, knob: str, candidates, **kw) -> KnobSpec:
+    """Register one impl choice (module doc).  Idempotent per knob name
+    only in the sense that re-registration replaces — knobs are
+    registered once, below, at import."""
+    s = KnobSpec(op, knob, candidates, **kw)
+    _SPECS[knob] = s
+    return s
+
+
+def spec(knob: str) -> KnobSpec:
+    if knob not in _SPECS:
+        raise LogicError(
+            "raft_tpu.core.tuning: unknown knob %r (registered: %s)"
+            % (knob, ", ".join(sorted(_SPECS))))
+    return _SPECS[knob]
+
+
+def specs() -> Tuple[KnobSpec, ...]:
+    """Every registered spec — the sweep's search space."""
+    return tuple(_SPECS[k] for k in sorted(_SPECS))
+
+
+def candidates(knob: str) -> Tuple[str, ...]:
+    """The config-settable candidate set of ``knob`` (the one source —
+    consumer modules re-export THIS instead of a local literal)."""
+    c = spec(knob).candidates
+    return c if c is not None else ()
+
+
+def _fmt_legal(s: KnobSpec, explicit: bool) -> str:
+    if s.candidates is None:
+        return "free-form"
+    vals = s.candidates + (s.arg_only if explicit else ())
+    return ", ".join(vals)
+
+
+def check(knob: str, value, *, site: Optional[str] = None,
+          explicit: bool = False, purpose: str = "use",
+          dtype=None, **dims):
+    """Validate ``value`` for ``knob`` at the cell described by
+    ``dims``/``dtype``; returns the value or raises
+    :class:`LogicError` in the shared message shape (module doc)."""
+    s = spec(knob)
+    ctx = _ctx(explicit=explicit, purpose=purpose, dtype=dtype, **dims)
+    reason = s.illegal_reason(value, ctx)
+    if reason is not None:
+        raise LogicError(
+            "%s: %s=%r is illegal for this cell (legal: %s) — %s"
+            % (site or s.op, knob, value, _fmt_legal(s, explicit),
+               reason))
+    return value
+
+
+def legal_candidates(knob: str, *, purpose: str = "use", dtype=None,
+                     **dims):
+    """(candidate, reason) pairs: reason None = legal for this cell.
+    The sweep driver's view of a cell's search space."""
+    s = spec(knob)
+    ctx = _ctx(explicit=False, purpose=purpose, dtype=dtype, **dims)
+    return tuple((c, s.illegal_reason(c, ctx))
+                 for c in (s.candidates or ()))
+
+
+def resolve(knob: str, explicit=None, *, site: Optional[str] = None,
+            dtype=None, **dims):
+    """THE consumer entry point: explicit argument, else the config
+    ladder (override → configure → env → tuning table → default) for
+    config knobs, else the spec's pinned default — always validated.
+
+    A *table* answer that is illegal for the real cell (the table was
+    swept at a coarser class than this call) silently falls back to
+    the built-in default: the table is advisory, never a new way to
+    crash a call that used to work.  Returns None only for
+    unset-default knobs (``fused_knn_impl`` auto).
+    """
+    s = spec(knob)
+    site = site or s.op
+    if explicit is not None:
+        return check(knob, explicit, site=site, explicit=True,
+                     dtype=dtype, **dims)
+    if not s.config_knob:
+        value = s.default
+        if value is None:
+            return None
+        return check(knob, value, site=site, dtype=dtype, **dims)
+    from raft_tpu import config
+
+    value, layer = config.tuned(knob, op=s.op, dtype=_dtype_str(dtype),
+                                dims=_class_dims(s, dims))
+    if value is None:
+        return None
+    if layer == "table":
+        ctx = _ctx(explicit=False, purpose="use", dtype=dtype, **dims)
+        if s.illegal_reason(value, ctx) is not None:
+            # the lookup already counted a "hit"; record the discard
+            # so the observability digest can report EFFECTIVE table
+            # coverage (hits - discarded)
+            config._count_table("discarded", knob)
+            value = config.knob_default(knob)
+            if value is None:
+                return None
+    return check(knob, value, site=site, dtype=dtype, **dims)
+
+
+def _ctx(**kw) -> Mapping:
+    d = {k: v for k, v in kw.items() if v is not None}
+    d.setdefault("explicit", False)
+    d.setdefault("purpose", "use")
+    return d
+
+
+def _dtype_str(dtype) -> Optional[str]:
+    if dtype is None:
+        return None
+    try:
+        import numpy as np
+
+        return np.dtype(dtype).name
+    except TypeError:
+        return getattr(dtype, "name", None) or str(dtype)
+
+
+def _class_dims(s: KnobSpec, dims: Mapping) -> Dict[str, int]:
+    """Restrict a consumer's ctx dims to the spec's class dims so the
+    lookup key and the sweep key cannot skew on extra context."""
+    return {k: int(v) for k, v in dims.items()
+            if k in s.dims and v is not None}
+
+
+# --------------------------------------------------------------------- #
+# shape classes + backend fingerprint (the tuning-table key space)
+# --------------------------------------------------------------------- #
+def shape_class(dims: Mapping) -> str:
+    """Canonical shape-class string: each dim rounded to the nearest
+    power of two (in log space), formatted ``k=v`` sorted by name.
+    Empty dims → ``"*"`` (the any-shape class).  Restriction to the
+    spec's class dims happens in :func:`_class_dims` before this.
+
+    Pow2 rounding is the whole mechanism: a sweep at (n=131072, k=128)
+    and a query at (n=100000, k=100) land in the SAME class, while
+    n=8192 lands two classes away — coarse enough that a small swept
+    grid covers real traffic, fine enough that the known winner flips
+    (select_impl at k=100 vs k=10) stay separated.
+    """
+    items = []
+    for name in sorted(dims):
+        v = dims[name]
+        if v is None:
+            continue
+        v = int(v)
+        b = 0 if v <= 0 else 1 << max(0, round(math.log2(v)))
+        items.append("%s=%d" % (name, b))
+    return ",".join(items) if items else "*"
+
+
+def backend_fingerprint() -> Dict[str, object]:
+    """(platform, device kind, device count) of the live backend — the
+    venue key a tuning table is valid for.  Imports jax lazily so the
+    registry itself stays importable without a backend (the style lint
+    and ``--dry-run`` sweeps parse it statically)."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "device_kind": devs[0].device_kind if devs else "none",
+        "device_count": len(devs),
+    }
+
+
+def fingerprint_slug(fp: Mapping) -> str:
+    """Filesystem-safe name for a fingerprint (the checked-in table
+    files under ``raft_tpu/tuning/`` are named by it)."""
+    import re
+
+    kind = re.sub(r"[^A-Za-z0-9]+", "-", str(fp["device_kind"])).strip("-")
+    return "%s_%s_d%d" % (fp["platform"], kind.lower(),
+                          int(fp["device_count"]))
+
+
+# --------------------------------------------------------------------- #
+# helpers shared by the legality predicates
+# --------------------------------------------------------------------- #
+def _is_float_dtype(dtype) -> Optional[bool]:
+    """True/False when ``dtype`` is known, None when absent from ctx
+    (legality is best-effort on the context it is given)."""
+    if dtype is None:
+        return None
+    name = _dtype_str(dtype)
+    return name.startswith(("float", "bfloat", "f8", "float8"))
+
+
+def _off_tpu_sweep(ctx: Mapping) -> Optional[str]:
+    """Sweep-only rejection of Pallas kernels off-TPU: they run through
+    the interpreter there (a test vehicle, not a production candidate),
+    so timing one against XLA would 'lose' by construction and waste
+    most of the sweep budget doing it."""
+    if ctx.get("purpose") != "sweep":
+        return None
+    from raft_tpu.core.utils import is_tpu_backend
+
+    if not is_tpu_backend():
+        return ("pallas kernels run interpreted off-TPU — a test "
+                "vehicle, not a sweep candidate on this backend")
+    return None
+
+
+def _legal_select_impl(value, ctx):
+    if value == "pallas":
+        if ctx.get("k") is not None and int(ctx["k"]) > 128:
+            return ("the fused select kernel caps k at 128 (bitonic "
+                    "merge width); got k=%d" % int(ctx["k"]))
+        if _is_float_dtype(ctx.get("dtype")) is False:
+            return "the fused select kernel requires float keys"
+        return _off_tpu_sweep(ctx)
+    return None
+
+
+def _legal_fused_knn(value, ctx):
+    if value == "pallas":
+        if ctx.get("k") is not None and int(ctx["k"]) > 128:
+            return ("the fused kNN kernel caps k at 128 (bitonic merge "
+                    "width); got k=%d — use impl='xla' or reduce k"
+                    % int(ctx["k"]))
+        return _off_tpu_sweep(ctx)
+    return None
+
+
+def _legal_knn_tile_merge(value, ctx):
+    # every merge network lives inside the Pallas kernel: off-TPU the
+    # whole knob is interpreter-only, so no candidate is sweepable there
+    return _off_tpu_sweep(ctx)
+
+
+def _legal_group_size(value, ctx):
+    try:
+        g = int(value)
+    except (TypeError, ValueError):
+        return "not an integer"
+    size = ctx.get("axis_size")
+    if size is not None and not (1 <= g <= int(size)
+                                 and int(size) % g == 0):
+        return ("group_size=%d must divide the merge axis size %d "
+                "(balanced two-level decomposition)" % (g, int(size)))
+    return None
+
+
+# --------------------------------------------------------------------- #
+# the registry — every impl choice in the library, one block
+# --------------------------------------------------------------------- #
+register(
+    "select_k", "select_impl",
+    ("topk", "approx", "approx95", "chunked", "pallas"),
+    legality=_legal_select_impl,
+    no_sweep={"approx95": ("deliberately approximate (recall_target "
+                           "0.95) — a time-only sweep must not trade "
+                           "exactness silently")},
+    dims=("n", "k"),
+    doc="per-row top-k impl (spatial/select_k.py)")
+
+register(
+    "tiled_knn", "tile_merge", ("tile_topk", "direct"),
+    dims=("n", "k"),
+    doc="tile-scan kNN per-tile selection strategy (spatial/tiled_knn.py)")
+
+register(
+    "fused_knn_tile", "knn_tile_merge", ("merge", "fullsort", "sorttile"),
+    arg_only=("skip",),
+    legality=_legal_knn_tile_merge,
+    dims=("n", "k"),
+    doc="Pallas fused-kNN/select merge network (ops/knn_tile.py)")
+
+register(
+    "fused_l2_knn", "fused_knn_impl", ("xla", "pallas"),
+    legality=_legal_fused_knn,
+    auto_default="xla",
+    dims=("n", "k"),
+    doc="fused L2 kNN path (spatial/fused_l2_knn.py); unset = "
+        "per-backend auto (currently xla everywhere, the r4 measured "
+        "default)")
+
+register(
+    "ivf_pq_search", "pq_adc", ("gather", "onehot"),
+    dims=("n", "k"),
+    doc="IVF-PQ ADC lookup formulation (spatial/ann.py)")
+
+register(
+    "csr_spmv", "spmv_impl", ("segment", "cumsum", "sortscan"),
+    no_sweep={"cumsum": ("differences a global running prefix — a "
+                         "row's error scales with |cs| at its "
+                         "position (sparse/linalg.py caveat); a "
+                         "time-only sweep must not pick it")},
+    dims=("rows", "nnz"),
+    doc="CSR SpMV formulation (sparse/linalg.py)")
+
+register(
+    "mnmg_knn", "mnmg_merge", ("allgather", "ring", "hierarchical"),
+    dims=("devices", "n", "k"),
+    doc="cross-shard top-k merge topology (spatial/mnmg_knn.py + the "
+        "sharded serve dispatch)")
+
+register(
+    "fused_l2_nn", "fused_nn_impl", ("xla", "pallas"),
+    legality=lambda v, ctx: (_off_tpu_sweep(ctx) if v == "pallas"
+                             else None),
+    config_knob=False, default=None,
+    dims=("n", "k"),
+    doc="fused 1-NN path (distance/fused_l2_nn.py) — argument-only "
+        "today (no config knob); unset = per-backend auto (pallas on "
+        "TPU for the plain f32 min-reduce, xla otherwise)")
+
+# registry-only knobs: validated here, NEVER resolved from config —
+# the pin is the point (a process-wide configure() must not reach them)
+register(
+    "fused_knn_twophase", "merge_select_impl",
+    ("topk", "approx", "approx95", "chunked", "pallas"),
+    legality=_legal_select_impl,
+    config_knob=False, default="topk",
+    dims=("n", "k"),
+    doc="phase-2 merge select of the two-phase fused kNN — pinned to "
+        "exact 'topk' so a process-wide select_impl pin cannot trade "
+        "the kernel's exactness contract away silently")
+
+register(
+    "mnmg_knn", "mnmg_group_size", None,
+    legality=_legal_group_size,
+    config_knob=False, default=None,
+    dims=("devices",),
+    doc="hierarchical-merge host-group size (free-form int; must "
+        "divide the merge axis size)")

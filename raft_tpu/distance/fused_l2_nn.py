@@ -28,6 +28,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import is_tpu_backend
@@ -149,9 +150,13 @@ def fused_l2_nn(
     silently running another impl (same convention as fused_l2_knn).
     """
     requested = impl
-    if impl is None:
+    if impl is not None:
+        # registry-only knob: explicit values validated through the
+        # candidate registry's shared message shape
+        tuning.check("fused_nn_impl", impl, site="fused_l2_nn",
+                     explicit=True)
+    else:
         impl = "pallas" if is_tpu_backend() else "xla"
-    expects(impl in ("xla", "pallas"), "fused_l2_nn: unknown impl %s", impl)
     plain_f32 = (mask is None
                  and jnp.result_type(x.dtype, jnp.float32) == jnp.float32)
     expects(not (requested == "pallas" and not plain_f32),

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import ceildiv, is_tpu_backend
@@ -398,7 +398,7 @@ def fused_knn_twophase(
     block_n: int = 1024,
     precision: str = "highest",
     interpret: Optional[bool] = None,
-    merge_select_impl: str = "topk",
+    merge_select_impl: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest index rows: Pallas per-tile select + one XLA merge.
 
@@ -411,8 +411,9 @@ def fused_knn_twophase(
     against ``merge``/``sorttile`` by ``tools/knn_kernel_sweep.py``.
 
     ``merge_select_impl`` pins the phase-2 ``select_k`` implementation
-    and defaults to exact ``"topk"`` — the merge is part of this
-    kernel's EXACTNESS contract, so a process-wide
+    and defaults to exact ``"topk"`` — a registry-only knob
+    (:mod:`raft_tpu.core.tuning`, ``config_knob=False``): the merge is
+    part of this kernel's EXACTNESS contract, so a process-wide
     ``config.configure(select_impl="approx95")`` pin must not reach it
     silently.  Pass a different impl explicitly to trade exactness
     away on purpose.
@@ -426,6 +427,9 @@ def fused_knn_twophase(
             "fused_knn_twophase: k=%d out of range for n=%d", k, n)
     expects(k <= 128,
             "fused_knn_twophase: k <= 128 (bitonic width cap; got %d)", k)
+    merge_select_impl = tuning.resolve(
+        "merge_select_impl", merge_select_impl,
+        site="fused_knn_twophase", k=k, dtype=index.dtype)
     if interpret is None:
         interpret = not is_tpu_backend()
     kpad = 128
@@ -501,15 +505,13 @@ def fused_knn_tile(
     expects(0 < k <= n, "fused_knn_tile: k=%d out of range for n=%d", k, n)
     if interpret is None:
         interpret = not is_tpu_backend()
-    if merge_impl is None:
-        merge_impl = config.get("knn_tile_merge")
-        # "skip" (the attribution probe that returns WRONG results) is
-        # argument-only: an env var must never silently break the
-        # public dispatch's results
-        expects(merge_impl != "skip",
-                "fused_knn_tile: merge_impl='skip' is argument-only")
-    expects(merge_impl in ("merge", "fullsort", "sorttile", "skip"),
-            "fused_knn_tile: unknown merge_impl %s", merge_impl)
+    # registry resolution: "skip" (the attribution probe that returns
+    # WRONG results by design) is an arg-only candidate — the registry
+    # rejects it from config/env/table so an env var can never silently
+    # break the public dispatch's results
+    merge_impl = tuning.resolve("knn_tile_merge", merge_impl,
+                                site="fused_knn_tile", n=n, k=k,
+                                dtype=index.dtype)
 
     # next power of two >= max(k, 128): the bitonic merge width 2*kpad
     # must be a power of two, and kpad must stay a lane multiple

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import is_tpu_backend
@@ -106,10 +106,12 @@ def select_tile(
             "select_tile: float keys required, got %s", keys.dtype)
     if interpret is None:
         interpret = not is_tpu_backend()
-    if merge_impl is None:
-        merge_impl = config.get("knn_tile_merge")
-    expects(merge_impl in ("merge", "fullsort", "sorttile"),
-            "select_tile: unknown merge_impl %s", merge_impl)
+    merge_impl = tuning.resolve("knn_tile_merge", merge_impl,
+                                site="select_tile", n=w, k=k,
+                                dtype=keys.dtype)
+    expects(merge_impl != "skip",
+            "select_tile: merge_impl='skip' has no meaning here (the "
+            "probe belongs to the fused kNN kernel)")
 
     # shared geometry with the fused kNN kernel (one definition so the
     # padding/alignment rules cannot drift between the kernels); the

@@ -64,6 +64,7 @@ import numpy as np
 from raft_tpu import config
 from raft_tpu.core import flight
 from raft_tpu.core import metrics as _metrics
+from raft_tpu.core import tuning
 from raft_tpu.core.error import ServiceOverloadError, expects, fail
 from raft_tpu.serve.resilience import BreakerState
 from raft_tpu.serve.service import (Service, _knob_float, _knob_int,
@@ -220,7 +221,12 @@ class ANNService(Service):
         # per-service top-k impl pin, passed explicitly into every
         # search (the config-doc recommendation: an explicit argument
         # reaches the trace as a Python value and always takes effect);
-        # "approx" is membership-exact and markedly faster at large k
+        # "approx" is membership-exact and markedly faster at large k.
+        # Validated through the candidate registry at CONSTRUCTION so
+        # a typo'd pin fails here, not mid-dispatch inside a trace
+        if select_impl is not None:
+            tuning.check("select_impl", select_impl, site="ANNService",
+                         explicit=True, k=int(k), dtype=dtype)
         self._select_impl = select_impl
 
         # slot-sharded SPMD dispatch (docs/SERVING.md "Sharded
@@ -335,7 +341,9 @@ class ANNService(Service):
         expects(nprobe >= 1, "ANNService: nprobe=%d", int(nprobe))
         self._nprobe = min(int(nprobe), self._nlist)
         if nprobe_ladder is None:
-            nprobe_ladder = config.get("serve_ann_nprobe_ladder")
+            # typed knob read: a malformed env ladder fails HERE as a
+            # LogicError naming the knob + env var (config.py helpers)
+            nprobe_ladder = config.get_int_list("serve_ann_nprobe_ladder")
         self._nprobe_ladder = _parse_ladder(nprobe_ladder, self._nlist)
         if self._nprobe not in self._nprobe_ladder:
             self._nprobe_ladder = tuple(sorted(

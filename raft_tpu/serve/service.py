@@ -85,22 +85,13 @@ __all__ = ["Service", "KNNService", "PairwiseService"]
 _service_seq = itertools.count()
 
 
-def _knob_float(name: str) -> float:
-    raw = config.get(name)
-    try:
-        return float(raw)
-    except (TypeError, ValueError):
-        raise ValueError("raft_tpu.config: %s=%r is not a number"
-                         % (name, raw)) from None
-
-
-def _knob_int(name: str) -> int:
-    raw = config.get(name)
-    try:
-        return int(raw)
-    except (TypeError, ValueError):
-        raise ValueError("raft_tpu.config: %s=%r is not an integer"
-                         % (name, raw)) from None
+# typed knob reads live in config itself now (config.get_float /
+# get_int raise LogicError naming the knob AND its env var — the
+# ad-hoc parses here used to surface malformed env values as bare
+# ValueErrors deep inside construction); these aliases keep the
+# serve-local call sites short
+_knob_float = config.get_float
+_knob_int = config.get_int
 
 
 def _parse_tenant_weights(spec) -> Optional[dict]:
@@ -127,15 +118,17 @@ def _parse_tenant_weights(spec) -> Optional[dict]:
 
 
 def _parse_windows(spec) -> tuple:
-    """Resolve the ``serve_slo_windows_s`` knob's comma-separated
-    seconds list into an ascending float tuple."""
+    """Resolve an SLO-window seconds list (an explicit sequence, or
+    the ``serve_slo_windows_s`` knob already parsed by
+    :func:`config.get_float_list`) into an ascending float tuple."""
     try:
-        out = tuple(sorted(float(tok) for tok in str(spec).split(",")
-                           if tok.strip()))
-    except ValueError:
+        out = tuple(sorted(float(tok) for tok in
+                           (spec.split(",") if isinstance(spec, str)
+                            else spec) if str(tok).strip()))
+    except (TypeError, ValueError):
         raise ValueError(
             "serve_slo_windows_s: %r is not a comma-separated number "
-            "list" % spec) from None
+            "list" % (spec,)) from None
     expects(len(out) > 0 and all(w > 0 for w in out),
             "serve_slo_windows_s: %r resolves to no positive windows",
             spec)
@@ -301,7 +294,7 @@ class Service:
             target_s=_knob_float("serve_slo_target_ms") / 1e3,
             objective=_knob_float("serve_slo_objective"),
             windows_s=_parse_windows(
-                config.get("serve_slo_windows_s")),
+                config.get_float_list("serve_slo_windows_s")),
             clock=clock)
         # fresh exemplars to match the fresh SLO tracker: a rebuilt
         # service under a reused name must not report the dead
@@ -654,7 +647,10 @@ def _resolve_shard_spec(cls_name: str, mesh, axis, merge):
     expects(axis in mesh.axis_names,
             "%s: axis %r not in mesh axes %r", cls_name, axis,
             tuple(mesh.axis_names))
-    return mesh, axis, resolve_merge(merge)
+    # registry resolution at CONSTRUCTION: the service pins its merge
+    # topology once (tuning table answers per the mesh's device count)
+    return mesh, axis, resolve_merge(
+        merge, devices=int(mesh.shape[axis]))
 
 
 class _ShardState(NamedTuple):

@@ -18,17 +18,18 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.sparse.formats import COO, CSR
 from raft_tpu.sparse import convert, op as sparse_op
 
-# the one legal-impl list for csr_spmv: shared by the call-time check
-# below, the spmv_impl knob whitelist (config._KNOBS mirrors it), and
-# SparseMatrix's construction-time validation — a typo'd pin must fail
-# where it is written, not deep inside a jitted Lanczos solve
-SPMV_IMPLS = ("segment", "cumsum", "sortscan")
+# the candidate registry (raft_tpu/core/tuning) owns the legal-impl
+# set; re-exported here for the callers that enumerate it —
+# SparseMatrix's construction-time validation goes through
+# tuning.check so a typo'd pin fails where it is written, not deep
+# inside a jitted Lanczos solve
+SPMV_IMPLS = tuning.candidates("spmv_impl")
 
 
 # --------------------------------------------------------------------- #
@@ -280,9 +281,9 @@ def csr_spmv(csr: CSR, x: jnp.ndarray,
       large-graph spectral regime; small graphs densify instead,
       spectral/matrix_wrappers.py).
     """
-    if impl is None:
-        impl = config.get("spmv_impl")
-    expects(impl in SPMV_IMPLS, "csr_spmv: unknown impl %s", impl)
+    impl = tuning.resolve("spmv_impl", impl, site="csr_spmv",
+                          rows=csr.n_rows, nnz=csr.capacity,
+                          dtype=csr.data.dtype)
     if impl == "cumsum":
         # validity needs only the entry position vs nnz (the tail is
         # padding by the container invariant) — NOT row_ids(), whose

@@ -46,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.handle import record_on_handle
 from raft_tpu.core.profiler import profiled_jit
@@ -666,12 +666,13 @@ def ivf_pq_search(index: IVFPQIndex, queries, k: int,
     refine = ratio > 1 and index.vectors is not None
     metric = DistanceType(int(index.metric))
     k_search = k * ratio if refine else k
-    # ADC impl resolved at CALL time (a trace-time env read would pin
-    # the first value into the shape-keyed executable cache — the
-    # select_k caveat)
-    adc = config.get("pq_adc")
-    expects(adc in ("gather", "onehot"),
-            "ivf_pq_search: unknown pq_adc %s", adc)
+    # ADC impl resolved at CALL time through the candidate registry (a
+    # trace-time env read would pin the first value into the
+    # shape-keyed executable cache — the select_k caveat)
+    adc = tuning.resolve("pq_adc", None, site="ivf_pq_search",
+                         n=int(index.slot_ids.shape[0]
+                               * index.slot_ids.shape[1]),
+                         k=k, dtype=q.dtype)
     base_fn = (_ivf_pq_search_jit_donated
                if donate_queries and not refine and delta is None
                else _ivf_pq_search_jit)

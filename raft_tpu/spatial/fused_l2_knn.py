@@ -39,7 +39,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.spatial.tiled_knn import tiled_knn
 
@@ -83,26 +83,19 @@ def fused_l2_knn(
     expects(index.ndim == 2 and queries.ndim == 2
             and index.shape[1] == queries.shape[1],
             "fused_l2_knn: shape mismatch")
-    requested = impl or config.get("fused_knn_impl")
-    if impl is None:
-        # r4: "xla" on every backend — the measured default (module doc)
-        impl = requested or "xla"
-    expects(impl in ("xla", "pallas"),
-            "fused_l2_knn: unknown impl %s", impl)
+    # registry resolution (override → configure → env → tuning table →
+    # default); unset default = per-backend auto, currently "xla"
+    # everywhere — the r4 measured default (module doc).  The k <= 128
+    # Pallas cap (the kernel's bitonic merge is a network over 2*kpad
+    # lanes; beyond kpad=128 the unrolled network blows up Mosaic
+    # compile time — the reference draws the line even tighter,
+    # fusedL2Knn serving only k <= 64, knn_brute_force_faiss.cuh:
+    # 297-313) is the registry's legality predicate: an explicit pallas
+    # request above it errors rather than silently running another impl.
+    impl = tuning.resolve("fused_knn_impl", impl, site="fused_l2_knn",
+                          n=index.shape[0], k=k,
+                          dtype=index.dtype) or "xla"
     if impl == "pallas":
-        # impl == "pallas" now implies an explicit request (arg or env;
-        # auto-dispatch picks "xla" as of r4).  The kernel's merge is a
-        # bitonic network over 2*kpad lanes; beyond kpad=128 the
-        # unrolled network blows up Mosaic compile time (measured:
-        # minutes at kpad=256 on v5e).  The reference draws the same
-        # line even tighter — fusedL2Knn serves only k <= 64 and larger
-        # k falls back to the general path
-        # (knn_brute_force_faiss.cuh:297-313).  An explicit pallas
-        # request errors rather than silently running another impl.
-        expects(k <= 128,
-                "fused_l2_knn: impl='pallas' supports k <= 128 (bitonic "
-                "merge width cap; got k=%d) — use impl='xla' or reduce k",
-                k)
         from raft_tpu.ops.knn_tile import fused_knn_tile
 
         return fused_knn_tile(index, queries, k,

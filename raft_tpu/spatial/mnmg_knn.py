@@ -75,7 +75,7 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.comms.host_comms import axis_host_group_size, shard_map
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled_jit
@@ -87,7 +87,9 @@ from raft_tpu.spatial.select_k import select_k
 
 D = DistanceType
 
-MERGE_TOPOLOGIES = ("allgather", "ring", "hierarchical")
+# the candidate registry owns the topology set (raft_tpu/core/tuning);
+# re-exported here for the callers that enumerate it
+MERGE_TOPOLOGIES = tuning.candidates("mnmg_merge")
 
 
 def _resolve_comms(handle, comms, mesh, axis):
@@ -117,15 +119,16 @@ def _resolve_comms(handle, comms, mesh, axis):
     return m, m.axis_names[0]
 
 
-def resolve_merge(merge: Optional[str]) -> str:
-    """Resolve the merge-topology knob: explicit argument first, then
-    the ``mnmg_merge`` config knob (env ``RAFT_TPU_MNMG_MERGE``)."""
-    if merge is None:
-        merge = config.get("mnmg_merge")
-    expects(merge in MERGE_TOPOLOGIES,
-            "mnmg: unknown merge topology %r (have: %s)", merge,
-            ", ".join(MERGE_TOPOLOGIES))
-    return merge
+def resolve_merge(merge: Optional[str], *,
+                  devices: Optional[int] = None,
+                  n: Optional[int] = None,
+                  k: Optional[int] = None) -> str:
+    """Resolve the merge-topology knob through the candidate registry:
+    explicit argument first, then the ``mnmg_merge`` config ladder
+    (override → configure → env ``RAFT_TPU_MNMG_MERGE`` → tuning table
+    on the (devices, n, k) shape class → default)."""
+    return tuning.resolve("mnmg_merge", merge, site="mnmg",
+                          devices=devices, n=n, k=k)
 
 
 def resolve_group_size(mesh, axis: str,
@@ -142,9 +145,10 @@ def resolve_group_size(mesh, axis: str,
     size = int(mesh.shape[axis])
     if group_size is not None:
         g = int(group_size)
-        expects(1 <= g <= size and size % g == 0,
-                "mnmg: group_size=%d must divide the axis size %d",
-                g, size)
+        # registry legality (shared LogicError message shape): must
+        # divide the merge axis size
+        tuning.check("mnmg_group_size", g, site="mnmg", explicit=True,
+                     axis_size=size)
         return g
     g = axis_host_group_size(mesh, axis)
     if g is not None and size % g == 0:
@@ -410,7 +414,7 @@ def mnmg_knn(
     # occupy local top-k slots — the widening guarantees >= k real
     # candidates survive the post-search mask
     k_local = min(k + (n_pad - n), rows)
-    merge = resolve_merge(merge)
+    merge = resolve_merge(merge, devices=size, n=n, k=k)
     group_size = (resolve_group_size(mesh_, axis_, group_size)
                   if merge == "hierarchical" else 1)
 
@@ -619,7 +623,9 @@ def mnmg_ivf_flat_search(sharded: ShardedIVFFlat, queries, k: int,
     nprobe = sharded.nprobe if nprobe is None else nprobe
     nprobe = _validate_nprobe("mnmg_ivf_flat_search", nprobe,
                               sharded.nlist)
-    merge = resolve_merge(merge)
+    merge = resolve_merge(merge,
+                          devices=int(sharded.mesh.shape[sharded.axis]),
+                          k=k)
     group_size = (resolve_group_size(sharded.mesh, sharded.axis,
                                      group_size)
                   if merge == "hierarchical" else 1)

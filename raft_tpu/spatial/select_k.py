@@ -37,7 +37,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.utils import ceildiv
 
@@ -124,16 +124,18 @@ def _flip(x):
     return jnp.bitwise_not(x)
 
 
-def _resolve_impl(impl: Optional[str]) -> str:
-    """Default + whitelist for the select impl (shared by
-    :func:`top_k_rows` and :func:`select_k`); the default resolves
-    through :mod:`raft_tpu.config` (knob ``select_impl``, env alias
-    RAFT_TPU_SELECT_IMPL — caveat documented there, once)."""
-    if impl is None:
-        impl = config.get("select_impl")
-    expects(impl in ("topk", "approx", "approx95", "chunked", "pallas"),
-            "select_k: unknown impl %s", impl)
-    return impl
+def _resolve_impl(impl: Optional[str], *, n: Optional[int] = None,
+                  k: Optional[int] = None, dtype=None) -> str:
+    """Default + validation for the select impl (shared by
+    :func:`top_k_rows`, :func:`select_k`, and the tile-scan driver):
+    one call into the candidate registry
+    (:func:`raft_tpu.core.tuning.resolve`), which walks the config
+    ladder — override → configure → env (RAFT_TPU_SELECT_IMPL) →
+    tuning table (shape-class on (n, k)) → default — and owns the
+    candidate whitelist + legality (caveats documented in
+    :mod:`raft_tpu.config`, once)."""
+    return tuning.resolve("select_impl", impl, site="select_k",
+                          dtype=dtype, n=n, k=k)
 
 
 def top_k_rows(sel: jnp.ndarray, k: int,
@@ -155,7 +157,7 @@ def top_k_rows(sel: jnp.ndarray, k: int,
     public kNN/ANN paths) never default to approx95; it exists for
     consumers that opt into recall-for-speed, and the bench reports its
     measured recall next to its QPS."""
-    impl = _resolve_impl(impl)
+    impl = _resolve_impl(impl, n=sel.shape[1], k=k, dtype=sel.dtype)
     if impl == "pallas":
         # fused threshold-gated selection kernel (ops/select_tile.py):
         # the kernel selects SMALLEST, this contract is largest —
@@ -211,7 +213,7 @@ def select_k(
     n = keys.shape[1]
     expects(0 < k <= n, "select_k: k=%d out of range for n=%d", k, n)
 
-    impl = _resolve_impl(impl)
+    impl = _resolve_impl(impl, n=n, k=k, dtype=keys.dtype)
     if values is None:
         sel = -keys if select_min else keys
         top_vals, top_idx = top_k_rows(sel, k, impl)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu import config
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled, profiled_jit
 from raft_tpu.core.utils import as_pytree_fn, ceildiv
@@ -78,10 +78,8 @@ def tiled_knn(
     """
     n = index.shape[0]
     expects(0 < k <= n, "tiled_knn: k=%d out of range for n_index=%d", k, n)
-    if merge is None:
-        merge = config.get("tile_merge")
-    expects(merge in ("tile_topk", "direct"),
-            "tiled_knn: unknown merge %s", merge)
+    merge = tuning.resolve("tile_merge", merge, site="tiled_knn",
+                           n=n, k=k, dtype=queries.dtype)
     # knobs resolved HERE (outside the jit) and passed static, so the
     # executable caches on their values; tile_dist crosses the boundary
     # as a pytree (fresh closures would otherwise retrace the whole
@@ -90,7 +88,10 @@ def tiled_knn(
     run = _tiled_knn_run_donated if donate_queries else _tiled_knn_run
     return run(index, queries, as_pytree_fn(tile_dist),
                k=k, tile_n=max(k, min(tile_n, n)),
-               merge=merge, select_impl=_resolve_impl(None))
+               merge=merge,
+               select_impl=_resolve_impl(
+                   None, n=max(k, min(tile_n, n)), k=k,
+                   dtype=queries.dtype))
 
 
 def _tiled_knn_body(index, queries, tile_dist, k, tile_n, merge,

@@ -26,10 +26,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.error import expects
+from raft_tpu.core import tuning
 from raft_tpu.core.utils import is_tpu_backend
 from raft_tpu.sparse.formats import CSR
-from raft_tpu.sparse.linalg import SPMV_IMPLS, csr_spmv
+from raft_tpu.sparse.linalg import csr_spmv
 
 # auto-densify budget (elements): 2**22 f32 = 16 MiB
 _DENSIFY_ELEMS = 1 << 22
@@ -52,10 +52,10 @@ class SparseMatrix:
                  spmv_impl: str | None = None):
         # fail a typo'd pin HERE, at construction — not attempts deep
         # inside the jitted Lanczos solve that consumes the operator
-        expects(spmv_impl is None or spmv_impl in SPMV_IMPLS,
-                "SparseMatrix: spmv_impl=%r not in %s (None = the "
-                "spmv_impl config knob at trace time)",
-                spmv_impl, SPMV_IMPLS)
+        # (registry legality, shared LogicError message shape)
+        if spmv_impl is not None:
+            tuning.check("spmv_impl", spmv_impl, site="SparseMatrix",
+                         explicit=True)
         self.csr = csr
         if densify is None:
             densify = (is_tpu_backend()

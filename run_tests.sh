@@ -27,4 +27,11 @@ if [[ "${1:-}" == "--serve" ]]; then
     shift
     exec python -m pytest tests/ -q -m serve "$@"
 fi
+# --tuning: only the autotuner/candidate-registry suite (resolution
+# ladder, table load/stale/corrupt, sweep smoke; also part of the
+# default invocation)
+if [[ "${1:-}" == "--tuning" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m tuning "$@"
+fi
 exec python -m pytest tests/ -q "$@"

@@ -25,6 +25,13 @@
 # clients + an open-loop bulk flood through weighted-fair admission;
 # exits non-zero if any shed was untyped (missing retry_after_s).
 #
+# Tuning note: the bench-driven autotuner (tools/autotune.py,
+# docs/TUNING.md) is deterministic best-of-N timing, not a stress
+# scenario — its rot guard is the bench ladder's `autotune_smoke` rung
+# and `./run_tests.sh --tuning`; loop those if a tuning flake is ever
+# suspected (the sweep is seed-free by design: same cells, same
+# candidates, winner = measured min).
+#
 # `./stress.sh serve [N]` loops the serving-layer suite N times
 # (default 10) with a rotating data/submit-order seed
 # (RAFT_TPU_SERVE_SEED) — the concurrent-submitter tests (including

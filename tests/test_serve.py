@@ -586,8 +586,13 @@ class TestServeKnobs:
         svc.close()
 
     def test_bad_numeric_knob_surfaces(self, index):
+        # typed knob parse (config.get_float): LogicError naming the
+        # knob AND its env var — was a bare ValueError before the
+        # autotuner PR's typed-parse satellite
+        from raft_tpu.core.error import LogicError
+
         config.configure(serve_max_wait_ms="fast")
-        with pytest.raises(ValueError, match="serve_max_wait_ms"):
+        with pytest.raises(LogicError, match="serve_max_wait_ms"):
             KNNService(index, k=5, start=False)
 
 

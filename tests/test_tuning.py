@@ -1,0 +1,522 @@
+"""Bench-driven autotuner: candidate registry + tuning-table layer.
+
+Covers the full resolution ladder with the table rung (override >
+configure > env > table > default), table lifecycle (load / stale
+fingerprint / corrupt / suspend), describe() layer attribution, the
+registry's shared validation contract, the typed knob parsers, and the
+sweep driver itself (tools/autotune.py --smoke in-process).
+"""
+
+import importlib.util
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import config
+from raft_tpu.core import tuning
+from raft_tpu.core.error import LogicError, RaftError
+
+pytestmark = pytest.mark.tuning
+
+
+@pytest.fixture(autouse=True)
+def _reset(monkeypatch):
+    monkeypatch.setattr(config, "_values", {})
+    monkeypatch.setattr(config, "_consumed", {})
+    monkeypatch.setattr(config, "_table", None)
+    monkeypatch.setattr(config, "_table_env_checked", True)
+    monkeypatch.setattr(config, "_table_warned", set())
+    for _, (env, _, _) in config._KNOBS.items():
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.delenv(config.TUNING_TABLE_ENV, raising=False)
+    yield
+    config.clear_tuning_table()
+
+
+# select cell the fixtures key on: class of (n=4096, k=16)
+DIMS = {"n": 4096, "k": 16}
+CLS = tuning.shape_class(DIMS)
+
+
+def make_table(entries=None, fp=None):
+    return {
+        "version": 1,
+        "fingerprint": fp or tuning.backend_fingerprint(),
+        "entries": entries if entries is not None else [
+            {"op": "select_k", "knob": "select_impl",
+             "shape_class": CLS, "dtype": "float32",
+             "winner": "chunked", "margin": 2.0},
+            {"op": "select_k", "knob": "select_impl",
+             "shape_class": "*", "dtype": "*", "winner": "approx"},
+        ],
+    }
+
+
+def resolve_select(**kw):
+    kw.setdefault("dtype", jnp.float32)
+    return tuning.resolve("select_impl", site="select_k", **DIMS, **kw)
+
+
+# --------------------------------------------------------------------- #
+# resolution ladder
+# --------------------------------------------------------------------- #
+class TestResolutionLadder:
+    def test_table_answers_when_unset(self):
+        assert resolve_select() == "topk"          # no table: default
+        config.install_tuning_table(make_table())
+        assert resolve_select() == "chunked"       # exact-class cell
+        # unswept class falls through to the "*" rollup
+        assert tuning.resolve("select_impl", site="select_k",
+                              n=1 << 20, k=7,
+                              dtype=jnp.float32) == "approx"
+
+    def test_env_beats_table(self, monkeypatch):
+        config.install_tuning_table(make_table())
+        monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "approx")
+        assert resolve_select() == "approx"
+        assert config.tuned("select_impl")[1] == "env"
+
+    def test_configure_beats_table_and_reverts_to_it(self):
+        config.install_tuning_table(make_table())
+        config.configure(select_impl="topk")
+        assert resolve_select() == "topk"
+        config.configure(select_impl=None)
+        assert resolve_select() == "chunked"       # table, not default
+
+    def test_override_beats_env_and_table(self, monkeypatch):
+        config.install_tuning_table(make_table())
+        monkeypatch.setenv("RAFT_TPU_SELECT_IMPL", "approx")
+        with config.override(select_impl="topk"):
+            assert resolve_select() == "topk"
+        assert resolve_select() == "approx"
+
+    def test_override_none_reverts_to_table_not_default(self):
+        """The acceptance contract: a knob resolved from the table is
+        overridable, and REVERTING the override restores the table's
+        answer (not the built-in default)."""
+        config.install_tuning_table(make_table())
+        with config.override(select_impl="approx"):
+            assert resolve_select() == "approx"
+            with config.override(select_impl=None):
+                assert resolve_select() == "chunked"
+            assert resolve_select() == "approx"
+        assert resolve_select() == "chunked"
+
+    def test_suspend_tuning(self):
+        config.install_tuning_table(make_table())
+        assert resolve_select() == "chunked"
+        with config.suspend_tuning():
+            assert resolve_select() == "topk"
+        assert resolve_select() == "chunked"
+
+    def test_suspend_is_thread_local(self):
+        """A suspension neither leaks into concurrent threads nor
+        races their depth (review finding: the global += counter could
+        lose an increment and latch the table off process-wide)."""
+        import threading
+
+        config.install_tuning_table(make_table())
+        seen = []
+        with config.suspend_tuning():
+            t = threading.Thread(target=lambda:
+                                 seen.append(resolve_select()))
+            t.start()
+            t.join()
+            assert resolve_select() == "topk"      # suspended here
+        assert seen == ["chunked"]                 # not over there
+        assert resolve_select() == "chunked"
+
+    def test_sweep_times_with_table_suspended(self):
+        """Candidate timing must not resolve nested knobs through the
+        incumbent table (review finding: re-sweeps on a tuned venue
+        would persist winners measured under the OLD table's pins)."""
+        at = _load_autotune()
+        config.install_tuning_table(make_table())
+        states = []
+        best, compiles = at.time_candidate(
+            lambda: states.append(config.tuning_table_info()),
+            op="x", cell="c", cand="v", iters=1)
+        assert states == [None, None]              # warmup + 1 iter
+        assert config.tuning_table_info() is not None
+
+    def test_illegal_table_winner_falls_back_to_default(self):
+        """A table cell whose winner is illegal for the REAL call ctx
+        (swept at a coarser class) must fall back to the default, not
+        crash the call: the table is advisory."""
+        t = make_table(entries=[
+            {"op": "select_k", "knob": "select_impl",
+             "shape_class": "*", "dtype": "*", "winner": "pallas"}])
+        config.install_tuning_table(t)
+        got = tuning.resolve("select_impl", site="select_k",
+                             n=100000, k=500, dtype=jnp.float32)
+        assert got == "topk"                       # pallas caps k at 128
+
+    def test_consumer_dispatches_table_winner(self, monkeypatch):
+        """Through the REAL consumer: select_k routes to the table's
+        winner for the matching shape class."""
+        import importlib
+
+        sk = importlib.import_module("raft_tpu.spatial.select_k")
+        calls = []
+        real = sk.chunked_top_k
+        monkeypatch.setattr(
+            sk, "chunked_top_k",
+            lambda *a, **k: calls.append(1) or real(*a, **k))
+        keys = jnp.asarray(
+            np.random.RandomState(0).random((4, DIMS["n"]))
+            .astype("float32"))
+        sk.select_k(keys, DIMS["k"])
+        assert not calls                           # default: topk path
+        config.install_tuning_table(make_table())
+        sk.select_k(keys, DIMS["k"])
+        assert calls                               # table: chunked
+
+
+# --------------------------------------------------------------------- #
+# table lifecycle
+# --------------------------------------------------------------------- #
+class TestTableLifecycle:
+    def test_stale_fingerprint_ignored_with_one_warning(self, tmp_path):
+        fp = dict(tuning.backend_fingerprint())
+        fp["platform"] = "definitely-not-this-backend"
+        path = tmp_path / "stale.json"
+        path.write_text(json.dumps(make_table(fp=fp)))
+        with pytest.warns(UserWarning, match="stale fingerprint"):
+            assert config.load_tuning_table(str(path)) is False
+        assert resolve_select() == "topk"          # untuned
+        # one-time: the second load of the SAME table stays silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert config.load_tuning_table(str(path)) is False
+
+    def test_corrupt_table_fails_loudly(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        with pytest.raises(LogicError, match="corrupt"):
+            config.load_tuning_table(str(bad))
+        wrong = tmp_path / "wrong.json"
+        wrong.write_text(json.dumps({"version": 999, "fingerprint": {},
+                                     "entries": []}))
+        with pytest.raises(LogicError, match="version"):
+            config.load_tuning_table(str(wrong))
+        missing = tmp_path / "missing.json"
+        missing.write_text(json.dumps(
+            {"version": 1,
+             "fingerprint": tuning.backend_fingerprint(),
+             "entries": [{"op": "x"}]}))
+        with pytest.raises(LogicError, match="entry 0"):
+            config.load_tuning_table(str(missing))
+        with pytest.raises(LogicError, match="unreadable"):
+            config.load_tuning_table(str(tmp_path / "nope.json"))
+
+    def test_env_var_load(self, tmp_path, monkeypatch):
+        path = tmp_path / "t.json"
+        path.write_text(json.dumps(make_table()))
+        monkeypatch.setenv(config.TUNING_TABLE_ENV, str(path))
+        monkeypatch.setattr(config, "_table_env_checked", False)
+        assert resolve_select() == "chunked"
+        info = config.tuning_table_info()
+        assert info["cells"] == 2
+        assert info["knobs"] == {"select_impl": 2}
+
+    def test_roundtrip_through_file(self, tmp_path):
+        path = tmp_path / "t.json"
+        path.write_text(json.dumps(make_table()))
+        assert config.load_tuning_table(str(path)) is True
+        assert resolve_select() == "chunked"
+        config.clear_tuning_table()
+        assert resolve_select() == "topk"
+
+    def test_checked_in_table_is_valid(self):
+        """Every checked-in table under raft_tpu/tuning/ parses and
+        indexes (fingerprint match not required — other venues' tables
+        ride the same tree)."""
+        d = os.path.join(os.path.dirname(config.__file__), "tuning")
+        found = 0
+        for fname in os.listdir(d):
+            if fname.endswith(".json"):
+                with open(os.path.join(d, fname)) as f:
+                    doc = json.load(f)
+                t = config._index_table(doc, fname)
+                assert t["index"], fname
+                found += 1
+        assert found >= 1                          # the CPU-ladder table
+
+
+# --------------------------------------------------------------------- #
+# describe() attribution
+# --------------------------------------------------------------------- #
+class TestDescribe:
+    def test_layers(self, monkeypatch):
+        config.install_tuning_table(make_table())
+        monkeypatch.setenv("RAFT_TPU_TILE_MERGE", "direct")
+        config.configure(spmv_impl="sortscan")
+        with config.override(pq_adc="onehot"):
+            d = config.describe(layers=True)
+            assert d["pq_adc"] == {"value": "onehot",
+                                   "layer": "override"}
+            assert d["spmv_impl"] == {"value": "sortscan",
+                                      "layer": "configure"}
+            assert d["tile_merge"] == {"value": "direct",
+                                       "layer": "env"}
+            # two table cells with different winners -> "per-shape"
+            assert d["select_impl"] == {"value": "per-shape",
+                                        "layer": "table"}
+            assert d["mnmg_merge"] == {"value": "allgather",
+                                       "layer": "default"}
+        # unanimous single-cell table reads its winner
+        config.install_tuning_table(make_table(entries=[
+            {"op": "select_k", "knob": "select_impl",
+             "shape_class": CLS, "dtype": "float32",
+             "winner": "chunked"}]))
+        d = config.describe(layers=True)
+        assert d["select_impl"] == {"value": "chunked",
+                                    "layer": "table"}
+        # plain describe() reports the EFFECTIVE value — the table's
+        # winner, exactly what consumers receive (review finding: the
+        # untabled _resolve here misled operators about the running
+        # config)
+        assert config.describe()["select_impl"] == "chunked"
+        with config.suspend_tuning():
+            assert config.describe()["select_impl"] == "topk"
+
+    def test_override_none_attributes_to_table(self):
+        config.install_tuning_table(make_table(entries=[
+            {"op": "select_k", "knob": "select_impl",
+             "shape_class": "*", "dtype": "*", "winner": "approx"}]))
+        config.configure(select_impl="topk")
+        with config.override(select_impl=None):
+            d = config.describe(layers=True)
+            assert d["select_impl"] == {"value": "approx",
+                                        "layer": "table"}
+
+
+# --------------------------------------------------------------------- #
+# registry contract
+# --------------------------------------------------------------------- #
+class TestRegistry:
+    def test_shared_message_shape(self):
+        with pytest.raises(LogicError) as ei:
+            tuning.check("spmv_impl", "cusparse", site="SparseMatrix")
+        msg = str(ei.value)
+        # names the site, knob, value, legal set, and why
+        for frag in ("SparseMatrix", "spmv_impl", "cusparse",
+                     "segment", "cumsum", "sortscan", "unknown impl"):
+            assert frag in msg
+
+    def test_arg_only_candidate(self):
+        assert tuning.check("knn_tile_merge", "skip",
+                            site="fused_knn_tile",
+                            explicit=True) == "skip"
+        with pytest.raises(LogicError, match="argument-only"):
+            tuning.check("knn_tile_merge", "skip",
+                         site="fused_knn_tile")
+        # from the table layer: also rejected (falls back via resolve)
+        t = make_table(entries=[
+            {"op": "fused_knn_tile", "knob": "knn_tile_merge",
+             "shape_class": "*", "dtype": "*", "winner": "skip"}])
+        config.install_tuning_table(t)
+        assert tuning.resolve("knn_tile_merge", site="fused_knn_tile",
+                              n=1024, k=8) == "merge"
+
+    def test_twophase_pin_ignores_config(self):
+        """merge_select_impl is registry-only: a process-wide
+        select_impl configure() must not reach it."""
+        config.configure(select_impl="approx95")
+        assert tuning.resolve("merge_select_impl") == "topk"
+        assert tuning.resolve("merge_select_impl", "chunked") == \
+            "chunked"
+
+    def test_group_size_legality(self):
+        with pytest.raises(LogicError, match="mnmg_group_size"):
+            tuning.check("mnmg_group_size", 3, site="mnmg",
+                         explicit=True, axis_size=8)
+        assert tuning.check("mnmg_group_size", 4, site="mnmg",
+                            explicit=True, axis_size=8) == 4
+
+    def test_sparse_matrix_typo_via_registry(self):
+        from raft_tpu.sparse.formats import CSR
+        from raft_tpu.spectral.matrix_wrappers import SparseMatrix
+
+        d = (np.random.RandomState(0).random((8, 8)) * 1).astype(
+            "float32")
+        csr = CSR.from_dense(d, capacity=80)
+        with pytest.raises(RaftError, match="spmv_impl"):
+            SparseMatrix(csr, spmv_impl="segement")
+
+    def test_pallas_k_cap_legality(self):
+        with pytest.raises(LogicError, match="128"):
+            tuning.resolve("fused_knn_impl", "pallas",
+                           site="fused_l2_knn", n=10000, k=500)
+
+    def test_every_choices_knob_is_registered(self):
+        """The lint's contract, asserted dynamically too: every config
+        knob with a choices whitelist has a registry spec with the
+        SAME candidate set."""
+        for knob, (_, _, choices) in config._KNOBS.items():
+            if choices is None:
+                continue
+            assert set(tuning.candidates(knob)) == set(choices), knob
+
+    def test_shape_class_pow2_rounding(self):
+        assert tuning.shape_class({"n": 100000, "k": 100}) == \
+            "k=128,n=131072"
+        assert tuning.shape_class({"n": 131072, "k": 128}) == \
+            "k=128,n=131072"
+        assert tuning.shape_class({}) == "*"
+        assert tuning.shape_class({"n": 8192, "k": 100}) != \
+            tuning.shape_class({"n": 131072, "k": 100})
+
+
+# --------------------------------------------------------------------- #
+# typed knob parsers
+# --------------------------------------------------------------------- #
+class TestTypedParsers:
+    @pytest.mark.parametrize("fn,knob,env,bad", [
+        (config.get_int, "serve_queue_cap",
+         "RAFT_TPU_SERVE_QUEUE_CAP", "many"),
+        (config.get_float, "serve_max_wait_ms",
+         "RAFT_TPU_SERVE_MAX_WAIT_MS", "fast"),
+        (config.get_float, "serve_hedge_factor",
+         "RAFT_TPU_SERVE_HEDGE_FACTOR", "1.5x"),
+        (config.get_int_list, "serve_ann_nprobe_ladder",
+         "RAFT_TPU_SERVE_ANN_NPROBE_LADDER", "4,8,banana"),
+        (config.get_float_list, "serve_slo_windows_s",
+         "RAFT_TPU_SERVE_SLO_WINDOWS_S", "60,eternity"),
+    ])
+    def test_malformed_env_names_knob_and_env(self, monkeypatch, fn,
+                                              knob, env, bad):
+        monkeypatch.setenv(env, bad)
+        with pytest.raises(LogicError) as ei:
+            fn(knob)
+        assert knob in str(ei.value)
+        assert env in str(ei.value)
+
+    def test_happy_paths(self, monkeypatch):
+        assert config.get_int("serve_queue_cap") == 1024
+        assert config.get_float("serve_max_wait_ms") == 2.0
+        assert config.get_int_list("serve_ann_nprobe_ladder") == \
+            (4, 8, 16, 32, 64)
+        assert config.get_float_list("serve_slo_windows_s") == \
+            (60.0, 300.0)
+
+    def test_service_construction_surfaces_logic_error(self):
+        """The serve layer reads through the typed helpers: a
+        malformed configure()d value fails service construction with
+        the knob-naming LogicError (was a bare ValueError)."""
+        from raft_tpu.serve.service import KNNService
+
+        idx = jnp.asarray(np.random.RandomState(0)
+                          .random((64, 8)).astype("float32"))
+        config.configure(serve_max_wait_ms="fast")
+        try:
+            with pytest.raises(LogicError, match="serve_max_wait_ms"):
+                KNNService(idx, k=5, start=False)
+        finally:
+            config.configure(serve_max_wait_ms=None)
+
+
+# --------------------------------------------------------------------- #
+# the sweep driver (tools/autotune.py)
+# --------------------------------------------------------------------- #
+def _load_autotune():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "autotune.py")
+    spec = importlib.util.spec_from_file_location("_autotune_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestAutotune:
+    def test_smoke_sweep_produces_valid_table(self, tmp_path):
+        at = _load_autotune()
+        table = at.run_sweep(smoke=True, log=lambda *_: None)
+        # valid per the config loader's own contract
+        t = config._index_table(table, "<smoke>")
+        exact = [e for e in table["entries"]
+                 if e["shape_class"] != "*"]
+        swept_knobs = {e["knob"] for e in exact}
+        # every knob with >= 1 sweep-legal candidate on this backend
+        assert {"select_impl", "tile_merge", "spmv_impl", "pq_adc",
+                "mnmg_merge"} <= swept_knobs
+        for e in exact:
+            assert e["winner"] in e["timings_s"]
+            assert all(n == 0 for n in
+                       e["post_warmup_compiles"].values()), e
+            assert e["margin"] >= 1.0
+        # rollup entries cover shape-less lookups
+        assert any(e["shape_class"] == "*" for e in table["entries"])
+        assert t["index"]
+
+    def test_smoke_table_installs_and_tuned_vs_default(self):
+        at = _load_autotune()
+        table = at.run_sweep(smoke=True, log=lambda *_: None)
+        assert config.install_tuning_table(table) is True
+        res = at.tuned_vs_default(table, iters=2, log=lambda *_: None)
+        assert res["cells"]
+        # smoke cells are ms-scale, so the re-timed ratio is noisy:
+        # this asserts the MACHINERY (the >= 1.0 bar is the bench
+        # rung's, over the real-size checked-in table)
+        assert res["min_ratio"] is None or res["min_ratio"] >= 0.5
+        assert res["post_warmup_compiles"] == 0
+
+    def test_dry_run_and_filters(self, capsys):
+        at = _load_autotune()
+        assert at.main(["--dry-run", "--smoke"]) == 0
+        out = capsys.readouterr().out
+        assert "select_k/select_impl" in out
+        assert "SWEEP" in out
+        table = at.run_sweep(smoke=True, op_filter="select_impl",
+                             log=lambda *_: None)
+        assert {e["knob"] for e in table["entries"]} == \
+            {"select_impl"}
+
+    def test_diff_tables(self):
+        at = _load_autotune()
+        old = make_table()
+        new = make_table(entries=[
+            {"op": "select_k", "knob": "select_impl",
+             "shape_class": CLS, "dtype": "float32",
+             "winner": "topk", "margin": 1.2},
+        ])
+        logs = []
+        changes = at.diff_tables(old, new, log=logs.append)
+        assert changes == 2                        # 1 flip + 1 gone
+        assert any("FLIP" in ln for ln in logs)
+        assert at.diff_tables(old, old, log=logs.append) == 0
+
+
+# --------------------------------------------------------------------- #
+# the style lint (registry drift)
+# --------------------------------------------------------------------- #
+class TestStyleLint:
+    def _sc(self):
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ci", "style_check.py")
+        spec = importlib.util.spec_from_file_location("_sc_t", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_real_tree_has_no_drift(self):
+        sc = self._sc()
+        assert sc.check_tuning_registry() == []
+
+    def test_drift_detected(self):
+        sc = self._sc()
+        cfg = ('_KNOBS = {\n'
+               '    "ghost_impl": ("E", "a", ("a", "b")),\n'
+               '}\n')
+        probs = sc.check_tuning_registry(config_src=cfg,
+                                         tuning_src="\n")
+        assert probs and "ghost_impl" in probs[0]
+
+    def test_lint_selftest_green(self):
+        sc = self._sc()
+        assert sc.selftest() == 0

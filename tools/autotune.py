@@ -1,0 +1,645 @@
+#!/usr/bin/env python
+"""Bench-driven autotuner: sweep the candidate registry, persist winners.
+
+The reference hand-specializes its dispatch per GPU arch; CUDA-L2
+(PAPERS.md) shows *searched* schedules beating hand-tuned kernels, and
+the CUDA-Tile evaluation shows the winner is venue-specific.  raft_tpu
+does not need RL for that: the whole impl-choice space is the small
+discrete candidate registry (:mod:`raft_tpu.core.tuning`), so an
+exhaustive timed sweep per (backend, op, shape-class, dtype) cell
+settles every knob with measurements.
+
+For each cell the driver:
+
+1. asks the registry for the candidates *legal to sweep* on this
+   backend (``purpose="sweep"`` — interpreted-Pallas-off-TPU and the
+   deliberately approximate modes are excluded there, with reasons
+   recorded);
+2. times each candidate through the library's own instrumentation —
+   the workload compiles via :func:`profiled_jit` (compile time
+   excluded and accounted separately), executes best-of-N with every
+   sample observed into the metrics registry
+   (``raft_tpu_autotune_exec_seconds``), and asserts ZERO post-warmup
+   compiles (a candidate that recompiles mid-loop is mis-timed and the
+   cell records it);
+3. persists the winner + measured margins to a versioned JSON table
+   keyed by the backend fingerprint (platform, device kind, device
+   count) that :func:`raft_tpu.config.tuned` consults between env and
+   default (docs/TUNING.md "Bench-driven autotuning").
+
+Conservatism rule: a non-default winner is persisted only when it
+beats the config default by at least ``--min-margin`` (default 1.05x)
+— below that the default is kept, so the ``tuned_vs_default`` bench
+rung can never lose to noise on a coin-flip cell.
+
+Usage
+-----
+  python tools/autotune.py                   # full sweep -> raft_tpu/tuning/<slug>.json
+  python tools/autotune.py --smoke           # one tiny cell per op (CI / bench wiring)
+  python tools/autotune.py --op select_k     # filter by op
+  python tools/autotune.py --cell k100       # filter by cell-name substring
+  python tools/autotune.py --dry-run         # plan only: cells x candidates, no timing
+  python tools/autotune.py --out /tmp/t.json # write elsewhere
+
+The CPU-ladder checked-in table is generated under the bench/test
+environment (8 virtual devices)::
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/autotune.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+ITERS_FULL = 5
+ITERS_SMOKE = 2
+MIN_MARGIN = 1.05
+
+
+# --------------------------------------------------------------------- #
+# cell catalog: (op, knob) -> full + smoke cells.  Dims use the SAME
+# names as the registry spec's class dims (tuning.KnobSpec.dims) — the
+# consumer lookup key and the sweep key must not skew.
+# --------------------------------------------------------------------- #
+def catalog(smoke: bool):
+    """[(op, knob, cell_name, dims, extra)] — ``dims`` feed the shape
+    class; ``extra`` holds workload-only sizes (nq, d, ...)."""
+    if smoke:
+        return [
+            ("select_k", "select_impl", "k16_smoke",
+             {"n": 4096, "k": 16}, {"nq": 32}),
+            ("tiled_knn", "tile_merge", "knn4k_smoke",
+             {"n": 4096, "k": 16}, {"nq": 32, "d": 16}),
+            ("fused_l2_knn", "fused_knn_impl", "fused2k_smoke",
+             {"n": 2048, "k": 8}, {"nq": 32, "d": 16}),
+            ("fused_knn_tile", "knn_tile_merge", "ktile2k_smoke",
+             {"n": 2048, "k": 8}, {"nq": 32, "d": 16}),
+            ("csr_spmv", "spmv_impl", "spmv4k_smoke",
+             {"rows": 4096, "nnz": 32768}, {}),
+            ("ivf_pq_search", "pq_adc", "pq2k_smoke",
+             {"n": 2048, "k": 8},
+             {"d": 16, "nlist": 16, "M": 4, "nq": 32}),
+            ("mnmg_knn", "mnmg_merge", "mnmg1k_smoke",
+             {"n": 1024, "k": 8}, {"nq": 16, "d": 16}),
+        ]
+    return [
+        # THE acceptance cell: select at k=100 over a wide row (PR 5
+        # measured ~7x spread between impls at k=100)
+        ("select_k", "select_impl", "k100",
+         {"n": 131072, "k": 100}, {"nq": 256}),
+        ("select_k", "select_impl", "k10",
+         {"n": 131072, "k": 10}, {"nq": 256}),
+        ("tiled_knn", "tile_merge", "knn50k",
+         {"n": 50000, "k": 100}, {"nq": 256, "d": 64}),
+        ("fused_l2_knn", "fused_knn_impl", "fused20k",
+         {"n": 20000, "k": 32}, {"nq": 128, "d": 64}),
+        ("fused_knn_tile", "knn_tile_merge", "ktile20k",
+         {"n": 20000, "k": 32}, {"nq": 128, "d": 64}),
+        ("csr_spmv", "spmv_impl", "spmv200k",
+         {"rows": 200000, "nnz": 2000000}, {}),
+        ("ivf_pq_search", "pq_adc", "pq32k",
+         {"n": 32768, "k": 10},
+         {"d": 64, "nlist": 64, "M": 8, "nq": 128}),
+        # merge-heavy geometry (small per-shard scan, wide nq*k merge
+        # traffic): where the topology choice actually moves the
+        # needle — measured 1.2x hierarchical-vs-allgather on the
+        # 8-device virtual mesh
+        ("mnmg_knn", "mnmg_merge", "mnmg16k",
+         {"n": 16384, "k": 100}, {"nq": 512, "d": 32}),
+    ]
+
+
+def _rand(shape, seed=0, scale=1.0):
+    import numpy as np
+
+    return (np.random.RandomState(seed).random(shape) * scale).astype(
+        "float32")
+
+
+def _jnp(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+# --------------------------------------------------------------------- #
+# per-op workload builders: build data ONCE per cell, return
+# make(candidate) -> zero-arg blocking step.  Every workload keeps both
+# outputs live (bench lesson r4: a dead output lets XLA delete the
+# selection inside the timing loop).
+# --------------------------------------------------------------------- #
+def _build_select_k(dims, extra, cell):
+    import jax
+
+    from raft_tpu.core.profiler import profiled_jit
+    from raft_tpu.spatial.select_k import select_k
+
+    keys = _jnp(_rand((extra["nq"], dims["n"])))
+    k = dims["k"]
+
+    def make(cand):
+        fn = profiled_jit(
+            lambda ks: select_k(ks, k, impl=cand),
+            name="autotune_select_%s_%s" % (cell, cand))
+        return lambda: jax.block_until_ready(fn(keys))
+    return make
+
+
+def _build_tiled_knn(dims, extra, cell):
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.spatial.fused_l2_knn import _l2_tile_dist
+    from raft_tpu.spatial.tiled_knn import tiled_knn
+
+    x = _jnp(_rand((dims["n"], extra["d"])))
+    q = _jnp(_rand((extra["nq"], extra["d"]), seed=1))
+    qn = jnp.sum(q * q, axis=1)
+    tile_dist = jax.tree_util.Partial(_l2_tile_dist("highest"), qn)
+    k = dims["k"]
+
+    def make(cand):
+        return lambda: jax.block_until_ready(
+            tiled_knn(x, q, k, tile_dist, merge=cand))
+    return make
+
+
+def _build_fused_l2_knn(dims, extra, cell):
+    import jax
+
+    from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+    x = _jnp(_rand((dims["n"], extra["d"])))
+    q = _jnp(_rand((extra["nq"], extra["d"]), seed=1))
+    k = dims["k"]
+
+    def make(cand):
+        return lambda: jax.block_until_ready(
+            fused_l2_knn(x, q, k, impl=cand))
+    return make
+
+
+def _build_fused_knn_tile(dims, extra, cell):
+    import jax
+
+    from raft_tpu.ops.knn_tile import fused_knn_tile
+
+    x = _jnp(_rand((dims["n"], extra["d"])))
+    q = _jnp(_rand((extra["nq"], extra["d"]), seed=1))
+    k = dims["k"]
+
+    def make(cand):
+        return lambda: jax.block_until_ready(
+            fused_knn_tile(x, q, k, merge_impl=cand))
+    return make
+
+
+def _build_csr_spmv(dims, extra, cell):
+    import jax
+    import numpy as np
+
+    from raft_tpu.core.profiler import profiled_jit
+    from raft_tpu.sparse.formats import CSR
+    from raft_tpu.sparse.linalg import csr_spmv
+
+    rows = dims["rows"]
+    nnz_row = max(1, dims["nnz"] // rows)
+    rng = np.random.RandomState(0)
+    dense_cols = rows
+    indptr = np.arange(rows + 1, dtype=np.int32) * nnz_row
+    indices = rng.randint(0, dense_cols,
+                          size=rows * nnz_row).astype(np.int32)
+    data = rng.random(rows * nnz_row).astype(np.float32)
+    csr = CSR(_jnp(indptr), _jnp(indices), _jnp(data),
+              (rows, dense_cols))
+    x = _jnp(rng.random(dense_cols).astype(np.float32))
+
+    def make(cand):
+        fn = profiled_jit(
+            lambda c, v: csr_spmv(c, v, impl=cand),
+            name="autotune_spmv_%s_%s" % (cell, cand))
+        return lambda: jax.block_until_ready(fn(csr, x))
+    return make
+
+
+def _build_ivf_pq_search(dims, extra, cell):
+    import jax
+
+    from raft_tpu import config
+    from raft_tpu.spatial.ann import IVFPQParams, ivf_pq_build, \
+        ivf_pq_search
+
+    x = _rand((dims["n"], extra["d"]))
+    q = _jnp(_rand((extra["nq"], extra["d"]), seed=1))
+    params = IVFPQParams(nlist=extra["nlist"], nprobe=4,
+                         M=extra["M"], n_bits=8)
+    index = ivf_pq_build(_jnp(x), params)
+    k = dims["k"]
+
+    def make(cand):
+        def step():
+            # pq_adc resolves at call time from config; candidate
+            # pinned via a scoped override (consumed-knob warnings are
+            # the sweep's own churn, not a user bug — suppressed)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with config.override(pq_adc=cand):
+                    return jax.block_until_ready(
+                        ivf_pq_search(index, q, k))
+        return step
+    return make
+
+
+def _build_mnmg_knn(dims, extra, cell):
+    import jax
+
+    from raft_tpu.spatial.mnmg_knn import mnmg_knn
+
+    x = _jnp(_rand((dims["n"], extra["d"])))
+    q = _jnp(_rand((extra["nq"], extra["d"]), seed=1))
+    k = dims["k"]
+
+    def make(cand):
+        return lambda: jax.block_until_ready(
+            mnmg_knn(x, q, k, merge=cand))
+    return make
+
+
+BUILDERS = {
+    "select_k": _build_select_k,
+    "tiled_knn": _build_tiled_knn,
+    "fused_l2_knn": _build_fused_l2_knn,
+    "fused_knn_tile": _build_fused_knn_tile,
+    "csr_spmv": _build_csr_spmv,
+    "ivf_pq_search": _build_ivf_pq_search,
+    "mnmg_knn": _build_mnmg_knn,
+}
+
+
+# --------------------------------------------------------------------- #
+# timing: profiled_jit owns compile accounting; executes are observed
+# into the metrics registry AND reduced best-of-N locally
+# --------------------------------------------------------------------- #
+def _total_misses():
+    from raft_tpu.core.profiler import compile_cache_stats
+
+    return sum(st.get("misses", 0)
+               for keys in compile_cache_stats().values()
+               for st in keys.values())
+
+
+def _exec_timer(op, cell, cand):
+    from raft_tpu.core.metrics import default_registry
+
+    return default_registry().timer(
+        "raft_tpu_autotune_exec_seconds",
+        help="autotune sweep execute time (best-of-N per candidate)",
+        labels=("op", "cell", "candidate")).labels(
+            op=op, cell=cell, candidate=cand)
+
+
+def time_candidate(step, *, op, cell, cand, iters):
+    """(best_seconds, post_warmup_compiles): one warmup call (compile,
+    attributed by profiled_jit), then ``iters`` timed executes with a
+    zero-new-compiles assertion across the loop.  The tuning table is
+    SUSPENDED throughout: the swept candidate is pinned explicitly,
+    and any *nested* knob the workload resolves (e.g. tiled_knn's
+    internal select_impl) must time at the defaults — or a re-sweep on
+    an already-tuned venue would measure candidates under the
+    incumbent table's pins and persist winners inconsistent with the
+    fresh table they ship in."""
+    from raft_tpu import config
+
+    with config.suspend_tuning():
+        step()                               # warmup: compile + cache
+        m0 = _total_misses()
+        timer = _exec_timer(op, cell, cand)
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            step()
+            dt = time.perf_counter() - t0
+            timer.observe(dt)
+            best = min(best, dt)
+        return best, _total_misses() - m0
+
+
+# --------------------------------------------------------------------- #
+# the sweep
+# --------------------------------------------------------------------- #
+def _effective_default(knob):
+    """The sweep's comparison baseline: the config default, or — for
+    unset-default knobs like fused_knn_impl whose None means a
+    consumer-side auto — the registry's declared auto_default.
+    Without this, the min-margin conservatism and the tuned_vs_default
+    guard would both silently skip such knobs and a noise-level winner
+    could be persisted unverified."""
+    from raft_tpu import config
+    from raft_tpu.core import tuning
+
+    return (config.knob_default(knob)
+            or tuning.spec(knob).auto_default)
+
+
+def _augment_dims(op, dims):
+    """Backend-dependent dims resolved at sweep time: the mnmg merge
+    cell is keyed on the LIVE device count (the winner flips with the
+    mesh size — that is a shape dim, not a fingerprint concern)."""
+    if op == "mnmg_knn":
+        import jax
+
+        return dict(dims, devices=jax.device_count())
+    return dims
+
+
+def sweep_cell(op, knob, cell_name, dims, extra, *, iters,
+               min_margin=MIN_MARGIN):
+    """Time every sweep-legal candidate of one cell; returns the table
+    entry (winner conservatism: module doc) or None when fewer than
+    one candidate is legal."""
+    from raft_tpu import config
+    from raft_tpu.core import tuning
+
+    dims = _augment_dims(op, dims)
+    cands = tuning.legal_candidates(knob, purpose="sweep",
+                                    dtype="float32", **dims)
+    legal = [c for c, why in cands if why is None]
+    skipped = {c: why for c, why in cands if why is not None}
+    if not legal:
+        return None
+    make = BUILDERS[op](dims, extra, cell_name)
+    timings, compiles = {}, {}
+    for cand in legal:
+        t, extra_compiles = time_candidate(
+            make(cand), op=op, cell=cell_name, cand=cand, iters=iters)
+        timings[cand] = t
+        compiles[cand] = extra_compiles
+    ranked = sorted(timings, key=timings.get)
+    winner = ranked[0]
+    default = _effective_default(knob)
+    margin = (timings[ranked[1]] / timings[winner]
+              if len(ranked) > 1 else 1.0)
+    vs_default = (timings[default] / timings[winner]
+                  if default in timings else None)
+    reverted_from = None
+    if (default in timings and winner != default
+            and timings[default] / timings[winner] < min_margin):
+        # conservatism: a sub-margin win is noise territory — keep the
+        # default so the tuned table can never LOSE to it.  margin is
+        # RECOMPUTED for the persisted winner (best alternative over
+        # it — honestly < 1 here: the discarded candidate was faster,
+        # just inside the noise band)
+        reverted_from, winner = winner, default
+        vs_default = 1.0
+        margin = round(min(t for c, t in timings.items()
+                           if c != winner) / timings[winner], 4)
+    return {
+        "op": op, "knob": knob, "cell": cell_name,
+        "shape_class": tuning.shape_class(dims),
+        "dtype": "float32",
+        "dims": dims,
+        "extra": extra,
+        "winner": winner,
+        "margin": round(margin, 4),
+        "reverted_from": reverted_from,
+        "vs_default": (round(vs_default, 4)
+                       if vs_default is not None else None),
+        "timings_s": {c: round(t, 6) for c, t in timings.items()},
+        "post_warmup_compiles": compiles,
+        "skipped": skipped,
+        "iters": iters,
+    }
+
+
+def run_sweep(*, smoke=False, op_filter=None, cell_filter=None,
+              iters=None, min_margin=MIN_MARGIN, log=print):
+    """Run the sweep; returns the table document (not yet written)."""
+    from raft_tpu.core import tuning
+
+    cells = catalog(smoke)
+    if op_filter:
+        cells = [c for c in cells if c[0] == op_filter
+                 or c[1] == op_filter]
+    if cell_filter:
+        cells = [c for c in cells if cell_filter in c[2]]
+    iters = iters or (ITERS_SMOKE if smoke else ITERS_FULL)
+    entries = []
+    for op, knob, cell_name, dims, extra in cells:
+        log("sweep %s/%s cell=%s dims=%s ..." % (op, knob, cell_name,
+                                                 dims))
+        e = sweep_cell(op, knob, cell_name, dims, extra, iters=iters,
+                       min_margin=min_margin)
+        if e is None:
+            log("  no sweep-legal candidates on this backend; skipped")
+            continue
+        log("  winner=%s margin=%.2fx vs_default=%s timings=%s" % (
+            e["winner"], e["margin"], e["vs_default"],
+            {c: "%.4fs" % t for c, t in e["timings_s"].items()}))
+        bad = {c: n for c, n in e["post_warmup_compiles"].items() if n}
+        if bad:
+            log("  WARNING post-warmup compiles: %s (mis-timed "
+                "candidates)" % bad)
+        entries.append(e)
+    # per-(op, knob) wildcard rollup: the winner of the LARGEST swept
+    # cell answers shape-less lookups (e.g. serve construction) and
+    # unswept classes through the lookup's "*" fallbacks
+    by_knob = {}
+    for e in entries:
+        by_knob.setdefault((e["op"], e["knob"]), []).append(e)
+    for (op, knob), group in sorted(by_knob.items()):
+        largest = max(group, key=lambda e: _cell_volume(e["dims"]))
+        entries.append({
+            "op": op, "knob": knob, "cell": "rollup",
+            "shape_class": "*", "dtype": "*",
+            "winner": largest["winner"],
+            "margin": largest["margin"],
+            "vs_default": largest["vs_default"],
+            "rollup_of": largest["cell"],
+        })
+    return {
+        "version": 1,
+        "fingerprint": tuning.backend_fingerprint(),
+        "created_unix": int(time.time()),
+        "generated_by": "tools/autotune.py",
+        "smoke": smoke,
+        "min_margin": min_margin,
+        "entries": entries,
+    }
+
+
+def _cell_volume(dims):
+    v = 1
+    for x in dims.values():
+        v *= max(int(x), 1)
+    return v
+
+
+def diff_tables(old, new, log=print):
+    """Human diff of winners: new vs incumbent, per cell."""
+    def key(e):
+        return (e["op"], e["knob"], e["shape_class"], e["dtype"])
+
+    old_ix = {key(e): e for e in old.get("entries", [])}
+    changes = 0
+    for e in new["entries"]:
+        inc = old_ix.pop(key(e), None)
+        if inc is None:
+            log("  NEW   %s/%s [%s] -> %s" % (
+                e["op"], e["knob"], e["shape_class"], e["winner"]))
+            changes += 1
+        elif inc["winner"] != e["winner"]:
+            log("  FLIP  %s/%s [%s]: %s -> %s (margin %.2fx)" % (
+                e["op"], e["knob"], e["shape_class"], inc["winner"],
+                e["winner"], e.get("margin", 1.0)))
+            changes += 1
+    for k in old_ix:
+        log("  GONE  %s/%s [%s]" % (k[0], k[1], k[2]))
+        changes += 1
+    if not changes:
+        log("  no winner changes vs incumbent")
+    return changes
+
+
+# --------------------------------------------------------------------- #
+# tuned-vs-default: what is the table worth on this venue?  (the bench
+# rung's engine — docs/TUNING.md "Measuring")
+# --------------------------------------------------------------------- #
+def _time_ab(step_a, step_b, *, iters, op, cell, cand_a, cand_b):
+    """Interleaved A/B best-of-N: the arms alternate every iteration
+    so a host load spike lands on BOTH, not whichever arm it happened
+    to overlap (the serve_trace_overhead rung's discipline — a
+    sequential A-then-B on a busy box can invert a real 1.17x margin).
+    Returns (best_a, best_b, post_warmup_compiles).  Table suspended
+    throughout (the time_candidate rationale: nested knobs time at
+    the defaults both arms share)."""
+    from raft_tpu import config
+
+    with config.suspend_tuning():
+        step_a()
+        step_b()                           # warm both: compiles done
+        m0 = _total_misses()
+        timer_a = _exec_timer(op, cell, cand_a)
+        timer_b = _exec_timer(op, cell, cand_b)
+        best_a = best_b = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            step_a()
+            dt = time.perf_counter() - t0
+            timer_a.observe(dt)
+            best_a = min(best_a, dt)
+            t0 = time.perf_counter()
+            step_b()
+            dt = time.perf_counter() - t0
+            timer_b.observe(dt)
+            best_b = min(best_b, dt)
+        return best_a, best_b, _total_misses() - m0
+
+
+def tuned_vs_default(table, *, iters=5, log=print):
+    """Re-time winner vs config-default for every exact swept cell of
+    ``table``; returns per-op ratios.  winner == default reports 1.0
+    without re-timing (same executable — there is nothing to race);
+    genuinely different arms race INTERLEAVED (:func:`_time_ab`)."""
+    out = {"cells": [], "min_ratio": None, "max_ratio": None,
+           "post_warmup_compiles": 0}
+    for e in table["entries"]:
+        if e.get("shape_class") == "*" or "dims" not in e:
+            continue
+        default = _effective_default(e["knob"])
+        cell_r = {"op": e["op"], "knob": e["knob"], "cell": e["cell"],
+                  "winner": e["winner"], "default": default}
+        if e["winner"] == default or default not in e.get(
+                "timings_s", {e["winner"]: 0}):
+            cell_r["ratio"] = 1.0
+            cell_r["note"] = "winner is the default"
+        else:
+            make = BUILDERS[e["op"]](e["dims"], e.get("extra", {}),
+                                     e["cell"] + "_ab")
+            tw, td, compiles = _time_ab(
+                make(e["winner"]), make(default), iters=iters,
+                op=e["op"], cell=e["cell"] + "_ab",
+                cand_a=e["winner"], cand_b=default)
+            cell_r["ratio"] = round(td / tw, 4)
+            cell_r["tuned_s"] = round(tw, 6)
+            cell_r["default_s"] = round(td, 6)
+            out["post_warmup_compiles"] += compiles
+        out["cells"].append(cell_r)
+        log("  %s/%s [%s]: tuned/default ratio %.2fx" % (
+            e["op"], e["knob"], e["cell"], cell_r["ratio"]))
+    ratios = [c["ratio"] for c in out["cells"]]
+    if ratios:
+        out["min_ratio"] = min(ratios)
+        out["max_ratio"] = max(ratios)
+    return out
+
+
+def default_out_path(table):
+    from raft_tpu.core import tuning
+
+    return os.path.join(REPO, "raft_tpu", "tuning",
+                        tuning.fingerprint_slug(table["fingerprint"])
+                        + ".json")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--op", help="filter: op or knob name")
+    p.add_argument("--cell", help="filter: cell-name substring")
+    p.add_argument("--smoke", action="store_true",
+                   help="one tiny cell per op (seconds, not minutes)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="plan only: print cells x legal candidates")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--min-margin", type=float, default=MIN_MARGIN)
+    p.add_argument("--out", help="output path (default: "
+                   "raft_tpu/tuning/<fingerprint-slug>.json)")
+    args = p.parse_args(argv)
+
+    if args.dry_run:
+        from raft_tpu.core import tuning
+
+        for op, knob, cell_name, dims, extra in catalog(args.smoke):
+            if args.op and args.op not in (op, knob):
+                continue
+            if args.cell and args.cell not in cell_name:
+                continue
+            cands = tuning.legal_candidates(knob, purpose="sweep",
+                                            dtype="float32", **dims)
+            print("%s/%s cell=%s class=%s" % (
+                op, knob, cell_name, tuning.shape_class(dims)))
+            for c, why in cands:
+                print("    %-12s %s" % (c, "SWEEP" if why is None
+                                        else "skip: " + why))
+        return 0
+
+    table = run_sweep(smoke=args.smoke, op_filter=args.op,
+                      cell_filter=args.cell, iters=args.iters,
+                      min_margin=args.min_margin)
+    out = args.out or default_out_path(table)
+    if os.path.exists(out):
+        print("diff vs incumbent %s:" % out)
+        try:
+            with open(out, encoding="utf-8") as f:
+                diff_tables(json.load(f), table)
+        except (OSError, ValueError) as e:
+            print("  incumbent unreadable (%s); overwriting" % e)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("wrote %d entries -> %s" % (len(table["entries"]), out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,11 @@ def render_report(snap: dict) -> str:
         lines.append("== SLO burn & exemplars (docs/OBSERVABILITY.md "
                      "\"Flight recorder & request tracing\") ==")
         lines.extend(slo)
+    tuning = _tuning_summary(metrics)
+    if tuning:
+        lines.append("== tuning (docs/TUNING.md \"Bench-driven "
+                     "autotuning\") ==")
+        lines.extend(tuning)
     cc = snap.get("compile_cache", {})
     if cc:
         lines.append("== jit compile cache (per fn: shapes / hits / "
@@ -316,6 +321,55 @@ def _serve_traffic_summary(metrics: dict) -> list:
                 % (r, _fmt_s(p50), _fmt_s(p95), n)
                 for r, p50, p95, n in sorted(reps))))
     return lines
+
+
+def _tuning_summary(metrics: dict) -> list:
+    """Autotuner digest: the active table's fingerprint/source (live
+    process only), per-knob table-hit vs miss lookup counts, and the
+    tuned-vs-default margins the bench rung measured."""
+    out = []
+    # live table info — meaningful when rendering in-process (--demo /
+    # Session.metrics_snapshot callers); a snapshot file rendered
+    # elsewhere simply skips it
+    try:
+        from raft_tpu import config as _config
+
+        info = _config.tuning_table_info()
+    except Exception:
+        info = None
+    if info:
+        fp = info["fingerprint"]
+        out.append("  table %s  fingerprint=%s/%s/%d  cells=%d"
+                   % (info["source"], fp.get("platform"),
+                      fp.get("device_kind"),
+                      int(fp.get("device_count", 0)), info["cells"]))
+    lookups = metrics.get("raft_tpu_tuning_table_lookups_total", {})
+    by_knob = {}
+    for s in lookups.get("series", []):
+        lbl = s.get("labels", {})
+        knob = lbl.get("knob", "?")
+        d = by_knob.setdefault(knob,
+                               {"hit": 0, "miss": 0, "discarded": 0})
+        oc = lbl.get("outcome", "miss")
+        d[oc] = d.get(oc, 0) + s.get("value", 0)
+    for knob, d in sorted(by_knob.items()):
+        total = d["hit"] + d["miss"]
+        # effective coverage: a "discarded" answer (illegal for the
+        # real call ctx) actually resolved to the default
+        eff = d["hit"] - d["discarded"]
+        line = ("  %-20s lookups=%-7d from_table=%-7d pinned/"
+                "default=%d" % (knob, int(total), int(eff),
+                                int(d["miss"] + d["discarded"])))
+        if d["discarded"]:
+            line += "  (discarded=%d)" % int(d["discarded"])
+        out.append(line)
+    ratios = metrics.get("raft_tpu_tuning_tuned_vs_default_ratio", {})
+    for s in ratios.get("series", []):
+        lbl = s.get("labels", {})
+        out.append("  tuned_vs_default %-16s [%s] = %.2fx"
+                   % (lbl.get("op", "?"), lbl.get("cell", "?"),
+                      s.get("value", 0.0)))
+    return out
 
 
 def _serve_resilience_summary(metrics: dict) -> list:
