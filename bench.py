@@ -1391,6 +1391,161 @@ def _bench_serve_ann_ooc(index_rows, dim, k, duration, concurrency,
     return out
 
 
+def _bench_serve_ann_persist(index_rows, dim, k, duration, concurrency,
+                             nlist, train_rows, rows=16):
+    """Durability rung (docs/PERSISTENCE.md): the cost of durable
+    serving state, measured.  Two arms over ONE built IVF-Flat index,
+    each driving closed-loop queries plus a steady insert stream:
+
+    - **OFF** — the plain in-memory ANNService (the baseline);
+    - **ON** — ``persist_dir`` + WAL ``fsync="always"`` (every insert
+      durable before acknowledge) + periodic snapshots on the
+      maintenance seam.
+
+    ``persist_overhead_ok`` asserts the ON arm holds ≥ 70% of the OFF
+    arm's steady-state QPS (the query path shares nothing with the
+    WAL; the overhead is the insert fsyncs plus snapshot writes riding
+    the maintenance seam).  Two restore rows follow: snapshot-only
+    restore time (clean shutdown) and WAL-replay rate (simulated crash
+    with a 2048-row WAL tail and only the bootstrap snapshot)."""
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.serve.ann_service import ANNService
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+    from tools.loadgen import make_query_pool, run_load, synth_data
+
+    t_build = time.time()
+    ref = jnp.asarray(synth_data(index_rows, dim, seed=0, clusters=256))
+    index = ivf_flat_build(ref, IVFFlatParams(nlist=nlist, nprobe=8),
+                           train_rows=train_rows)
+    build_s = time.time() - t_build
+    mbr = 128
+    svc_opts = dict(max_batch_rows=mbr, bucket_rungs=(8, 32, 64, mbr),
+                    max_wait_ms=2.0, queue_cap=4096,
+                    nprobe_ladder=(4, 8), nprobe=8,
+                    select_impl="approx", delta_cap=8192,
+                    compact_rows=0)
+    pool = make_query_pool(ref, rows, n=8, seed=1)
+
+    def run_arm(persist_dir, dur):
+        kw = dict(svc_opts)
+        if persist_dir is not None:
+            kw.update(persist_dir=persist_dir, persist_fsync="always",
+                      snapshot_interval_s=max(1.0, dur / 3))
+        svc = ANNService(index, k=k, **kw)
+        svc.loadgen_ref = ref
+        t0 = time.time()
+        svc.warmup()
+        warm = time.time() - t0
+        stop = _threading.Event()
+        inserted = {"n": 0}
+        rng = np.random.default_rng(7)
+
+        def inserter():
+            base = 10_000_000
+            while not stop.is_set():
+                ids = np.arange(base + inserted["n"],
+                                base + inserted["n"] + 16)
+                try:
+                    svc.insert(ids, rng.standard_normal(
+                        (16, dim)).astype(np.float32))
+                    inserted["n"] += 16
+                except RaftError:
+                    pass  # a full delta sheds in both arms alike
+                time.sleep(0.01)
+
+        th = _threading.Thread(target=inserter, daemon=True)
+        th.start()
+        persist_stats = None
+        try:
+            rep = run_load(svc, mode="closed", duration=dur,
+                           concurrency=concurrency, rows=rows,
+                           query_pool=pool)
+        finally:
+            stop.set()
+            th.join(timeout=10.0)
+            if persist_dir is not None:
+                persist_stats = svc.stats().get("persist")
+            svc.close()    # the ON arm's clean-shutdown final snapshot
+        rep["warmup_s"] = round(warm, 2)
+        rep["inserted_rows"] = inserted["n"]
+        rep["persist"] = persist_stats
+        return rep
+
+    off = run_arm(None, duration)
+    pdir = tempfile.mkdtemp(prefix="raft_tpu_bench_persist_")
+    pdir2 = tempfile.mkdtemp(prefix="raft_tpu_bench_persist_wal_")
+    try:
+        on = run_arm(pdir, duration)
+        # restore row 1: snapshot-only restore (the clean shutdown
+        # above left an empty WAL — restart never pays replay)
+        t0 = time.time()
+        svc_r = ANNService(None, k=k,
+                           **dict(svc_opts, persist_dir=pdir))
+        restore_snapshot_s = time.time() - t0
+        r_stats = svc_r._persist.stats()
+        svc_r.close(snapshot=False)
+        # restore row 2: WAL-replay rate — bootstrap snapshot only,
+        # 2048 acknowledged rows living in the WAL, simulated crash
+        svc_w = ANNService(index, k=k,
+                           **dict(svc_opts, persist_dir=pdir2,
+                                  persist_fsync="always",
+                                  snapshot_interval_s=1e9))
+        rngw = np.random.default_rng(11)
+        wal_rows = 0
+        for _ in range(16):
+            ids = np.arange(20_000_000 + wal_rows,
+                            20_000_000 + wal_rows + 128)
+            svc_w.insert(ids, rngw.standard_normal(
+                (128, dim)).astype(np.float32))
+            wal_rows += 128
+        svc_w.close(snapshot=False)
+        t0 = time.time()
+        svc_w2 = ANNService(None, k=k,
+                            **dict(svc_opts, persist_dir=pdir2))
+        restore_replay_s = time.time() - t0
+        replayed = svc_w2._persist.stats()["replayed_records"]
+        svc_w2.close(snapshot=False)
+    finally:
+        shutil.rmtree(pdir, ignore_errors=True)
+        shutil.rmtree(pdir2, ignore_errors=True)
+    ratio = on["qps"] / max(off["qps"], 1e-9)
+    return {
+        "query_qps_on": on["query_qps"],
+        "query_qps_off": off["query_qps"],
+        "qps_on": on["qps"],
+        "qps_off": off["qps"],
+        "persist_overhead_ratio": round(ratio, 3),
+        "persist_overhead_ok": ratio >= 0.7,
+        "p99_ms_on": on["p99_ms"],
+        "p99_ms_off": off["p99_ms"],
+        "inserted_rows_on": on["inserted_rows"],
+        "inserted_rows_off": off["inserted_rows"],
+        "snapshots_taken": (on["persist"] or {}).get("snapshot_seq"),
+        "snapshot_bytes": (on["persist"] or {}).get("snapshot_bytes"),
+        "restore_snapshot_s": round(restore_snapshot_s, 3),
+        "restored_snapshot_seq": r_stats["snapshot_seq"],
+        "restore_replay_s": round(restore_replay_s, 3),
+        "wal_replay_rows": wal_rows,
+        "wal_replay_records": replayed,
+        "wal_replay_rows_per_s": round(
+            wal_rows / max(restore_replay_s, 1e-9), 1),
+        "post_warmup_compiles_on": on["post_warmup_compiles"],
+        "build_s": round(build_s, 2),
+        "config": {"index_rows": index_rows, "dim": dim, "k": k,
+                   "nlist": nlist, "train_rows": train_rows,
+                   "concurrency": concurrency,
+                   "rows_per_request": rows, "fsync": "always",
+                   "max_batch_rows": mbr},
+    }
+
+
 def _bench_comms_p2p(rows, dim, iters):
     """Tagged-p2p staging A/B (docs/ZERO_COPY.md): one full ring
     (every rank sends a (rows, dim) f32 block to its neighbor) per
@@ -1888,6 +2043,13 @@ def child_main():
              lambda: _bench_serve_ann(1_000_000, 128, 100, 4.0, 12,
                                       nlist=2048, train_rows=65536,
                                       target_recall=0.9, state=state)),
+            # durability cost + recovery speed: WAL + periodic
+            # snapshots ON vs OFF at a scaled shape, plus restore-time
+            # and WAL-replay-rate rows (docs/PERSISTENCE.md)
+            ("serve_ann_persist", 200,
+             lambda: _bench_serve_ann_persist(200_000, 64, 10, 3.0, 6,
+                                              nlist=512,
+                                              train_rows=65536)),
             # the out-of-core tier at the same 1M x 128 scale: device
             # budget = 1/4 of the slot store (~4x oversubscription),
             # recall must EQUAL the resident arm, and the double-
@@ -2007,6 +2169,14 @@ def child_main():
              lambda: _bench_serve_ann(1_000_000, 128, 100, 5.0, 16,
                                       nlist=1024, train_rows=131072,
                                       target_recall=0.9, state=state)),
+            # durability cost + recovery speed at hardware scale:
+            # WAL-fsync'd inserts + periodic snapshots ON vs OFF,
+            # restore-time and WAL-replay-rate rows
+            # (docs/PERSISTENCE.md)
+            ("serve_ann_persist", 200,
+             lambda: _bench_serve_ann_persist(500_000, 64, 10, 4.0, 8,
+                                              nlist=1024,
+                                              train_rows=131072)),
             # out-of-core tier on hardware: index bigger than the
             # budget by 4x, host-streamed tiles double-buffered against
             # the scans — where H2D is a real interconnect, the

@@ -156,6 +156,19 @@ METRIC_DOC_MARKER = "metric-doc-ok"
 METRIC_NAME_RE = re.compile(r"^raft_tpu_[a-z0-9_]+$")
 METRIC_CALL_HINTS = ("counter", "gauge", "timer", "labeled")
 
+# serialization ban (raft_tpu/ wide): persisted state goes through the
+# checksummed manifest path (raft_tpu/persist, docs/PERSISTENCE.md) —
+# never pickle (arbitrary code execution on load, zero integrity
+# checking) and never numpy's .npy containers (``np.save`` /
+# ``np.load(allow_pickle=True)`` can embed pickles and bypass the
+# manifest CRCs entirely).  Plain ``np.load`` without allow_pickle
+# stays legal (it cannot execute code).  A deliberate site marks its
+# line `persist-io-ok` — the persist module's raw-array writer is the
+# intended serializer and needs no marker (it uses tobytes/frombuffer).
+PERSIST_IO_MARKER = "persist-io-ok"
+PICKLE_MODULES = ("pickle", "cPickle", "_pickle", "dill", "cloudpickle")
+NP_SAVE_ATTRS = ("save", "savez", "savez_compressed")
+
 # tuning-registry drift lint: every config._KNOBS entry with a non-None
 # choices whitelist is a registry-owned impl knob and MUST have a
 # register(...) entry in raft_tpu/core/tuning.py (the sweep's search
@@ -368,6 +381,7 @@ def check_file(path, doc_text=None, repo_root=None):
     in_comms_np_scope = (rel.startswith(COMMS_NP_DIR)
                          and rel not in COMMS_NP_ALLOWLIST)
     in_serve_exc_scope = rel.startswith(SERVE_EXC_DIR)
+    in_serial_scope = rel.startswith("raft_tpu" + os.sep)
     in_mnmg_jit_scope = rel in MNMG_JIT_FILES
     in_ooc_put_scope = rel in OOC_PUT_FILES
     in_tune_scope = (rel.startswith("raft_tpu" + os.sep)
@@ -451,6 +465,56 @@ def check_file(path, doc_text=None, repo_root=None):
                 "count it (.inc/.observe/record_failure), re-raise, "
                 f"or mark the audited line `{SERVE_EXC_MARKER}` "
                 "(docs/FAULT_MODEL.md)")
+        if in_serial_scope:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        numpy_aliases.add(a.asname or "numpy")
+                    if (a.name.split(".")[0] in PICKLE_MODULES
+                            and PERSIST_IO_MARKER
+                            not in src_lines[node.lineno - 1]):
+                        problems.append(
+                            f"{rel}:{node.lineno}: import of {a.name} "
+                            "— persisted state goes through the "
+                            "checksummed manifest path "
+                            "(raft_tpu/persist, docs/PERSISTENCE.md), "
+                            "never pickle; mark a deliberate site "
+                            f"`{PERSIST_IO_MARKER}`")
+            elif (isinstance(node, ast.ImportFrom) and node.module
+                    and node.module.split(".")[0] in PICKLE_MODULES
+                    and PERSIST_IO_MARKER
+                    not in src_lines[node.lineno - 1]):
+                problems.append(
+                    f"{rel}:{node.lineno}: from-import of "
+                    f"{node.module} — persisted state goes through "
+                    "the checksummed manifest path "
+                    "(raft_tpu/persist, docs/PERSISTENCE.md), never "
+                    "pickle; mark a deliberate site "
+                    f"`{PERSIST_IO_MARKER}`")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in numpy_aliases
+                    and PERSIST_IO_MARKER
+                    not in src_lines[node.lineno - 1]):
+                if node.func.attr in NP_SAVE_ATTRS:
+                    problems.append(
+                        f"{rel}:{node.lineno}: np.{node.func.attr}() "
+                        "— .npy/.npz containers bypass the "
+                        "checksummed manifest path (raft_tpu/persist,"
+                        " docs/PERSISTENCE.md); mark a deliberate "
+                        f"site `{PERSIST_IO_MARKER}`")
+                elif node.func.attr == "load" and any(
+                        kw.arg == "allow_pickle"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in node.keywords):
+                    problems.append(
+                        f"{rel}:{node.lineno}: np.load(allow_pickle="
+                        "True) — a pickle-bearing load can execute "
+                        "code and bypasses the manifest CRCs "
+                        "(docs/PERSISTENCE.md); mark a deliberate "
+                        f"site `{PERSIST_IO_MARKER}`")
         if in_thread_scope:
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -633,6 +697,45 @@ def selftest():
     print("metric-doc lint selftest: %d fixtures, %d failures"
           % (len(cases), failures), file=sys.stderr)
     failures += _selftest_tuning()
+    failures += _selftest_persist_io()
+    return failures
+
+
+def _selftest_persist_io():
+    """Executable fixtures for the serialization ban: pickle imports
+    and .npy-container writes are flagged, pickle-free numpy load
+    passes, the ``persist-io-ok`` marker escapes."""
+    import tempfile
+
+    cases = [
+        ("import pickle\n", True),
+        ("import cloudpickle as cp\n", True),
+        ("from pickle import loads\n", True),
+        ("import pickle  # persist-io-ok: fixture\n", False),
+        ("import numpy as np\nnp.save('x.npy', a)\n", True),
+        ("import numpy as np\nnp.savez('x.npz', a=a)\n", True),
+        ("import numpy as np\n"
+         "np.load('x.npy', allow_pickle=True)\n", True),
+        ("import numpy as np\nnp.load('x.npy')\n", False),
+        ("import numpy as np\n"
+         "np.save('x.npy', a)  # persist-io-ok: fixture\n", False),
+    ]
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        fixdir = os.path.join(tmp, "raft_tpu")
+        os.makedirs(fixdir)
+        for i, (src, expect) in enumerate(cases):
+            path = os.path.join(fixdir, "serfix%d.py" % i)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(src)
+            probs = [p for p in check_file(path, repo_root=tmp)
+                     if PERSIST_IO_MARKER in p]
+            if bool(probs) != expect:
+                failures += 1
+                print("persist-io fixture %d: expected flagged=%s, "
+                      "got %r" % (i, expect, probs), file=sys.stderr)
+    print("persist-io lint selftest: %d fixtures, %d failures"
+          % (len(cases), failures), file=sys.stderr)
     return failures
 
 

@@ -178,6 +178,23 @@ serve_slo_windows_s
     Comma-separated burn-rate window lengths in seconds (multi-window
     alerting: the short window catches a fast burn, the long one a
     slow leak).  Free-form list.
+persist_fsync
+    Write-ahead-log durability policy for persistent services
+    (:mod:`raft_tpu.persist`; docs/PERSISTENCE.md): ``always`` fsyncs
+    before every insert acknowledge (no acknowledged loss, ever),
+    ``batch`` defers the fsync to the next maintenance tick (bounded
+    loss window, much cheaper), ``off`` leaves durability to the OS
+    page cache.  Free-form (validated by the persist layer at
+    construction); runtime-resolved.
+persist_snapshot_interval_s
+    Minimum seconds between interval-driven snapshots of a dirty
+    serving state (taken on the serve worker's maintenance seam from
+    the immutable ``_AnnState`` — never mid-batch).  Free-form float;
+    runtime-resolved.
+persist_scrub_chunks
+    Integrity-scrub units (snapshot chunks / out-of-core host-store
+    slots) re-checksummed per maintenance tick; ``0`` disables the
+    background scrubber.  Free-form int; runtime-resolved.
 """
 
 from __future__ import annotations
@@ -240,6 +257,10 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "serve_hedge_factor": ("RAFT_TPU_SERVE_HEDGE_FACTOR", "1.5", None),
     "serve_hedge_min_ms": ("RAFT_TPU_SERVE_HEDGE_MIN_MS", "10", None),
     "flight_events": ("RAFT_TPU_FLIGHT_EVENTS", "4096", None),
+    "persist_fsync": ("RAFT_TPU_PERSIST_FSYNC", "always", None),
+    "persist_snapshot_interval_s": (
+        "RAFT_TPU_PERSIST_SNAPSHOT_INTERVAL_S", "30", None),
+    "persist_scrub_chunks": ("RAFT_TPU_PERSIST_SCRUB_CHUNKS", "4", None),
     "serve_slo_target_ms": ("RAFT_TPU_SERVE_SLO_TARGET_MS", "100", None),
     "serve_slo_objective": ("RAFT_TPU_SERVE_SLO_OBJECTIVE",
                             "0.99", None),
@@ -260,7 +281,8 @@ _RUNTIME_KNOBS = frozenset(
      "serve_ann_degrade_frac", "serve_tenant_weights",
      "serve_hedge_ms", "serve_hedge_factor", "serve_hedge_min_ms",
      "flight_events", "serve_slo_target_ms", "serve_slo_objective",
-     "serve_slo_windows_s"))
+     "serve_slo_windows_s", "persist_fsync",
+     "persist_snapshot_interval_s", "persist_scrub_chunks"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

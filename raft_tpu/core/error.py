@@ -132,6 +132,47 @@ class ServiceUnavailableError(RaftError):
             % (message, self.service, self.reason, self.retry_after_s))
 
 
+class DataCorruptionError(RaftError):
+    """Persisted serving state failed an integrity check
+    (:mod:`raft_tpu.persist`): a snapshot manifest, array payload, or
+    interior write-ahead-log record whose stored checksum does not
+    match its bytes (docs/PERSISTENCE.md).  Never retried and never
+    tolerated silently — a corrupt region must fail loudly rather than
+    serve wrong distances.  (A *torn trailing* WAL record — an append
+    cut short by the crash itself — is the one tolerated case and does
+    not raise; see the WAL replay contract.)
+
+    Attributes
+    ----------
+    path:
+        File holding the corrupt region.
+    offset:
+        Byte offset of the failing region within ``path`` (None when
+        the whole file is the unit, e.g. a manifest).
+    expected_crc / actual_crc:
+        The stored checksum vs the checksum of the bytes actually read
+        (None when the failure precedes checksumming, e.g. a bad
+        record magic or unparseable manifest).
+    """
+
+    def __init__(self, message: str, path: str,
+                 offset: "int | None" = None,
+                 expected_crc: "int | None" = None,
+                 actual_crc: "int | None" = None):
+        self.path = str(path)
+        self.offset = None if offset is None else int(offset)
+        self.expected_crc = (None if expected_crc is None
+                             else int(expected_crc))
+        self.actual_crc = None if actual_crc is None else int(actual_crc)
+        where = self.path if self.offset is None else (
+            "%s @ byte %d" % (self.path, self.offset))
+        crcs = ("" if self.expected_crc is None
+                else " expected_crc=0x%08x actual_crc=0x%08x"
+                % (self.expected_crc,
+                   0 if self.actual_crc is None else self.actual_crc))
+        super().__init__("%s (%s%s)" % (message, where, crcs))
+
+
 class CommError(RaftError):
     """Communicator failure (analog of the reference's NCCL/UCX error
     surfacing: ``RAFT_NCCL_TRY`` / the ERROR arm of ``status_t``,

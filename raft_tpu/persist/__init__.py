@@ -1,0 +1,50 @@
+"""Durable serving state (docs/PERSISTENCE.md).
+
+The one failure domain the self-healing serving stack cannot reach is
+process death: a built index, the out-of-core host store, and every
+acknowledged streaming insert live only in memory.  This package makes
+them durable:
+
+- :mod:`~raft_tpu.persist.snapshot` — versioned, manifest-driven,
+  per-chunk CRC-checksummed serialization of the IVF indexes and the
+  out-of-core slot store (raw little-endian arrays + JSON manifest,
+  **no pickle** — ``ci/style_check.py`` bans it library-wide), written
+  atomically (tmp + fsync + rename) and loaded with every checksum
+  verified (OOC store optionally ``np.memmap``-backed, never touching
+  device);
+- :mod:`~raft_tpu.persist.wal` — the write-ahead log
+  ``ANNService.insert`` appends (checksummed records, fsync policy
+  knob) before acknowledging, replayed on restart with a
+  tolerated-torn-tail / loud-interior-corruption contract
+  (:class:`~raft_tpu.core.error.DataCorruptionError`);
+- :mod:`~raft_tpu.persist.manager` — :class:`PersistManager`: the
+  per-service authority gluing both into the serve worker's
+  maintenance seam (interval snapshots that never tear a batch, WAL
+  truncation, crash-restart restore, incremental integrity scrubbing
+  with quarantine-and-rebuild of poisoned host-store slots).
+
+Entry point for services: ``ANNService(persist_dir=...)`` — see
+docs/PERSISTENCE.md for the format, the fsync/acknowledge contract,
+the restore sequence, and the scrub policy.
+"""
+
+from raft_tpu.persist.manager import (  # noqa: F401
+    PersistManager,
+    RestoredState,
+)
+from raft_tpu.persist.snapshot import (  # noqa: F401
+    current_manifest,
+    load_current,
+    write_snapshot,
+)
+from raft_tpu.persist.wal import (  # noqa: F401
+    FSYNC_POLICIES,
+    WriteAheadLog,
+    replay_wal,
+)
+
+__all__ = [
+    "PersistManager", "RestoredState",
+    "write_snapshot", "load_current", "current_manifest",
+    "WriteAheadLog", "replay_wal", "FSYNC_POLICIES",
+]

@@ -1,0 +1,448 @@
+"""Versioned, manifest-driven, CRC-checksummed index snapshots.
+
+The serialization half of the durability subsystem
+(docs/PERSISTENCE.md).  One snapshot is a directory of **raw
+little-endian array files** plus a JSON ``MANIFEST.json`` describing
+them — dtype, shape, and a per-chunk CRC32 list per array — written
+**atomically**: arrays and manifest land in a hidden temp directory,
+every file is fsynced, the directory is renamed into place, and only
+then does the ``CURRENT`` pointer file (itself written tmp + fsync +
+rename) name it.  A crash at any point leaves either the old snapshot
+or the new one fully intact, never a half-written hybrid; stray temp
+directories are garbage, ignored by the loader and swept by the next
+writer.
+
+No pickle, anywhere (``ci/style_check.py`` bans it across
+``raft_tpu/``): every array round-trips as raw C-order little-endian
+bytes through the checksummed manifest path, so a snapshot can never
+execute code on load and every region of it is integrity-checked.
+
+Per-chunk checksums (default 1 MiB; the out-of-core slot store is
+chunked **per slot** so a chunk index IS a slot id) buy two things: a
+corruption error names the failing byte offset, not just the file, and
+the integrity scrubber (:mod:`raft_tpu.persist.manager`) can re-verify
+the snapshot incrementally — a few chunks per maintenance tick —
+without ever re-reading whole files on the serving thread.
+
+Load reconstructs the exact index object that was saved (IVF-Flat /
+PQ / SQ, or the out-of-core :class:`~raft_tpu.spatial.ooc.OocIVFFlat`
+whose bulk ``store`` stays **host-side numpy** — optionally
+``np.memmap``-backed, mode ``"c"`` so scrub repairs stay in memory).
+Every chunk's CRC is verified during load; any mismatch raises a typed
+:class:`~raft_tpu.core.error.DataCorruptionError` naming file, offset,
+and expected-vs-actual checksum.  The loader never calls
+``jax.device_put`` (the out-of-core style ban extends to this module):
+resident metadata re-enters JAX through ``jnp.asarray`` exactly like a
+fresh build, and the OOC store never touches the device at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import DataCorruptionError, expects
+from raft_tpu.distance.distance_type import DistanceType
+
+SNAPSHOT_FORMAT = "raft_tpu-snapshot"
+SNAPSHOT_VERSION = 1
+DEFAULT_CHUNK_BYTES = 1 << 20
+MANIFEST_NAME = "MANIFEST.json"
+CURRENT_NAME = "CURRENT"
+SNAPSHOTS_DIR = "snapshots"
+
+__all__ = ["write_snapshot", "load_current", "current_manifest",
+           "snapshot_dir", "SNAPSHOT_VERSION"]
+
+
+def _fsync_file(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record directory-entry changes (the rename)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without O_RDONLY dirs: best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _as_le(arr) -> np.ndarray:
+    """Host C-order little-endian view/copy of any array input."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    if a.dtype.byteorder == ">":
+        a = a.astype(a.dtype.newbyteorder("<"))
+    return a
+
+
+def snapshot_dir(root: str, name: str) -> str:
+    return os.path.join(root, SNAPSHOTS_DIR, name)
+
+
+# --------------------------------------------------------------------- #
+# array codec
+# --------------------------------------------------------------------- #
+def _write_array(dirpath: str, name: str, arr,
+                 chunk_bytes: int) -> Dict:
+    """Stream one array to ``<name>.bin`` computing per-chunk CRC32s;
+    returns its manifest entry.  Chunks are sliced from a flat byte
+    view, never a ``tobytes()`` copy — snapshotting a host store near
+    RAM capacity (the out-of-core tier's whole point) must not double
+    its footprint."""
+    a = _as_le(arr)
+    fname = "%s.bin" % name
+    crcs = []
+    nbytes = int(a.nbytes)
+    view = memoryview(a).cast("B") if nbytes else memoryview(b"")
+    with open(os.path.join(dirpath, fname), "wb") as f:
+        for off in range(0, max(nbytes, 1), chunk_bytes):
+            chunk = view[off:off + chunk_bytes]
+            crcs.append(zlib.crc32(chunk) & 0xFFFFFFFF)
+            f.write(chunk)
+        _fsync_file(f)
+    return {"name": name, "file": fname, "dtype": a.dtype.str,
+            "shape": list(a.shape), "nbytes": nbytes,
+            "chunk_bytes": int(chunk_bytes), "crc32s": crcs}
+
+
+def _verify_file_chunks(path: str, entry: Dict, *,
+                        accumulate: bool = True) -> Optional[bytes]:
+    """Read ``path`` verifying every chunk CRC; returns the raw bytes
+    (or None with ``accumulate=False`` — the mmap arm verifies
+    streaming-only so a huge store never materializes in memory).
+    Any mismatch (or a short file) is typed corruption."""
+    chunk_bytes = int(entry["chunk_bytes"])
+    crcs = entry["crc32s"]
+    nbytes = int(entry["nbytes"])
+    out = bytearray() if accumulate else None
+    read_total = 0
+    with open(path, "rb") as f:
+        for i, expected in enumerate(crcs):
+            want = min(chunk_bytes, max(nbytes - i * chunk_bytes, 0))
+            chunk = f.read(chunk_bytes if i < len(crcs) - 1 else want)
+            actual = zlib.crc32(chunk) & 0xFFFFFFFF
+            if actual != expected or (i < len(crcs) - 1
+                                      and len(chunk) < chunk_bytes):
+                raise DataCorruptionError(
+                    "snapshot array %r failed its chunk checksum"
+                    % entry["name"], path, offset=i * chunk_bytes,
+                    expected_crc=expected, actual_crc=actual)
+            read_total += len(chunk)
+            if out is not None:
+                out += chunk
+    if read_total != nbytes:
+        raise DataCorruptionError(
+            "snapshot array %r is %d bytes, manifest says %d"
+            % (entry["name"], read_total, nbytes), path,
+            offset=read_total)
+    return bytes(out) if out is not None else None
+
+
+def _read_array(dirpath: str, entry: Dict, *,
+                mmap: bool = False) -> np.ndarray:
+    path = os.path.join(dirpath, entry["file"])
+    dtype = np.dtype(entry["dtype"])
+    shape = tuple(entry["shape"])
+    # verification always streams the file (a corrupt store must fail
+    # at load, not at first scan); the mmap arm verifies CRC-only —
+    # no accumulation, so a huge store never materializes — and keeps
+    # the map as the DATA source: lazily paged + copy-on-write (scrub
+    # repairs mutate memory, never the snapshot file)
+    data = _verify_file_chunks(path, entry, accumulate=not mmap)
+    if mmap:
+        if not shape or 0 in shape:
+            return np.zeros(shape, dtype)
+        return np.memmap(path, dtype=dtype, mode="c", shape=shape)
+    if not data:
+        return np.zeros(shape, dtype)
+    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+
+# --------------------------------------------------------------------- #
+# index kind registry
+# --------------------------------------------------------------------- #
+def _kind_of(index) -> str:
+    return type(index).__name__
+
+
+def _flat_fields(index):
+    arrays = {"centroids": index.centroids, "slot_vecs": index.slot_vecs,
+              "slot_ids": index.slot_ids,
+              "slot_centroid": index.slot_centroid,
+              "cent_slots": index.cent_slots,
+              "list_sizes": index.list_sizes}
+    if index.slot_norms is not None:
+        arrays["slot_norms"] = index.slot_norms
+    return arrays, {"metric": int(index.metric),
+                    "nprobe": int(index.nprobe)}
+
+
+def _pq_fields(index):
+    arrays = {"centroids": index.centroids, "codebooks": index.codebooks,
+              "slot_codes": index.slot_codes, "slot_ids": index.slot_ids,
+              "slot_centroid": index.slot_centroid,
+              "cent_slots": index.cent_slots,
+              "list_sizes": index.list_sizes}
+    if index.vectors is not None:
+        arrays["vectors"] = index.vectors
+    return arrays, {"metric": int(index.metric),
+                    "nprobe": int(index.nprobe),
+                    "refine_ratio": int(index.refine_ratio)}
+
+
+def _sq_fields(index):
+    arrays = {"centroids": index.centroids, "slot_q": index.slot_q,
+              "scale": index.scale, "offset": index.offset,
+              "slot_ids": index.slot_ids,
+              "slot_centroid": index.slot_centroid,
+              "cent_slots": index.cent_slots,
+              "list_sizes": index.list_sizes}
+    return arrays, {"metric": int(index.metric),
+                    "nprobe": int(index.nprobe),
+                    "encode_residual": bool(index.encode_residual)}
+
+
+def _ooc_fields(index):
+    arrays = {"centroids": index.centroids, "slot_ids": index.slot_ids,
+              "slot_norms": index.slot_norms,
+              "cent_slots": index.cent_slots,
+              "slot_centroid": index.slot_centroid,
+              "list_sizes": index.list_sizes, "store": index.store}
+    return arrays, {"metric": int(index.metric),
+                    "nprobe": int(index.nprobe)}
+
+
+_FIELDS = {"IVFFlatIndex": _flat_fields, "IVFPQIndex": _pq_fields,
+           "IVFSQIndex": _sq_fields, "OocIVFFlat": _ooc_fields}
+
+
+def _rebuild_flat(a, meta):
+    from raft_tpu.spatial.ann import IVFFlatIndex
+
+    norms = a.get("slot_norms")
+    return IVFFlatIndex(
+        jnp.asarray(a["centroids"]), jnp.asarray(a["slot_vecs"]),
+        jnp.asarray(a["slot_ids"]), jnp.asarray(a["slot_centroid"]),
+        jnp.asarray(a["cent_slots"]), jnp.asarray(a["list_sizes"]),
+        DistanceType(int(meta["metric"])), int(meta["nprobe"]),
+        slot_norms=None if norms is None else jnp.asarray(norms))
+
+
+def _rebuild_pq(a, meta):
+    from raft_tpu.spatial.ann import IVFPQIndex
+
+    vecs = a.get("vectors")
+    return IVFPQIndex(
+        jnp.asarray(a["centroids"]), jnp.asarray(a["codebooks"]),
+        jnp.asarray(a["slot_codes"]), jnp.asarray(a["slot_ids"]),
+        jnp.asarray(a["slot_centroid"]), jnp.asarray(a["cent_slots"]),
+        jnp.asarray(a["list_sizes"]),
+        DistanceType(int(meta["metric"])), int(meta["nprobe"]),
+        vectors=None if vecs is None else jnp.asarray(vecs),
+        refine_ratio=int(meta.get("refine_ratio", 1)))
+
+
+def _rebuild_sq(a, meta):
+    from raft_tpu.spatial.ann import IVFSQIndex
+
+    return IVFSQIndex(
+        jnp.asarray(a["centroids"]), jnp.asarray(a["slot_q"]),
+        jnp.asarray(a["scale"]), jnp.asarray(a["offset"]),
+        jnp.asarray(a["slot_ids"]), jnp.asarray(a["slot_centroid"]),
+        jnp.asarray(a["cent_slots"]), jnp.asarray(a["list_sizes"]),
+        DistanceType(int(meta["metric"])), int(meta["nprobe"]),
+        bool(meta["encode_residual"]))
+
+
+def _rebuild_ooc(a, meta):
+    from raft_tpu.spatial.ooc import OocIVFFlat
+
+    # the store STAYS host numpy (memmap-backed when the loader was
+    # asked to) — only the small metadata re-enters JAX; the full
+    # index never lands on device (docs/ZERO_COPY.md §6)
+    return OocIVFFlat(
+        jnp.asarray(a["centroids"]), jnp.asarray(a["slot_ids"]),
+        jnp.asarray(a["slot_norms"]), jnp.asarray(a["cent_slots"]),
+        np.asarray(a["slot_centroid"], np.int32),
+        jnp.asarray(a["list_sizes"]),
+        DistanceType(int(meta["metric"])), int(meta["nprobe"]),
+        a["store"])
+
+
+_REBUILD = {"IVFFlatIndex": _rebuild_flat, "IVFPQIndex": _rebuild_pq,
+            "IVFSQIndex": _rebuild_sq, "OocIVFFlat": _rebuild_ooc}
+
+
+# --------------------------------------------------------------------- #
+# write
+# --------------------------------------------------------------------- #
+def write_snapshot(root: str, index, *, seq: int, wal_seq: int,
+                   delta: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                   chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> Dict:
+    """Write one atomic snapshot of ``index`` (+ the live delta rows)
+    under ``root`` and flip ``CURRENT`` to it; returns the manifest.
+
+    ``wal_seq`` is the last write-ahead-log sequence number whose
+    insert is *contained* in this snapshot's state — restart replays
+    only records beyond it.  ``delta=(vecs, ids)`` are the delta
+    segment's live rows (host arrays, already sliced to the fill
+    count).  Older snapshot directories are swept after the flip.
+    """
+    kind = _kind_of(index)
+    expects(kind in _FIELDS,
+            "write_snapshot: unsupported index kind %s", kind)
+    arrays, meta = _FIELDS[kind](index)
+    name = "snapshot-%010d" % int(seq)
+    snaps = os.path.join(root, SNAPSHOTS_DIR)
+    os.makedirs(snaps, exist_ok=True)
+    tmp = os.path.join(snaps, ".tmp-%s" % name)
+    if os.path.isdir(tmp):  # stale garbage from a crashed writer
+        _rmtree(tmp)
+    os.makedirs(tmp)
+    entries = []
+    total = 0
+    for aname, arr in arrays.items():
+        cb = chunk_bytes
+        if kind == "OocIVFFlat" and aname == "store":
+            # chunk the bulk store PER SLOT: a chunk index is a slot
+            # id, which is what lets the scrubber verify and rebuild
+            # individual slots (docs/PERSISTENCE.md "Scrubbing")
+            st = np.asarray(arr)
+            cb = max(int(st.shape[1]) * int(st.shape[2])
+                     * st.dtype.itemsize, 1)
+        e = _write_array(tmp, aname, arr, cb)
+        entries.append(e)
+        total += e["nbytes"]
+    delta_rows = 0
+    if delta is not None and delta[0].shape[0]:
+        dvecs, dids = delta
+        delta_rows = int(dvecs.shape[0])
+        for aname, arr in (("delta_vecs", dvecs), ("delta_ids", dids)):
+            e = _write_array(tmp, aname, arr, chunk_bytes)
+            entries.append(e)
+            total += e["nbytes"]
+    manifest = {"format": SNAPSHOT_FORMAT, "version": SNAPSHOT_VERSION,
+                "kind": kind, "seq": int(seq), "wal_seq": int(wal_seq),
+                "meta": meta, "delta_rows": delta_rows,
+                "total_bytes": total, "arrays": entries}
+    mbytes = json.dumps(manifest, indent=1, sort_keys=True).encode()
+    with open(os.path.join(tmp, MANIFEST_NAME), "wb") as f:
+        f.write(mbytes)
+        _fsync_file(f)
+    _fsync_dir(tmp)
+    final = os.path.join(snaps, name)
+    if os.path.isdir(final):
+        # orphan from a crash between a previous writer's directory
+        # rename and its CURRENT flip: CURRENT still names the older
+        # snapshot, so this seq was re-issued — the orphan is garbage
+        # and rename(2) cannot replace a non-empty directory
+        _rmtree(final)
+    os.replace(tmp, final)
+    _fsync_dir(snaps)
+    # flip CURRENT (tmp + fsync + rename): its manifest CRC is what
+    # lets the loader detect a tampered/corrupt manifest
+    cur_tmp = os.path.join(root, CURRENT_NAME + ".tmp")
+    with open(cur_tmp, "w", encoding="utf-8") as f:
+        f.write("%s %d\n" % (name, zlib.crc32(mbytes) & 0xFFFFFFFF))
+        _fsync_file(f)
+    os.replace(cur_tmp, os.path.join(root, CURRENT_NAME))
+    _fsync_dir(root)
+    # sweep superseded snapshots (and crashed writers' temp dirs)
+    for other in os.listdir(snaps):
+        if other != name:
+            _rmtree(os.path.join(snaps, other))
+    return manifest
+
+
+def _rmtree(path: str) -> None:
+    try:
+        for fname in os.listdir(path):
+            os.unlink(os.path.join(path, fname))
+        os.rmdir(path)
+    except OSError:
+        pass  # sweep is best-effort; a leftover dir is inert
+
+
+# --------------------------------------------------------------------- #
+# load
+# --------------------------------------------------------------------- #
+def _read_current(root: str):
+    cur = os.path.join(root, CURRENT_NAME)
+    if not os.path.isfile(cur):
+        return None
+    with open(cur, encoding="utf-8") as f:
+        line = f.read().strip()
+    parts = line.split()
+    if len(parts) != 2 or not parts[1].isdigit():
+        raise DataCorruptionError(
+            "CURRENT pointer is unparseable: %r" % line, cur)
+    return parts[0], int(parts[1])
+
+
+def current_manifest(root: str) -> Optional[Dict]:
+    """Read + verify the CURRENT snapshot's manifest (no array IO);
+    None when the directory holds no snapshot."""
+    cur = _read_current(root)
+    if cur is None:
+        return None
+    name, crc = cur
+    mpath = os.path.join(snapshot_dir(root, name), MANIFEST_NAME)
+    try:
+        with open(mpath, "rb") as f:
+            mbytes = f.read()
+    except OSError:
+        raise DataCorruptionError(
+            "CURRENT names snapshot %s but its manifest is unreadable"
+            % name, mpath) from None
+    actual = zlib.crc32(mbytes) & 0xFFFFFFFF
+    if actual != crc:
+        raise DataCorruptionError(
+            "snapshot manifest failed its checksum", mpath, offset=0,
+            expected_crc=crc, actual_crc=actual)
+    try:
+        manifest = json.loads(mbytes)
+    except ValueError:
+        raise DataCorruptionError(
+            "snapshot manifest is not valid JSON", mpath) from None
+    if (manifest.get("format") != SNAPSHOT_FORMAT
+            or manifest.get("version") != SNAPSHOT_VERSION):
+        raise DataCorruptionError(
+            "snapshot manifest format/version mismatch: %r/%r"
+            % (manifest.get("format"), manifest.get("version")), mpath)
+    manifest["_dir"] = snapshot_dir(root, name)
+    manifest["_name"] = name
+    return manifest
+
+
+def load_current(root: str, *, mmap_store: bool = False):
+    """Load the CURRENT snapshot: ``(index, delta_vecs, delta_ids,
+    manifest)`` with every chunk CRC verified, or None when no
+    snapshot exists.  ``mmap_store`` backs the out-of-core store with
+    a copy-on-write ``np.memmap`` instead of reading it into memory
+    (verification still streams the file once)."""
+    manifest = current_manifest(root)
+    if manifest is None:
+        return None
+    sdir = manifest["_dir"]
+    kind = manifest["kind"]
+    expects(kind in _REBUILD, "load_current: unknown index kind %s",
+            kind)
+    arrays = {}
+    for entry in manifest["arrays"]:
+        use_mmap = (mmap_store and kind == "OocIVFFlat"
+                    and entry["name"] == "store")
+        arrays[entry["name"]] = _read_array(sdir, entry, mmap=use_mmap)
+    delta_vecs = arrays.pop("delta_vecs", None)
+    delta_ids = arrays.pop("delta_ids", None)
+    index = _REBUILD[kind](arrays, manifest["meta"])
+    return index, delta_vecs, delta_ids, manifest

@@ -55,6 +55,7 @@ carries its quality number.
 from __future__ import annotations
 
 import threading
+import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -94,6 +95,11 @@ class _AnnState(NamedTuple):
     # out-of-core hot set: (hot_vecs, hot_ids_device, hot_mask_numpy)
     # or None — swapped whole by promotion/compaction, never mutated
     ooc_hot: object = None
+    # last write-ahead-log sequence number whose insert is CONTAINED
+    # in this state (docs/PERSISTENCE.md): a snapshot taken from this
+    # state records it as its replay floor, so WAL truncation can
+    # never drop a record the snapshot does not hold
+    wal_seq: int = 0
 
 
 def _labeled(kind: str, name: str, help: str, service: str, **extra):
@@ -177,6 +183,21 @@ class ANNService(Service):
         compose with ``axis=`` (shard the resident path instead).
         Passing a prebuilt :class:`~raft_tpu.spatial.ooc.OocIVFFlat`
         as ``index`` implies ``ooc=True``.
+    persist_dir / persist_fsync / snapshot_interval_s / persist_mmap /
+    scrub_chunks:
+        Durable serving state (docs/PERSISTENCE.md): ``persist_dir``
+        names a directory owning this service's checksummed snapshots
+        and write-ahead log.  A directory holding state
+        **auto-restores on construction** — snapshot load (every
+        chunk CRC-verified) plus WAL-tail replay into the delta — and
+        ``index=None`` is then legal (rebuild-from-directory, the
+        crash-restart path).  ``persist_fsync``
+        (``always``/``batch``/``off``) is the insert acknowledge
+        contract, ``snapshot_interval_s`` gates maintenance-seam
+        snapshots, ``persist_mmap`` backs a restored out-of-core
+        store with a copy-on-write ``np.memmap``, and
+        ``scrub_chunks`` sizes the per-tick integrity scrub (0
+        disables).  Each defaults to its ``persist_*`` knob.
     **opts:
         The shared :class:`~raft_tpu.serve.service.Service` options
         (``max_batch_rows``, ``bucket_rungs``, ``max_wait_ms``,
@@ -197,11 +218,65 @@ class ANNService(Service):
                  tile_slots: Optional[int] = None,
                  ooc_overlap: bool = True,
                  ooc_promote_batches: int = 32,
+                 persist_dir: Optional[str] = None,
+                 persist_fsync: Optional[str] = None,
+                 snapshot_interval_s: Optional[float] = None,
+                 persist_mmap: bool = False,
+                 scrub_chunks: Optional[int] = None,
                  mesh=None, axis: Optional[str] = None,
                  merge: Optional[str] = None,
                  group_size: Optional[int] = None,
                  name: Optional[str] = None, **opts):
         from raft_tpu.spatial.ooc import OocIVFFlat
+
+        # name resolved FIRST (it used to resolve just before
+        # Service.__init__): the persist manager labels its metrics
+        # and flight events by service name from restore onward
+        name = name or "ann%d" % next(_service_seq)
+        self.name = name
+
+        # durability (docs/PERSISTENCE.md): a persist_dir holding
+        # state auto-restores BEFORE anything reads the index — the
+        # loaded snapshot replaces the constructor's index (which may
+        # then be None: rebuild-from-directory, the crash-restart
+        # path) and the WAL tail replays into the delta mirror below
+        self._persist = None
+        self._persist_wal_seq = 0
+        restored = None
+        if persist_dir is not None:
+            from raft_tpu.persist import PersistManager
+
+            self._persist = PersistManager(
+                persist_dir, service=name, fsync=persist_fsync,
+                snapshot_interval_s=snapshot_interval_s,
+                scrub_chunks=scrub_chunks,
+                clock=opts.get("clock", time.monotonic))
+            if self._persist.has_state():
+                restored = self._persist.restore(
+                    mmap_store=persist_mmap)
+                if restored.index is not None:
+                    if index is not None:
+                        expects(
+                            int(index.centroids.shape[1])
+                            == int(restored.index.centroids.shape[1]),
+                            "ANNService: persist_dir %r holds a "
+                            "dim-%d snapshot but the constructor "
+                            "index is dim-%d", persist_dir,
+                            int(restored.index.centroids.shape[1]),
+                            int(index.centroids.shape[1]))
+                    index = restored.index
+        else:
+            expects(persist_fsync is None
+                    and snapshot_interval_s is None
+                    and scrub_chunks is None and not persist_mmap,
+                    "ANNService: persist_fsync/snapshot_interval_s/"
+                    "scrub_chunks/persist_mmap are durability knobs "
+                    "— pass persist_dir=")
+        expects(index is not None,
+                "ANNService: index=None requires persist_dir "
+                "pointing at existing durable state (no snapshot or "
+                "WAL found%s)" % ("" if persist_dir is None
+                                  else " in %r" % persist_dir))
 
         kinds = (_ann.IVFFlatIndex, _ann.IVFPQIndex, _ann.IVFSQIndex,
                  OocIVFFlat)
@@ -287,6 +362,14 @@ class ANNService(Service):
             self._ooc_mod = _ooc_mod
             if isinstance(index, _ann.IVFFlatIndex):
                 index = _ooc_mod.ivf_flat_to_ooc(index)
+            if (self._persist is not None
+                    and not index.store.flags.writeable):
+                # scrub quarantine rebuilds a poisoned slot IN PLACE
+                # (docs/PERSISTENCE.md); a store that is a read-only
+                # view of the build's jax buffer is copied once into
+                # writable host memory (restored stores — full-read
+                # or mode-"c" memmap — are already writable)
+                index = index._replace(store=index.store.copy())
             self._ooc = index
             if device_budget_bytes is None:
                 device_budget_bytes = _knob_int(
@@ -373,12 +456,6 @@ class ANNService(Service):
         # raise the effective level per batch without touching this
         self._degrade_hold = 0
 
-        # resolved before Service.__init__ so the metric labels (and
-        # the worker's maintenance tick) can use it from the first
-        # snapshot publish onward
-        name = name or "ann%d" % next(_service_seq)
-        self.name = name
-
         # delta segment: host mirror (the append target) + device
         # snapshot published in _ann_state; rows >= count carry id -1
         self._delta_lock = threading.Lock()
@@ -404,6 +481,14 @@ class ANNService(Service):
             self._ooc_hot_ids = self._ooc_ideal_hot()
             self._ooc_rebuild_hot()
         self._publish_state_locked()
+        if restored is not None:
+            self._apply_restore(restored)
+        if self._persist is not None and self._persist.snapshot_seq == 0:
+            # bootstrap snapshot: durability starts at construction,
+            # not at the first maintenance tick — a crash before the
+            # first interval must still restore, and a WAL-only
+            # directory cannot rebuild the base index
+            self._persist.snapshot(self._ann_state)
 
         def execute(padded):
             st = self._ann_state        # ONE snapshot per batch
@@ -493,7 +578,8 @@ class ANNService(Service):
             jnp.asarray(self._delta_ids_np),
             self._delta_count,
             sharded,
-            self._ooc_hot)
+            self._ooc_hot,
+            self._persist_wal_seq)
         _labeled("gauge", "raft_tpu_serve_ann_delta_rows",
                  "rows in the append-only delta segment",
                  self.name).set(self._delta_count)
@@ -628,6 +714,86 @@ class ANNService(Service):
             if self._ooc is not None:
                 self._ooc_rebuild_hot()
             self._publish_state_locked()
+
+    # ------------------------------------------------------------------ #
+    # durability (docs/PERSISTENCE.md)
+    # ------------------------------------------------------------------ #
+    def _apply_restore(self, restored) -> None:
+        """Re-enter the durable state (__init__ only, single-threaded):
+        snapshot delta rows into the host mirror, then the WAL tail —
+        every record beyond the snapshot's ``wal_seq`` — in sequence
+        order.  A replay that would overflow the delta segment (the
+        crash landed between a compaction and its snapshot) folds the
+        full delta into the index first (:meth:`_fold_delta_locked`),
+        exactly what compaction would have done — zero acknowledged
+        rows lost either way."""
+        with self._delta_lock:
+            self._persist_wal_seq = int(restored.wal_seq)
+            rows = int(restored.delta_rows)
+            if rows:
+                expects(rows <= self._delta_cap,
+                        "%s: restored snapshot holds %d delta rows "
+                        "but delta_cap is %d — restore with the "
+                        "original capacity or larger", self.name,
+                        rows, self._delta_cap)
+                self._delta_vecs_np[:rows] = np.asarray(
+                    restored.delta_vecs, self._delta_vecs_np.dtype)
+                self._delta_ids_np[:rows] = np.asarray(
+                    restored.delta_ids, np.int32)
+                self._delta_count = rows
+            dim = self._delta_vecs_np.shape[1]
+            for seq, ids, vecs in restored.wal_records:
+                expects(vecs.ndim == 2 and vecs.shape[1] == dim,
+                        "%s: WAL record %d carries dim-%d vectors; "
+                        "this service serves dim-%d", self.name,
+                        int(seq), int(vecs.shape[1]), dim)
+                n = int(vecs.shape[0])
+                if self._delta_count + n > self._delta_cap:
+                    self._fold_delta_locked()
+                expects(self._delta_count + n <= self._delta_cap,
+                        "%s: WAL record %d (%d rows) exceeds the "
+                        "delta capacity %d even after folding",
+                        self.name, int(seq), n, self._delta_cap)
+                at = self._delta_count
+                self._delta_vecs_np[at:at + n] = np.asarray(
+                    vecs, self._delta_vecs_np.dtype)
+                self._delta_ids_np[at:at + n] = np.asarray(
+                    ids, np.int32)
+                self._delta_count = at + n
+                self._persist_wal_seq = int(seq)
+            self._publish_state_locked()
+
+    def _fold_delta_locked(self) -> None:
+        """Restore-time inline compaction (caller holds
+        ``_delta_lock``): extend the index with the full delta so WAL
+        replay can keep appending — the same nearest-existing-centroid
+        fold :meth:`compact` performs, minus the serving swap
+        machinery (no traffic exists yet)."""
+        expects(self._compactable,
+                "%s: WAL replay overflowed the delta segment and a "
+                "PQ/SQ index cannot be extended — raise delta_cap or "
+                "rebuild offline", self.name)
+        n0 = self._delta_count
+        if n0 == 0:
+            return
+        vecs = self._delta_vecs_np[:n0].copy()
+        keys = self._delta_ids_np[:n0].copy()
+        old_index = self._index
+        if self._ooc is not None:
+            new_index = self._ooc_mod.ooc_extend(
+                old_index, vecs, keys,
+                slot_multiple=self._slot_multiple)
+            self._ooc_remap_counters(old_index, new_index)
+            self._ooc = new_index
+            self._ooc_hot_ids = self._ooc_ideal_hot()
+            self._ooc_rebuild_hot()
+        else:
+            new_index = _ann.ivf_flat_extend(
+                old_index, vecs, keys,
+                slot_multiple=self._slot_multiple)
+        self._index = new_index
+        self._delta_ids_np[:] = -1
+        self._delta_count = 0
 
     # ------------------------------------------------------------------ #
     # out-of-core tier (docs/SERVING.md "Out-of-core serving")
@@ -801,6 +967,13 @@ class ANNService(Service):
                         self.name, at, n, self._delta_cap), at,
                     self._delta_cap,
                     retry_after_s=max(self._last_compact_s, 0.05))
+            if self._persist is not None:
+                # the acknowledge contract (docs/PERSISTENCE.md): the
+                # record is in the WAL — durable per the fsync policy
+                # — BEFORE the mirror mutates or the caller is acked;
+                # an append failure raises with no state change
+                self._persist_wal_seq = self._persist.wal_append(
+                    key, np.asarray(v))
             self._delta_vecs_np[at:at + n] = np.asarray(v)
             self._delta_ids_np[at:at + n] = key
             self._delta_count = at + n
@@ -822,6 +995,13 @@ class ANNService(Service):
                 and self._delta_count >= self._compact_rows
                 and not self.batcher.draining()):
             self.compact()
+        if self._persist is not None:
+            # durability tick (docs/PERSISTENCE.md): deferred WAL
+            # fsync, interval-gated snapshot of the immutable state
+            # (never mid-batch — this IS the maintenance seam), one
+            # incremental scrub step
+            self._persist.maintenance_tick(self._ann_state,
+                                           ooc=self._ooc)
 
     def _tile_misses_now(self) -> float:
         """Current value of this service's pool-labeled tile-miss
@@ -903,6 +1083,11 @@ class ANNService(Service):
                     self._ooc_hot_ids = self._ooc_ideal_hot()
                     self._ooc_rebuild_hot()
                 self._publish_state_locked()   # THE atomic swap
+        if self._persist is not None:
+            # the on-disk snapshot no longer matches the served index
+            # — the next maintenance tick persists the compacted form
+            # and truncates the WAL of the rows it absorbed
+            self._persist.note_dirty()
         _labeled("counter", "raft_tpu_serve_ann_compactions_total",
                  "delta-to-slots compactions", self.name).inc()
         _labeled("counter", "raft_tpu_serve_ann_compacted_rows_total",
@@ -1025,6 +1210,27 @@ class ANNService(Service):
         return {"chosen_nprobe": chosen, "target_recall": target_recall,
                 "met_target": met, "k": self.k, "table": table}
 
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None, *,
+              snapshot: bool = True) -> None:
+        """Drain and stop (the base contract), then — for a
+        persistent service — take the **final snapshot**: a clean
+        shutdown leaves an empty WAL, so restart restores from the
+        snapshot alone and never pays replay.  ``snapshot=False``
+        skips it (the chaos harness's simulated process death — a
+        crash takes no snapshot, and restart must recover from the
+        last interval snapshot plus the WAL tail).  Idempotent."""
+        was_closed = self._closed
+        super().close(drain=drain, timeout=timeout)
+        if was_closed or self._persist is None:
+            return
+        if snapshot:
+            # the worker is joined (no compaction or batch can swap
+            # state under us) and insert() sheds on a closed service
+            # — the state below is final
+            self._persist.final_snapshot(self._ann_state)
+        self._persist.close()
+
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
         out = super().stats()
@@ -1038,6 +1244,11 @@ class ANNService(Service):
             "degrade_queue_frac": self._degrade_frac,
             "degrade_hold": self._degrade_hold,
         })
+        if self._persist is not None:
+            # durability digest (docs/PERSISTENCE.md): snapshot
+            # age/staleness, WAL depth, and the last scrub verdict —
+            # session health_check fails ok on detected corruption
+            out["persist"] = self._persist.stats()
         if self._ooc is not None:
             out["ooc"] = {
                 "budget_bytes": self._ooc_budget,

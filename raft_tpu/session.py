@@ -382,6 +382,14 @@ class Comms:
                     return False
                 if s.get("mesh_ok") is False:
                     return False
+                # detected (unrepaired) durable-state corruption
+                # fails health: the scrubber found a snapshot chunk
+                # or host-store slot whose bytes no longer match
+                # their checksum and could not rebuild it
+                # (docs/PERSISTENCE.md; snapshot staleness is
+                # surfaced in stats()["persist"] but does not fail)
+                if s.get("persist", {}).get("corruption_detected"):
+                    return False
                 br = s.get("breaker")
                 return not (br and br.get("state") == "open")
 
@@ -500,12 +508,21 @@ class Comms:
         devices against the session mesh and ``post_recover`` re-cuts
         the groups after a mesh rebuild.
 
+        ``serve(kind="ann", persist_dir=...)`` passes the durability
+        knobs straight through (docs/PERSISTENCE.md): the service
+        auto-restores from the directory on construction, journals
+        every acknowledged insert, snapshots on its maintenance seam,
+        and ``health_check`` fails ``ok`` when the integrity scrubber
+        detects unrepaired corruption (surfaced in
+        ``stats()["persist"]`` alongside snapshot staleness).
+
         Registration is what buys the lifecycle guarantees:
         :meth:`health_check` reports the service and :meth:`destroy`
         drains it before comms teardown — for an ANN service the drain
         also closes out compaction: the worker thread that runs
         maintenance is joined, so no index swap is mid-flight when the
-        communicator goes down.  The returned service is started; call
+        communicator goes down (and a persistent service takes its
+        final snapshot).  The returned service is started; call
         ``warmup()`` before taking traffic to precompile every shape
         bucket (× nprobe cell for ANN).
         """

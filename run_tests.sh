@@ -34,4 +34,11 @@ if [[ "${1:-}" == "--tuning" ]]; then
     shift
     exec python -m pytest tests/ -q -m tuning "$@"
 fi
+# --persist: only the durability suite (snapshot/WAL round trips, the
+# corruption matrix, crash-restart recovery, scrubbing; also part of
+# the default invocation)
+if [[ "${1:-}" == "--persist" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m persist "$@"
+fi
 exec python -m pytest tests/ -q "$@"

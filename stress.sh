@@ -70,6 +70,16 @@ if [[ "${1:-}" == "chaos" ]]; then
                 --concurrency 3 --index-rows 8000 --dim 16 --k 5 \
                 --max-batch-rows 64 --max-wait-ms 1
         fi
+        # every round also runs the crash-restart durability arm
+        # (docs/PERSISTENCE.md): simulated process death mid-run (no
+        # final snapshot), rebuild from the persist dir — zero
+        # acknowledged-insert loss, bit-identical post-restore search,
+        # typed-only errors, 0 post-warmup compiles after restore
+        echo "== serve chaos crash-restart $i/$n (seed=$i) =="
+        python tools/loadgen.py --crash-restart --service ann \
+            --seed "$i" --duration 3 --concurrency 3 \
+            --index-rows 4000 --dim 16 --k 5 --nlist 32 \
+            --max-batch-rows 64 --max-wait-ms 1
         # every other round runs the SHARDED variant with a permanent
         # shard kill: recovery must re-partition over the survivors
         # with exactly-once resolution and exact post-heal results

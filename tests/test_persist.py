@@ -1,0 +1,687 @@
+"""Durable serving state (raft_tpu.persist; docs/PERSISTENCE.md):
+snapshot round trips for every index kind (bitwise search identity),
+the corruption matrix (manifest / array payload / WAL interior / WAL
+torn tail), the insert acknowledge contract, crash-restart recovery
+through ANNService(persist_dir=) including the delta-overflow fold,
+integrity scrubbing with quarantine-and-rebuild, session health
+integration, and the serialization style ban.
+
+Deterministic throughout: services run threadless (``start=False``)
+with injected fake clocks driving snapshot intervals; the one
+concurrency scenario rides ``tools/loadgen.run_crash_restart`` (also
+rotated by ``./stress.sh chaos``).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import (
+    DataCorruptionError,
+    LogicError,
+)
+from raft_tpu.core.profiler import compile_cache_stats
+from raft_tpu.persist import (
+    WriteAheadLog,
+    current_manifest,
+    load_current,
+    replay_wal,
+    write_snapshot,
+)
+from raft_tpu.serve import ANNService
+from raft_tpu.spatial import ann
+from raft_tpu.spatial.ooc import OocIVFFlat, ivf_flat_to_ooc
+
+pytestmark = [pytest.mark.persist, pytest.mark.serve]
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def data(rng):
+    return jnp.asarray(rng.standard_normal((900, 16)), jnp.float32)
+
+
+@pytest.fixture
+def flat_index(data):
+    return ann.ivf_flat_build(
+        data, ann.IVFFlatParams(nlist=8, nprobe=4), seed=SEED)
+
+
+def _total_misses():
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
+
+
+def _search_pair(idx, q, k=5):
+    out = ann.approx_knn_search(idx, q, k, nprobe=4)
+    return np.asarray(out[0]), np.asarray(out[1])
+
+
+def _flip_byte(path, offset):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def make_svc(index, tmp=None, clock=None, **kw):
+    kw.setdefault("max_batch_rows", 32)
+    kw.setdefault("bucket_rungs", (8, 32))
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("nprobe_ladder", (4, 8))
+    kw.setdefault("delta_cap", 64)
+    kw.setdefault("compact_rows", 0)
+    # donation off: the deterministic halves re-drive queries through
+    # _snapshot_search(donate=False) directly, which must hit the same
+    # (non-donating) executables warmup compiled
+    kw.setdefault("donate", False)
+    if tmp is not None:
+        kw.setdefault("persist_dir", str(tmp))
+    if clock is not None:
+        kw["clock"] = clock
+    return ANNService(index, k=5, start=False, **kw)
+
+
+def _state_search(svc, q, nprobe=4):
+    st = svc._ann_state
+    delta = ((st.delta_vecs, st.delta_ids) if st.delta_rows else None)
+    out = svc._snapshot_search(st, q, nprobe, delta, False)
+    return np.asarray(out[0]).copy(), np.asarray(out[1]).copy()
+
+
+# --------------------------------------------------------------------- #
+# snapshot round trips
+# --------------------------------------------------------------------- #
+class TestSnapshotRoundTrip:
+    def test_flat_bitwise(self, flat_index, rng, tmp_path):
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        idx2, dv, di, manifest = load_current(str(tmp_path))
+        assert manifest["kind"] == "IVFFlatIndex"
+        assert dv is None and di is None
+        q = jnp.asarray(rng.standard_normal((6, 16)), jnp.float32)
+        d1, i1 = _search_pair(flat_index, q)
+        d2, i2 = _search_pair(idx2, q)
+        assert (d1 == d2).all() and (i1 == i2).all()
+
+    def test_pq_with_refine(self, data, rng, tmp_path):
+        idx = ann.ivf_pq_build(
+            data, ann.IVFPQParams(nlist=8, nprobe=4, M=4,
+                                  refine_ratio=2), seed=SEED)
+        write_snapshot(str(tmp_path), idx, seq=1, wal_seq=0)
+        idx2, _, _, manifest = load_current(str(tmp_path))
+        assert manifest["kind"] == "IVFPQIndex"
+        assert idx2.vectors is not None
+        assert idx2.refine_ratio == 2
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        d1, i1 = _search_pair(idx, q)
+        d2, i2 = _search_pair(idx2, q)
+        assert (d1 == d2).all() and (i1 == i2).all()
+
+    def test_sq(self, data, rng, tmp_path):
+        idx = ann.ivf_sq_build(
+            data, ann.IVFSQParams(nlist=8, nprobe=4), seed=SEED)
+        write_snapshot(str(tmp_path), idx, seq=1, wal_seq=0)
+        idx2, _, _, manifest = load_current(str(tmp_path))
+        assert manifest["kind"] == "IVFSQIndex"
+        assert bool(idx2.encode_residual) == bool(idx.encode_residual)
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        d1, i1 = _search_pair(idx, q)
+        d2, i2 = _search_pair(idx2, q)
+        assert (d1 == d2).all() and (i1 == i2).all()
+
+    @pytest.mark.parametrize("mmap", [False, True])
+    def test_ooc_store_stays_host(self, flat_index, rng, tmp_path,
+                                  mmap):
+        ooc = ivf_flat_to_ooc(flat_index)
+        write_snapshot(str(tmp_path), ooc, seq=1, wal_seq=0)
+        idx2, _, _, manifest = load_current(str(tmp_path),
+                                            mmap_store=mmap)
+        assert isinstance(idx2, OocIVFFlat)
+        # the loader's contract: the bulk store never touches device
+        assert isinstance(idx2.store, np.ndarray)
+        if mmap:
+            assert isinstance(idx2.store, np.memmap)
+        assert (np.asarray(idx2.store) == np.asarray(ooc.store)).all()
+        # per-slot chunking: chunk index IS a slot id
+        store_entry = next(e for e in manifest["arrays"]
+                           if e["name"] == "store")
+        assert len(store_entry["crc32s"]) == ooc.n_slots
+
+    def test_delta_rides_along(self, flat_index, rng, tmp_path):
+        dvecs = rng.standard_normal((7, 16)).astype(np.float32)
+        dids = np.arange(100, 107, dtype=np.int32)
+        write_snapshot(str(tmp_path), flat_index, seq=3, wal_seq=9,
+                       delta=(dvecs, dids))
+        idx2, dv, di, manifest = load_current(str(tmp_path))
+        assert manifest["delta_rows"] == 7
+        assert manifest["wal_seq"] == 9
+        assert (dv == dvecs).all() and (di == dids).all()
+
+    def test_orphan_final_dir_from_crashed_flip_is_replaced(
+            self, flat_index, tmp_path):
+        # a crash BETWEEN a writer's directory rename and its CURRENT
+        # flip leaves an orphan snapshot dir whose seq gets re-issued;
+        # the next write must replace it, not fail rename(2) forever
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        orphan = tmp_path / "snapshots" / "snapshot-0000000002"
+        orphan.mkdir()
+        (orphan / "half-written.bin").write_bytes(b"junk")
+        m = write_snapshot(str(tmp_path), flat_index, seq=2, wal_seq=0)
+        assert m["seq"] == 2
+        assert current_manifest(str(tmp_path))["seq"] == 2
+        idx2, _, _, _ = load_current(str(tmp_path))
+        assert idx2 is not None
+
+    def test_restore_depth_skips_snapshot_covered_records(
+            self, flat_index, rng, tmp_path):
+        from raft_tpu.persist import PersistManager
+
+        # a crash between write_snapshot and WAL truncation leaves
+        # covered records (seq <= wal_seq) in the file: replay skips
+        # them and the depth gauge must too
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 16, np.float32, fsync="always")
+        for i in range(3):
+            w.append(np.arange(2 * i, 2 * i + 2),
+                     rng.standard_normal((2, 16)).astype(np.float32))
+        w.close()
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=2)
+        mgr = PersistManager(str(tmp_path), service="t",
+                             fsync="always", snapshot_interval_s=30.0,
+                             scrub_chunks=0)
+        restored = mgr.restore()
+        assert len(restored.wal_records) == 1
+        st = mgr.stats()
+        assert st["replayed_records"] == 1
+        assert st["wal_records"] == 1
+        mgr.close()
+
+    def test_supersede_sweeps_and_ignores_stray_tmp(self, flat_index,
+                                                    tmp_path):
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        snaps = tmp_path / "snapshots"
+        stray = snaps / ".tmp-snapshot-0000000099"
+        stray.mkdir()
+        (stray / "junk.bin").write_bytes(b"junk")
+        write_snapshot(str(tmp_path), flat_index, seq=2, wal_seq=0)
+        names = sorted(os.listdir(snaps))
+        assert names == ["snapshot-0000000002"]
+        assert current_manifest(str(tmp_path))["seq"] == 2
+
+
+# --------------------------------------------------------------------- #
+# corruption matrix
+# --------------------------------------------------------------------- #
+class TestCorruptionMatrix:
+    def test_manifest_bitflip(self, flat_index, tmp_path):
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        mpath = (tmp_path / "snapshots" / "snapshot-0000000001"
+                 / "MANIFEST.json")
+        _flip_byte(str(mpath), 40)
+        with pytest.raises(DataCorruptionError) as e:
+            load_current(str(tmp_path))
+        assert "MANIFEST.json" in str(e.value)
+
+    def test_array_payload_bitflip(self, flat_index, tmp_path):
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        apath = (tmp_path / "snapshots" / "snapshot-0000000001"
+                 / "slot_vecs.bin")
+        _flip_byte(str(apath), 100)
+        with pytest.raises(DataCorruptionError) as e:
+            load_current(str(tmp_path))
+        err = e.value
+        assert err.path.endswith("slot_vecs.bin")
+        assert err.offset == 0          # chunk-granular offset
+        assert err.expected_crc is not None
+        assert err.actual_crc is not None
+        assert err.expected_crc != err.actual_crc
+
+    def test_current_pointer_garbage(self, flat_index, tmp_path):
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        (tmp_path / "CURRENT").write_text("what even is this\n")
+        with pytest.raises(DataCorruptionError):
+            load_current(str(tmp_path))
+
+    def test_version_mismatch(self, flat_index, tmp_path):
+        import json
+        import zlib
+
+        write_snapshot(str(tmp_path), flat_index, seq=1, wal_seq=0)
+        mpath = (tmp_path / "snapshots" / "snapshot-0000000001"
+                 / "MANIFEST.json")
+        doc = json.loads(mpath.read_bytes())
+        doc["version"] = 999
+        raw = json.dumps(doc).encode()
+        mpath.write_bytes(raw)
+        (tmp_path / "CURRENT").write_text(
+            "snapshot-0000000001 %d\n" % (zlib.crc32(raw) & 0xFFFFFFFF))
+        with pytest.raises(DataCorruptionError) as e:
+            load_current(str(tmp_path))
+        assert "version" in str(e.value)
+
+    def test_wal_roundtrip_and_min_seq(self, rng, tmp_path):
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 16, np.float32, fsync="always")
+        v1 = rng.standard_normal((3, 16)).astype(np.float32)
+        v2 = rng.standard_normal((2, 16)).astype(np.float32)
+        assert w.append(np.arange(3), v1) == 1
+        assert w.append(np.arange(3, 5), v2) == 2
+        w.close()
+        recs, info = replay_wal(wp)
+        assert [s for s, _, _ in recs] == [1, 2]
+        assert (recs[0][2] == v1).all() and (recs[1][2] == v2).all()
+        assert info["total_records"] == 2 and not info["torn"]
+        recs, info = replay_wal(wp, min_seq=1)
+        assert [s for s, _, _ in recs] == [2]
+        assert info["last_seq"] == 2
+
+    def test_wal_torn_tail_tolerated(self, rng, tmp_path):
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 8, np.float32, fsync="always")
+        w.append(np.arange(2), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        w.append(np.arange(2, 4), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        w.close()
+        os.truncate(wp, os.path.getsize(wp) - 5)   # tear the tail
+        recs, info = replay_wal(wp)
+        assert info["torn"]
+        assert [s for s, _, _ in recs] == [1]
+        # truncating to valid_end + re-opening appends cleanly
+        os.truncate(wp, info["valid_end"])
+        w2 = WriteAheadLog(wp, 8, np.float32, fsync="always",
+                           start_seq=info["last_seq"])
+        assert w2.append(np.arange(4, 6), rng.standard_normal(
+            (2, 8)).astype(np.float32)) == 2
+        w2.close()
+        recs, info = replay_wal(wp)
+        assert [s for s, _, _ in recs] == [1, 2] and not info["torn"]
+
+    def test_wal_interior_bitflip_raises(self, rng, tmp_path):
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 8, np.float32, fsync="always")
+        w.append(np.arange(2), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        end_first = w.tell()
+        w.append(np.arange(2, 4), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        w.close()
+        # flip a payload byte INSIDE the first record (interior)
+        _flip_byte(wp, end_first - 3)
+        with pytest.raises(DataCorruptionError) as e:
+            replay_wal(wp)
+        err = e.value
+        assert err.path == wp and err.offset is not None
+        assert err.expected_crc != err.actual_crc
+
+    def test_wal_bad_magic_raises(self, rng, tmp_path):
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 8, np.float32, fsync="always")
+        rec_start = w.tell()
+        w.append(np.arange(2), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        w.append(np.arange(2, 4), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        w.close()
+        _flip_byte(wp, rec_start)       # magic of record 1
+        with pytest.raises(DataCorruptionError) as e:
+            replay_wal(wp)
+        assert "magic" in str(e.value)
+
+    def test_wal_header_length_bitflip_is_corruption(self, rng,
+                                                     tmp_path):
+        # a flipped rows field must NOT reclassify as a torn tail and
+        # silently drop the record — the header CRC catches it
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 8, np.float32, fsync="always")
+        rec_start = w.tell()
+        w.append(np.arange(2), rng.standard_normal((2, 8)).astype(
+            np.float32))
+        w.close()
+        _flip_byte(wp, rec_start + 12)  # rows u32 inside the header
+        with pytest.raises(DataCorruptionError):
+            replay_wal(wp)
+
+    def test_wal_truncate_through(self, rng, tmp_path):
+        wp = str(tmp_path / "wal.log")
+        w = WriteAheadLog(wp, 8, np.float32, fsync="always")
+        for i in range(4):
+            w.append(np.arange(2 * i, 2 * i + 2),
+                     rng.standard_normal((2, 8)).astype(np.float32))
+        assert w.truncate_through(2) == 2
+        w.close()
+        recs, info = replay_wal(wp)
+        assert [s for s, _, _ in recs] == [3, 4]
+
+    def test_wal_bad_fsync_policy(self, tmp_path):
+        with pytest.raises(LogicError):
+            WriteAheadLog(str(tmp_path / "w.log"), 8, np.float32,
+                          fsync="sometimes")
+
+    def test_error_fields(self):
+        e = DataCorruptionError("boom", "/x/y.bin", offset=64,
+                                expected_crc=1, actual_crc=2)
+        assert e.path == "/x/y.bin" and e.offset == 64
+        assert "0x00000001" in str(e) and "@ byte 64" in str(e)
+
+
+# --------------------------------------------------------------------- #
+# ANNService integration
+# --------------------------------------------------------------------- #
+class TestServicePersistence:
+    def test_insert_journaled_before_ack(self, flat_index, rng,
+                                         tmp_path):
+        svc = make_svc(flat_index, tmp_path)
+        try:
+            svc.insert(np.arange(1000, 1004),
+                       rng.standard_normal((4, 16)).astype(np.float32))
+            ps = svc.stats()["persist"]
+            assert ps["wal_records"] == 1
+            assert ps["wal_seq"] == 1
+            assert svc._ann_state.wal_seq == 1
+        finally:
+            svc.close()
+
+    def test_wal_failure_fails_insert_without_state_change(
+            self, flat_index, rng, tmp_path):
+        svc = make_svc(flat_index, tmp_path)
+        try:
+            def boom(ids, vecs):
+                raise OSError("disk gone")
+
+            svc._persist.wal_append = boom
+            with pytest.raises(OSError):
+                svc.insert(np.arange(1000, 1004),
+                           rng.standard_normal((4, 16)).astype(
+                               np.float32))
+            # NOT acknowledged, NOT applied
+            assert svc.delta_rows == 0
+            assert svc._delta_count == 0
+        finally:
+            svc.close(snapshot=False)
+
+    def test_interval_snapshot_truncates_wal(self, flat_index, rng,
+                                             tmp_path):
+        clock = FakeClock()
+        svc = make_svc(flat_index, tmp_path, clock=clock,
+                       snapshot_interval_s=10.0)
+        try:
+            svc.insert(np.arange(1000, 1008),
+                       rng.standard_normal((8, 16)).astype(np.float32))
+            svc.worker.run_maintenance()
+            ps = svc.stats()["persist"]
+            assert ps["snapshot_seq"] == 1     # bootstrap only
+            assert ps["wal_records"] == 1 and ps["dirty"]
+            clock.advance(11.0)
+            svc.worker.run_maintenance()
+            ps = svc.stats()["persist"]
+            assert ps["snapshot_seq"] == 2
+            assert ps["wal_records"] == 0 and not ps["dirty"]
+            # the snapshot carries the delta rows the WAL dropped
+            assert current_manifest(str(tmp_path))["delta_rows"] == 8
+        finally:
+            svc.close(snapshot=False)
+
+    def test_crash_restart_bitwise_and_no_loss(self, flat_index, rng,
+                                               tmp_path):
+        svc = make_svc(flat_index, tmp_path)
+        new_ids = np.arange(2000, 2012)
+        svc.insert(new_ids,
+                   rng.standard_normal((12, 16)).astype(np.float32))
+        q = jnp.asarray(rng.standard_normal((6, 16)), jnp.float32)
+        ref = _state_search(svc, q)
+        svc.close(snapshot=False)           # simulated process death
+        svc2 = make_svc(None, tmp_path)     # rebuild from dir alone
+        try:
+            ps = svc2.stats()["persist"]
+            assert ps["replayed_records"] == 1
+            got = _state_search(svc2, q)
+            assert (got[0] == ref[0]).all() and (got[1] == ref[1]).all()
+            _, gt_ids = svc2.ground_truth_store()
+            assert set(int(x) for x in new_ids) <= set(
+                int(x) for x in gt_ids)
+        finally:
+            svc2.close()
+
+    def test_restored_service_zero_post_warmup_compiles(
+            self, flat_index, rng, tmp_path):
+        svc = make_svc(flat_index, tmp_path)
+        svc.insert(np.arange(3000, 3004),
+                   rng.standard_normal((4, 16)).astype(np.float32))
+        svc.close(snapshot=False)
+        svc2 = make_svc(None, tmp_path)
+        try:
+            svc2.warmup()
+            # a bucket-rung shape: dispatch always pads to one, and
+            # warmup only ever warms the rungs
+            q = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+            m0 = _total_misses()
+            for cell in (4, 8):
+                _state_search(svc2, q, nprobe=cell)
+            assert _total_misses() - m0 == 0
+        finally:
+            svc2.close()
+
+    def test_clean_close_leaves_empty_wal(self, flat_index, rng,
+                                          tmp_path):
+        svc = make_svc(flat_index, tmp_path)
+        svc.insert(np.arange(4000, 4006),
+                   rng.standard_normal((6, 16)).astype(np.float32))
+        svc.close()                         # final snapshot
+        svc2 = make_svc(None, tmp_path)
+        try:
+            ps = svc2.stats()["persist"]
+            assert ps["replayed_records"] == 0
+            assert ps["wal_records"] == 0
+            assert svc2.delta_rows == 6     # via the snapshot instead
+        finally:
+            svc2.close()
+
+    def test_restore_overflow_folds_into_index(self, flat_index, rng,
+                                               tmp_path):
+        svc = make_svc(flat_index, tmp_path, delta_cap=32,
+                       snapshot_interval_s=1e9)
+        ids_a = np.arange(5000, 5032)
+        svc.insert(ids_a,
+                   rng.standard_normal((32, 16)).astype(np.float32))
+        svc.compact()       # delta -> index; WAL keeps the record
+        ids_b = np.arange(6000, 6020)
+        svc.insert(ids_b,
+                   rng.standard_normal((20, 16)).astype(np.float32))
+        q = jnp.asarray(rng.standard_normal((6, 16)), jnp.float32)
+        ref = _state_search(svc, q)
+        svc.close(snapshot=False)
+        svc2 = make_svc(None, tmp_path, delta_cap=32)
+        try:
+            # replay had to fold record A into the index to make room
+            assert svc2.stats()["persist"]["replayed_records"] == 2
+            assert svc2.delta_rows == 20
+            got = _state_search(svc2, q)
+            assert (got[0] == ref[0]).all() and (got[1] == ref[1]).all()
+            _, gt_ids = svc2.ground_truth_store()
+            have = set(int(x) for x in gt_ids)
+            assert set(int(x) for x in ids_a) <= have
+            assert set(int(x) for x in ids_b) <= have
+        finally:
+            svc2.close()
+
+    def test_snapshot_delta_exceeding_cap_raises(self, flat_index,
+                                                 rng, tmp_path):
+        svc = make_svc(flat_index, tmp_path, delta_cap=64)
+        svc.insert(np.arange(7000, 7040),
+                   rng.standard_normal((40, 16)).astype(np.float32))
+        svc.close()     # snapshot holds 40 delta rows
+        with pytest.raises(LogicError):
+            make_svc(None, tmp_path, delta_cap=16)
+
+    def test_dim_mismatch_restore_raises(self, flat_index, rng,
+                                         tmp_path):
+        svc = make_svc(flat_index, tmp_path)
+        svc.close()
+        other = ann.ivf_flat_build(
+            jnp.asarray(rng.standard_normal((300, 8)), jnp.float32),
+            ann.IVFFlatParams(nlist=4, nprobe=2), seed=SEED)
+        with pytest.raises(LogicError):
+            make_svc(other, tmp_path)
+
+    def test_persist_knobs_require_persist_dir(self, flat_index):
+        with pytest.raises(LogicError):
+            make_svc(flat_index, None, persist_fsync="always")
+
+    def test_bad_fsync_policy_at_construction(self, flat_index,
+                                              tmp_path):
+        with pytest.raises(LogicError):
+            make_svc(flat_index, tmp_path, persist_fsync="sometimes")
+
+    def test_index_none_without_state_raises(self, tmp_path):
+        with pytest.raises(LogicError):
+            make_svc(None, tmp_path)
+
+
+# --------------------------------------------------------------------- #
+# scrubbing
+# --------------------------------------------------------------------- #
+class TestScrubbing:
+    def _ooc_svc(self, flat_index, tmp_path, **kw):
+        store_b = int(np.asarray(flat_index.slot_vecs).nbytes)
+        return make_svc(flat_index, tmp_path, ooc=True,
+                        device_budget_bytes=max(store_b // 2, 4096),
+                        scrub_chunks=10_000,
+                        snapshot_interval_s=1e9, **kw)
+
+    def test_poisoned_slot_quarantined_and_rebuilt(self, flat_index,
+                                                   rng, tmp_path):
+        from raft_tpu.core import flight
+
+        svc = self._ooc_svc(flat_index, tmp_path)
+        try:
+            store = svc._ooc.store
+            orig = store[2].copy()
+            store[2] = 123.0                      # poison
+            boxes0 = len(flight.default_recorder().blackboxes())
+            svc.worker.run_maintenance()          # one full scrub cycle
+            ps = svc.stats()["persist"]
+            assert ps["last_scrub"]["errors"] >= 1
+            assert ps["last_scrub"]["rebuilt"] == 1
+            assert ps["last_scrub"]["last_error"]["repaired"]
+            # repaired damage does NOT latch corruption
+            assert not ps["corruption_detected"]
+            assert (store[2] == orig).all()
+            assert len(flight.default_recorder().blackboxes()) \
+                > boxes0
+        finally:
+            svc.close(snapshot=False)
+
+    def test_snapshot_file_corruption_detected(self, flat_index,
+                                               tmp_path):
+        svc = make_svc(flat_index, tmp_path, scrub_chunks=10_000)
+        try:
+            name = "snapshot-%010d" % svc._persist.snapshot_seq
+            apath = os.path.join(str(tmp_path), "snapshots", name,
+                                 "slot_vecs.bin")
+            _flip_byte(apath, 10)
+            svc.worker.run_maintenance()
+            ps = svc.stats()["persist"]
+            assert ps["corruption_detected"]
+            assert ps["last_scrub"]["last_error"]["where"] \
+                == "snapshot-file"
+        finally:
+            svc.close(snapshot=False)
+
+    def test_session_health_fails_on_corruption(self, flat_index,
+                                                tmp_path):
+        from raft_tpu.session import Session
+
+        with Session() as session:
+            svc = session.serve(kind="ann", index=flat_index, k=5,
+                                persist_dir=str(tmp_path),
+                                scrub_chunks=10_000,
+                                max_batch_rows=32,
+                                bucket_rungs=(8, 32), delta_cap=64,
+                                compact_rows=0, nprobe_ladder=(4, 8))
+            assert session.health_check()["ok"]
+            name = "snapshot-%010d" % svc._persist.snapshot_seq
+            _flip_byte(os.path.join(str(tmp_path), "snapshots", name,
+                                    "slot_vecs.bin"), 10)
+            svc.worker.run_maintenance()
+            report = session.health_check()
+            assert not report["ok"]
+            assert report["services"][svc.name]["persist"][
+                "corruption_detected"]
+
+    def test_scrub_disabled(self, flat_index, tmp_path):
+        svc = make_svc(flat_index, tmp_path, scrub_chunks=0)
+        try:
+            svc.worker.run_maintenance()
+            assert svc.stats()["persist"]["last_scrub"]["checked"] == 0
+        finally:
+            svc.close(snapshot=False)
+
+
+# --------------------------------------------------------------------- #
+# ooc restore + chaos + style ban
+# --------------------------------------------------------------------- #
+class TestEndToEnd:
+    def test_ooc_crash_restart_mmap(self, flat_index, rng, tmp_path):
+        store_b = int(np.asarray(flat_index.slot_vecs).nbytes)
+        kw = dict(ooc=True, device_budget_bytes=max(store_b // 2,
+                                                    4096))
+        svc = make_svc(flat_index, tmp_path, **kw)
+        svc.insert(np.arange(8000, 8008),
+                   rng.standard_normal((8, 16)).astype(np.float32))
+        q = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+        ref = _state_search(svc, q)
+        svc.close(snapshot=False)
+        svc2 = make_svc(None, tmp_path, persist_mmap=True, **kw)
+        try:
+            assert isinstance(svc2._ooc, OocIVFFlat)
+            assert isinstance(svc2._ooc.store, np.ndarray)
+            got = _state_search(svc2, q)
+            assert (got[0] == ref[0]).all() and (got[1] == ref[1]).all()
+        finally:
+            svc2.close(snapshot=False)
+
+    def test_loadgen_crash_restart_scenario(self, tmp_path):
+        from tools.loadgen import run_crash_restart
+
+        report = run_crash_restart(
+            str(tmp_path), index_rows=2500, dim=16, k=5, seed=SEED,
+            duration=1.5, concurrency=2, rows=4, nlist=16, clusters=8)
+        assert report["crash_ok"], report
+        assert report["no_insert_loss"]
+        assert report["bit_identical"]
+        assert report["wal_replayed_records"] > 0
+        assert report["post_restore_compiles"] == 0
+
+    def test_serialization_ban_selftest(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod._selftest_persist_io() == 0

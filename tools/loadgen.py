@@ -61,6 +61,15 @@ request resolves exactly once**, with a result or a *typed* error
 (``RaftError`` taxonomy).  Lost futures or untyped errors fail the run
 (exit 1).  ``stress.sh chaos N`` loops it with rotating seeds.
 
+``--crash-restart`` runs the **durability chaos scenario**
+(docs/PERSISTENCE.md): a persistent ANN service (``persist_dir``, WAL
+``fsync="always"``) under concurrent query + insert traffic dies
+mid-run with NO final snapshot, then rebuilds from the persist
+directory alone — asserting zero acknowledged-insert loss,
+bit-identical post-restore search vs a kept reference, typed-only
+errors, and 0 post-warmup compiles after restore (exit 1 otherwise).
+``stress.sh chaos N`` rotates it alongside the other chaos arms.
+
 ``--trace [K]`` captures the flight-recorder timelines of the K
 slowest requests (default 3) and prints their waterfalls next to the
 p99 row (docs/OBSERVABILITY.md "Flight recorder & request tracing");
@@ -980,6 +989,205 @@ def run_chaos(service, *, duration=6.0, concurrency=4, rows=4, seed=0,
     return report
 
 
+def run_crash_restart(persist_dir, *, index_rows=4000, dim=16, k=5,
+                      seed=0, duration=4.0, concurrency=3, rows=4,
+                      nlist=32, clusters=16, insert_rows=8,
+                      svc_opts=None):
+    """Crash-restart chaos scenario (docs/PERSISTENCE.md): drive a
+    **persistent** ANNService (WAL ``fsync="always"``, short snapshot
+    interval) with closed-loop queries plus a concurrent insert
+    stream, then simulate **process death mid-run** — drop the live
+    service with NO final snapshot — and rebuild a fresh service from
+    ``persist_dir`` alone (``ANNService(None, persist_dir=...)``).
+
+    ``crash_ok`` requires ALL of:
+
+    - **zero acknowledged-insert loss** — every id whose ``insert()``
+      returned before the crash is present in the restored service's
+      ground-truth store (the WAL acknowledge contract);
+    - **bit-identical search** — a reference result set captured from
+      the live service (after quiescing inserts) matches the restored
+      service's answers bit-for-bit, distances and ids;
+    - **exactly-once, typed-only** — every admitted future resolved
+      exactly once with a result or a typed ``RaftError`` (the crash
+      fails in-flight riders with typed errors, never silence);
+    - **0 post-warmup compiles** on the restored service — restore +
+      ``warmup()`` rebuilds the exact executables, nothing retraces.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.serve import ANNService
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build
+
+    rng = np.random.default_rng(seed)
+    ref_data = jnp.asarray(synth_data(index_rows, dim, seed=seed,
+                                      clusters=clusters))
+    index = ivf_flat_build(ref_data, IVFFlatParams(nlist=nlist,
+                                                   nprobe=8))
+    opts = dict(max_batch_rows=64, bucket_rungs=(8, 64),
+                max_wait_ms=1.0, delta_cap=2048, compact_rows=512,
+                nprobe_ladder=(4, 8))
+    opts.update(svc_opts or {})
+    svc = ANNService(index, k=k, persist_dir=persist_dir,
+                     persist_fsync="always",
+                     snapshot_interval_s=max(0.5, duration / 4),
+                     **opts)
+    svc.warmup()
+    pool = make_query_pool(ref_data, rows, n=8, seed=seed + 1)
+
+    lock = threading.Lock()
+    admitted = []
+    counts = {"submitted": 0, "sheds": 0}
+    acked_ids = []
+    stop_inserts = threading.Event()
+    stop_clients = threading.Event()
+
+    def client(tid):
+        i = tid
+        while not stop_clients.is_set():
+            q = pool[i % len(pool)]
+            i += concurrency
+            try:
+                fut = svc.submit(q)
+            except RaftError:
+                with lock:
+                    counts["sheds"] += 1
+                time.sleep(0.01)
+                continue
+            with lock:
+                counts["submitted"] += 1
+                admitted.append(fut)
+            fut.wait(timeout=5.0)
+
+    def inserter():
+        base = 10_000_000
+        n = 0
+        while not stop_inserts.is_set():
+            ids = np.arange(base + n, base + n + insert_rows)
+            vecs = rng.standard_normal(
+                (insert_rows, dim)).astype(np.float32)
+            try:
+                svc.insert(ids, vecs)
+            except RaftError:
+                time.sleep(0.02)
+                continue
+            with lock:
+                acked_ids.extend(int(x) for x in ids)
+            n += insert_rows
+            time.sleep(0.002)
+
+    threads = ([threading.Thread(target=client, args=(t,), daemon=True)
+                for t in range(concurrency)]
+               + [threading.Thread(target=inserter, daemon=True)])
+    for t in threads:
+        t.start()
+    time.sleep(duration * 0.5)
+    # quiesce inserts; then freeze interval snapshotting, take one
+    # catch-up snapshot (WAL drains to 0), and append a LAST
+    # acknowledged burst that only the WAL holds — the crash below
+    # lands with a guaranteed-non-empty WAL tail, so restore MUST
+    # exercise replay, not just snapshot load
+    stop_inserts.set()
+    threads[-1].join(timeout=10.0)
+    svc._persist.snapshot_interval_s = 1e9
+    time.sleep(0.1)   # let an in-flight maintenance tick finish
+    # fold the delta now: the restored service must not cross its own
+    # compact_rows threshold mid-probe (a compaction there grows the
+    # slot layout and pays a legitimate one-time layout compile,
+    # which would muddy the 0-post-warmup-compiles assertion)
+    svc.compact()
+    svc._persist.snapshot(svc._ann_state)
+    burst = np.arange(20_000_000, 20_000_000 + 2 * insert_rows)
+    svc.insert(burst, rng.standard_normal(
+        (burst.size, dim)).astype(np.float32))
+    with lock:
+        acked_ids.extend(int(x) for x in burst)
+    # the kept reference the restored service must reproduce
+    # bit-for-bit (queries only from here on — the served state is
+    # frozen; a snapshot would change nothing, and none will run)
+    reference = []
+    for q in pool:
+        out = svc.submit(q).result(timeout=30.0)
+        reference.append((np.asarray(out[0]).copy(),
+                          np.asarray(out[1]).copy()))
+    # keep querying, then die mid-traffic: the simulated process death
+    # takes NO final snapshot — restart must recover from the last
+    # interval snapshot plus the WAL tail
+    time.sleep(duration * 0.25)
+    svc.close(drain=False, timeout=2.0, snapshot=False)
+    stop_clients.set()
+    for t in threads[:-1]:
+        t.join(timeout=15.0)
+
+    results = {"ok": 0, "typed_errors": 0, "untyped_errors": 0,
+               "lost": 0}
+    for fut in admitted:
+        if not fut.wait(timeout=10.0):
+            results["lost"] += 1
+            continue
+        err = fut.exception(timeout=0)
+        if err is None:
+            results["ok"] += 1
+        elif isinstance(err, RaftError):
+            results["typed_errors"] += 1
+        else:
+            results["untyped_errors"] += 1
+    resolved = (results["ok"] + results["typed_errors"]
+                + results["untyped_errors"])
+
+    # rebuild from the persist directory alone
+    t0 = time.monotonic()
+    svc2 = ANNService(None, k=k, persist_dir=persist_dir,
+                      persist_fsync="always", **opts)
+    restore_s = time.monotonic() - t0
+    pstats = svc2._persist.stats()
+    svc2.warmup()
+    misses0 = _compile_misses()
+    identical = True
+    for q, (d_ref, i_ref) in zip(pool, reference):
+        out = svc2.submit(q).result(timeout=30.0)
+        if not ((np.asarray(out[0]) == d_ref).all()
+                and (np.asarray(out[1]) == i_ref).all()):
+            identical = False
+    post_restore_compiles = _compile_misses() - misses0
+    _, gt_ids = svc2.ground_truth_store()
+    missing = sorted(set(acked_ids) - set(int(x) for x in gt_ids))
+    svc2.close()
+
+    report = {
+        "seed": seed,
+        "duration_s": duration,
+        **counts,
+        **results,
+        "resolved": resolved,
+        "exactly_once": (results["lost"] == 0
+                         and resolved == counts["submitted"]),
+        "typed_only": results["untyped_errors"] == 0,
+        "acked_inserts": len(acked_ids),
+        "lost_inserts": len(missing),
+        "no_insert_loss": not missing,
+        "bit_identical": identical,
+        "restore_s": round(restore_s, 3),
+        "restored_snapshot_seq": pstats["snapshot_seq"],
+        "wal_replayed_records": pstats["replayed_records"],
+        "wal_replay_records_per_s": round(
+            pstats["replayed_records"] / max(restore_s, 1e-9), 1),
+        "post_restore_compiles": post_restore_compiles,
+    }
+    report["crash_ok"] = (report["exactly_once"]
+                          and report["typed_only"]
+                          and report["no_insert_loss"]
+                          and report["bit_identical"]
+                          # the scenario guarantees a WAL tail at the
+                          # crash (the post-snapshot burst): a restore
+                          # that replayed nothing did not recover it
+                          and report["wal_replayed_records"] > 0
+                          and post_restore_compiles == 0)
+    return report
+
+
 def _dump_flight(path):
     """Write the flight recorder's full state (ring + black boxes) to
     ``path`` and say so — the chaos postmortem artifact
@@ -1041,6 +1249,19 @@ def main(argv=None) -> int:
                          "recovery) instead of a load run; exits 1 "
                          "unless every submit resolved exactly once "
                          "with a result or typed error")
+    ap.add_argument("--crash-restart", action="store_true",
+                    help="run the crash-restart chaos scenario "
+                         "(docs/PERSISTENCE.md): persistent ANN "
+                         "service under query+insert traffic, "
+                         "simulated process death mid-run (no final "
+                         "snapshot), rebuild from --persist-dir; "
+                         "exits 1 unless zero acknowledged-insert "
+                         "loss, bit-identical post-restore search, "
+                         "typed-only errors, and 0 post-warmup "
+                         "compiles after restore all hold")
+    ap.add_argument("--persist-dir", default=None, metavar="DIR",
+                    help="durability directory for --crash-restart "
+                         "(default: a fresh temp dir, removed after)")
     ap.add_argument("--transient-p", type=float, default=0.05,
                     help="chaos: per-batch transient fault probability")
     ap.add_argument("--outage-s", type=float, default=0.8,
@@ -1110,6 +1331,52 @@ def main(argv=None) -> int:
                     help="print the raw report dict as JSON")
     args = ap.parse_args(argv)
 
+    if args.crash_restart:
+        if args.service != "ann":
+            raise SystemExit("--crash-restart drives the persistent "
+                             "ANN service (--service ann)")
+        import shutil
+        import tempfile
+
+        pdir = args.persist_dir
+        cleanup = pdir is None
+        if pdir is None:
+            pdir = tempfile.mkdtemp(prefix="raft_tpu_persist_")
+        svc_opts = {"max_batch_rows": args.max_batch_rows}
+        if args.max_wait_ms is not None:
+            svc_opts["max_wait_ms"] = args.max_wait_ms
+        if args.queue_cap is not None:
+            svc_opts["queue_cap"] = args.queue_cap
+        try:
+            report = run_crash_restart(
+                pdir, index_rows=args.index_rows, dim=args.dim,
+                k=args.k, seed=args.seed, duration=args.duration,
+                concurrency=args.concurrency, rows=args.rows,
+                nlist=args.nlist or 32,
+                clusters=args.clusters or 16, svc_opts=svc_opts)
+        finally:
+            if cleanup:
+                shutil.rmtree(pdir, ignore_errors=True)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print("== loadgen: ann crash-restart (seed=%d) =="
+                  % args.seed)
+            for key in ("duration_s", "submitted", "ok",
+                        "typed_errors", "untyped_errors", "lost",
+                        "sheds", "acked_inserts", "lost_inserts",
+                        "no_insert_loss", "bit_identical",
+                        "exactly_once", "typed_only", "restore_s",
+                        "restored_snapshot_seq",
+                        "wal_replayed_records",
+                        "wal_replay_records_per_s",
+                        "post_restore_compiles", "crash_ok"):
+                if key in report:
+                    print("  %-24s %s" % (key, report[key]))
+        if not report["crash_ok"]:
+            _dump_flight("flight_crash_restart_seed%d.json"
+                         % args.seed)
+        return 0 if report["crash_ok"] else 1
     opts = {"max_batch_rows": args.max_batch_rows}
     if args.max_wait_ms is not None:
         opts["max_wait_ms"] = args.max_wait_ms

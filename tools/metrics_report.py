@@ -77,6 +77,13 @@ def render_report(snap: dict) -> str:
         lines.append("== SLO burn & exemplars (docs/OBSERVABILITY.md "
                      "\"Flight recorder & request tracing\") ==")
         lines.extend(slo)
+    persist = _persist_summary(metrics)
+    if persist:
+        # standalone section (not nested under serving): a persistent
+        # service that has not dispatched a batch yet still has
+        # durable state worth one screen
+        lines.append("== durability (docs/PERSISTENCE.md) ==")
+        lines.extend(persist)
     tuning = _tuning_summary(metrics)
     if tuning:
         lines.append("== tuning (docs/TUNING.md \"Bench-driven "
@@ -180,6 +187,63 @@ def _serve_summary(metrics: dict) -> list:
     lines.extend(_serve_resilience_summary(metrics))
     lines.extend(_serve_ann_summary(metrics))
     lines.extend(_serve_ooc_summary(metrics))
+    return lines
+
+
+def _persist_summary(metrics: dict) -> list:
+    """Durability digest (docs/PERSISTENCE.md): per-service snapshot
+    age/bytes/latency, WAL depth and replay history, scrub progress
+    and corruption count — the one screen that answers "how much
+    acknowledged work would a crash right now lose, and is the durable
+    copy still intact"."""
+
+    def per_service(name):
+        fam = metrics.get(name, {})
+        return {s["labels"].get("service"): s
+                for s in fam.get("series", [])
+                if s["labels"].get("service") is not None}
+
+    snaps = per_service("raft_tpu_persist_snapshots_total")
+    age = per_service("raft_tpu_persist_snapshot_age_seconds")
+    sbytes = per_service("raft_tpu_persist_snapshot_bytes")
+    stimer = per_service("raft_tpu_persist_snapshot_seconds")
+    wal_rec = per_service("raft_tpu_persist_wal_records")
+    wal_b = per_service("raft_tpu_persist_wal_bytes")
+    replayed = per_service("raft_tpu_persist_wal_replayed_total")
+    restores = per_service("raft_tpu_persist_restores_total")
+    checked = per_service("raft_tpu_scrub_checked_total")
+    corrupt = per_service("raft_tpu_scrub_corruption_total")
+    rebuilt = per_service("raft_tpu_scrub_rebuilt_slots_total")
+    progress = per_service("raft_tpu_scrub_progress")
+    # union: a just-restored service may not have snapshotted yet but
+    # its restore/replay rows still belong on this screen
+    services = set(snaps) | set(restores) | set(wal_rec)
+    if not services:
+        return []
+    lines = []
+    for svc in sorted(services):
+        st = stimer.get(svc)
+        lines.append(
+            "  %-24s snapshots=%-4d age=%-8s bytes=%-10d "
+            "write_mean=%s  wal: records=%d bytes=%d"
+            % (svc, int(snaps.get(svc, {}).get("value", 0)),
+               "%.1fs" % age[svc]["value"] if svc in age else "-",
+               int(sbytes.get(svc, {}).get("value", 0)),
+               _fmt_s(st["mean"]) if st else "-",
+               int(wal_rec.get(svc, {}).get("value", 0)),
+               int(wal_b.get(svc, {}).get("value", 0))))
+        nres = int(restores.get(svc, {}).get("value", 0))
+        nchk = int(checked.get(svc, {}).get("value", 0))
+        ncor = int(corrupt.get(svc, {}).get("value", 0))
+        if nres or nchk or ncor:
+            lines.append(
+                "  %-24s   restores=%d replayed=%d  scrub: checked=%d "
+                "progress=%.0f%% corruption=%d rebuilt_slots=%d"
+                % ("", nres,
+                   int(replayed.get(svc, {}).get("value", 0)), nchk,
+                   100.0 * progress.get(svc, {}).get("value", 0.0),
+                   ncor,
+                   int(rebuilt.get(svc, {}).get("value", 0))))
     return lines
 
 
