@@ -64,6 +64,17 @@ Checks, per Python source file:
   ``ServiceOverloadError(msg, depth, cap)`` silently hands back the
   0.0 default — a shed site with genuinely no estimate marks the line
   ``shed-hint-ok``.
+- no jax reachable from the ops plane
+  (``raft_tpu/serve/opsplane.py`` / ``sentinel.py``): every ops HTTP
+  handler and sentinel rule reads host-side snapshots only — a jax
+  call on a scrape path could compile, block the worker loop, or
+  perturb the zero-post-warmup-compiles invariant
+  (docs/OBSERVABILITY.md "Ops plane").  Total ban per module
+  (imports, from-imports, any ``jax`` name use); a deliberate
+  exception marks its line ``ops-jax-ok``.  (The ops server's daemon
+  threads are legal by construction: the module lives in
+  ``raft_tpu/serve/``, the raw-``threading.Thread`` ban's allowlisted
+  home.)
 - metric docs drift: every ``raft_tpu_*`` metric name registered in
   ``raft_tpu/`` (a string literal inside a
   counter/gauge/timer/labeled registry call) must appear in
@@ -168,6 +179,17 @@ METRIC_CALL_HINTS = ("counter", "gauge", "timer", "labeled")
 PERSIST_IO_MARKER = "persist-io-ok"
 PICKLE_MODULES = ("pickle", "cPickle", "_pickle", "dill", "cloudpickle")
 NP_SAVE_ATTRS = ("save", "savez", "savez_compressed")
+
+# ops-plane jax ban (raft_tpu/serve/opsplane.py + sentinel.py): every
+# ops HTTP handler and sentinel rule reads host-side snapshots ONLY —
+# a jax call reachable from a scrape could compile, block the worker
+# loop, or perturb the zero-post-warmup-compiles invariant
+# (docs/OBSERVABILITY.md "Ops plane").  The ban is total for these
+# modules: no `import jax`, no `from jax import ...`, no `jax.`
+# attribute use.  A deliberate exception marks its line `ops-jax-ok`.
+OPS_JAX_FILES = (os.path.join("raft_tpu", "serve", "opsplane.py"),
+                 os.path.join("raft_tpu", "serve", "sentinel.py"))
+OPS_JAX_MARKER = "ops-jax-ok"
 
 # tuning-registry drift lint: every config._KNOBS entry with a non-None
 # choices whitelist is a registry-owned impl knob and MUST have a
@@ -384,6 +406,7 @@ def check_file(path, doc_text=None, repo_root=None):
     in_serial_scope = rel.startswith("raft_tpu" + os.sep)
     in_mnmg_jit_scope = rel in MNMG_JIT_FILES
     in_ooc_put_scope = rel in OOC_PUT_FILES
+    in_ops_jax_scope = rel in OPS_JAX_FILES
     in_tune_scope = (rel.startswith("raft_tpu" + os.sep)
                      and rel not in TUNE_EXEMPT)
     src_lines = src.splitlines()
@@ -566,6 +589,30 @@ def check_file(path, doc_text=None, repo_root=None):
                     "SPMD programs compile through profiled_jit "
                     "(docs/SERVING.md); mark deliberate exceptions "
                     f"`{MNMG_JIT_MARKER}`")
+        if in_ops_jax_scope:
+            flagged = None
+            if isinstance(node, ast.Import):
+                if any(a.name == "jax" or a.name.startswith("jax.")
+                       for a in node.names):
+                    flagged = node.lineno
+            elif (isinstance(node, ast.ImportFrom) and node.module
+                    and node.module.split(".")[0] == "jax"):
+                flagged = node.lineno
+            elif (isinstance(node, ast.Name) and node.id == "jax"
+                    and isinstance(node.ctx, ast.Load)):
+                # bare-name use covers jax.<anything> attribute chains
+                # AND aliasing (j = jax) — total ban, not a call list
+                flagged = node.lineno
+            if (flagged is not None
+                    and OPS_JAX_MARKER
+                    not in src_lines[flagged - 1]):
+                problems.append(
+                    f"{rel}:{flagged}: jax reachable from the ops "
+                    "plane — handlers/sentinel rules read host-side "
+                    "snapshots only; a scrape must never compile or "
+                    "block the worker loop (docs/OBSERVABILITY.md "
+                    f"\"Ops plane\"); mark a deliberate exception "
+                    f"`{OPS_JAX_MARKER}`")
         if in_ooc_put_scope:
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -698,6 +745,48 @@ def selftest():
           % (len(cases), failures), file=sys.stderr)
     failures += _selftest_tuning()
     failures += _selftest_persist_io()
+    failures += _selftest_ops_jax()
+    return failures
+
+
+def _selftest_ops_jax():
+    """Executable fixtures for the ops-plane jax ban: imports,
+    from-imports, attribute chains and aliasing are flagged inside the
+    banned modules; the ``ops-jax-ok`` marker escapes; jax-free code
+    and other serve modules pass."""
+    import tempfile
+
+    cases = [
+        # (filename, source, expect_flagged)
+        ("opsplane.py", "import jax\n", True),
+        ("opsplane.py", "import jax.numpy as jnp\n", True),
+        ("opsplane.py", "from jax import jit\n", True),
+        ("opsplane.py", "from jax.sharding import Mesh\n", True),
+        ("opsplane.py", "x = jax.devices()\n", True),
+        ("opsplane.py", "j = jax\n", True),
+        ("opsplane.py", "import jax  # ops-jax-ok: fixture\n", False),
+        ("opsplane.py", "import json\nx = json.dumps({})\n", False),
+        ("sentinel.py", "import jax\n", True),
+        # the ban is scoped: the rest of serve/ may use jax freely
+        ("scheduler.py", "import jax\n", False),
+    ]
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        fixdir = os.path.join(tmp, "raft_tpu", "serve")
+        os.makedirs(fixdir)
+        for i, (fname, src, expect) in enumerate(cases):
+            path = os.path.join(fixdir, fname)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(src)
+            probs = [p for p in check_file(path, repo_root=tmp)
+                     if "ops plane" in p]
+            if bool(probs) != expect:
+                failures += 1
+                print("ops-jax fixture %d (%s): expected flagged=%s, "
+                      "got %r" % (i, fname, expect, probs),
+                      file=sys.stderr)
+    print("ops-jax lint selftest: %d fixtures, %d failures"
+          % (len(cases), failures), file=sys.stderr)
     return failures
 
 

@@ -195,6 +195,40 @@ persist_scrub_chunks
     Integrity-scrub units (snapshot chunks / out-of-core host-store
     slots) re-checksummed per maintenance tick; ``0`` disables the
     background scrubber.  Free-form int; runtime-resolved.
+ops_healthz_ttl_s
+    TTL of the ops plane's cached full ``health_check()`` verdict
+    (``/healthz?full=1``; docs/OBSERVABILITY.md "Ops plane"): scrapes
+    within the window share one battery run.  Free-form float;
+    runtime-resolved at :class:`raft_tpu.serve.opsplane.OpsPlane`
+    construction.
+ops_sentinel_interval_s
+    Minimum seconds between anomaly-sentinel evaluations
+    (:mod:`raft_tpu.serve.sentinel`) — both the worker-seam pokes and
+    the ops plane's fallback ticker rate-limit to it.  Free-form
+    float; runtime-resolved.
+ops_sentinel_latency_factor
+    Breach multiplier for the ``exec_latency`` rule: a service's
+    windowed mean exec latency above this many times its rolling
+    (breach-frozen) baseline trips the sentinel.  Free-form float; runtime-resolved.
+ops_sentinel_min_samples
+    Minimum observed batches (and per-tenant SLO outcomes) before the
+    baseline-relative rules may judge — cold-start noise must not
+    trip alarms.  Free-form int; runtime-resolved.
+ops_sentinel_queue_frac
+    ``queue_depth`` rule threshold as a fraction of the service's
+    admission cap.  Free-form float in (0, 1]; runtime-resolved.
+ops_sentinel_burn
+    ``slo_burn`` rule threshold on the shortest-window error-budget
+    burn rate (1.0 = budget spent exactly as fast as it accrues).
+    Free-form float; runtime-resolved.
+ops_sentinel_wal_records
+    ``wal_depth`` rule threshold: un-snapshotted write-ahead-log
+    records above this mean snapshots stopped containing the journal.
+    Free-form int; runtime-resolved.
+ops_sentinel_stall_frac
+    ``tile_stall`` rule threshold on the exposed-stall fraction of
+    H2D transfer time over the last window (the prefetch stopped
+    hiding transfers).  Free-form float in (0, 1]; runtime-resolved.
 """
 
 from __future__ import annotations
@@ -266,6 +300,20 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
                             "0.99", None),
     "serve_slo_windows_s": ("RAFT_TPU_SERVE_SLO_WINDOWS_S",
                             "60,300", None),
+    "ops_healthz_ttl_s": ("RAFT_TPU_OPS_HEALTHZ_TTL_S", "15", None),
+    "ops_sentinel_interval_s": ("RAFT_TPU_OPS_SENTINEL_INTERVAL_S",
+                                "1", None),
+    "ops_sentinel_latency_factor": (
+        "RAFT_TPU_OPS_SENTINEL_LATENCY_FACTOR", "3", None),
+    "ops_sentinel_min_samples": ("RAFT_TPU_OPS_SENTINEL_MIN_SAMPLES",
+                                 "20", None),
+    "ops_sentinel_queue_frac": ("RAFT_TPU_OPS_SENTINEL_QUEUE_FRAC",
+                                "0.8", None),
+    "ops_sentinel_burn": ("RAFT_TPU_OPS_SENTINEL_BURN", "2", None),
+    "ops_sentinel_wal_records": ("RAFT_TPU_OPS_SENTINEL_WAL_RECORDS",
+                                 "100000", None),
+    "ops_sentinel_stall_frac": ("RAFT_TPU_OPS_SENTINEL_STALL_FRAC",
+                                "0.5", None),
 }
 
 # knobs resolved at *runtime* (service/object construction), never baked
@@ -282,7 +330,11 @@ _RUNTIME_KNOBS = frozenset(
      "serve_hedge_ms", "serve_hedge_factor", "serve_hedge_min_ms",
      "flight_events", "serve_slo_target_ms", "serve_slo_objective",
      "serve_slo_windows_s", "persist_fsync",
-     "persist_snapshot_interval_s", "persist_scrub_chunks"))
+     "persist_snapshot_interval_s", "persist_scrub_chunks",
+     "ops_healthz_ttl_s", "ops_sentinel_interval_s",
+     "ops_sentinel_latency_factor", "ops_sentinel_min_samples",
+     "ops_sentinel_queue_frac", "ops_sentinel_burn",
+     "ops_sentinel_wal_records", "ops_sentinel_stall_frac"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

@@ -1,0 +1,189 @@
+"""XLA program cost inventory: per-executable flops/bytes/footprint.
+
+The compile-cache accounting (:func:`raft_tpu.core.profiler.
+compile_cache_stats`) answers *when* a program compiled and how long
+the compile took; this module answers *what the compiler thinks the
+program costs*: every executable produced at :func:`profiled_jit`'s
+AOT lower/compile seam is interrogated once — ``compiled.
+cost_analysis()`` (flops, bytes accessed) and ``compiled.
+memory_analysis()`` (argument / output / temp footprints) — and the
+answers are kept in a process-wide inventory keyed exactly like the
+compile cache: (fn, input-aval key).
+
+Why it matters for serving (docs/OBSERVABILITY.md "Ops plane"): after
+``warmup()`` the executable set is CLOSED (the zero-post-warmup-
+compiles invariant), so the inventory is a complete static picture of
+the serving working set — summing the per-program footprints gives
+the first device-capacity number the stack has ("how much HBM do my
+warmed programs pin"), and dividing a program's flops by its measured
+execution time gives a roofline-style achieved-throughput figure per
+executable family (``tools/metrics_report.py`` renders both).
+
+Everything here is host-side Python over numbers the compiler already
+produced: capturing an entry costs one dict walk at compile time (a
+cache miss — never the steady-state hot path), reading the inventory
+costs a lock + dict copy.  The module never imports jax — the
+``compiled`` object is handed in by the profiler — so the ops-plane
+handlers can read it under the same no-jax static ban as every other
+scrape (``ci/style_check.py`` ``ops-jax-ban``).
+
+Metrics (labels ``fn``, ``entry`` — ``entry`` is a short stable hash
+of the shape key, full detail in :func:`snapshot`):
+
+- ``raft_tpu_program_flops``      — cost-model flop count
+- ``raft_tpu_program_bytes``      — cost-model bytes accessed
+- ``raft_tpu_program_hbm_bytes``  — argument+output+temp footprint
+
+Backends that cannot answer (``cost_analysis`` raising, absent
+``memory_analysis``) record zeros rather than failing the compile —
+the inventory is observability, never a correctness gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Optional
+
+from raft_tpu.core import metrics as _metrics
+
+__all__ = [
+    "note_compiled", "snapshot", "summary", "reset", "entry_count",
+]
+
+_lock = threading.Lock()
+# fn_name -> {key_repr: entry dict}
+_entries: Dict[str, Dict[str, dict]] = {}
+
+
+def _slug(key_repr: str) -> str:
+    """Short stable id for one (fn, shape) entry — the ``entry`` metric
+    label (full key reprs are label-hostile: long, brace-heavy)."""
+    return hashlib.sha1(key_repr.encode("utf-8")).hexdigest()[:10]
+
+
+def _cost_analysis(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one flat dict.
+    jax returns a list with one dict per module on some versions, a
+    plain dict on others, None/raise where the backend cannot say."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
+def _memory_analysis(compiled):
+    try:
+        return compiled.memory_analysis()
+    except Exception:
+        return None
+
+
+def note_compiled(fn_name: str, key, compiled) -> Optional[dict]:
+    """Record one freshly AOT-compiled executable's cost picture.
+
+    Called by :func:`raft_tpu.core.profiler.profiled_jit` on its
+    compile-cache miss path (the one place executables are born); the
+    lazy fallback path has no ``Compiled`` object and records nothing.
+    Never raises — a backend that cannot be interrogated must not turn
+    a working compile into a failure.
+    """
+    try:
+        key_repr = repr(key)
+        ca = _cost_analysis(compiled)
+        ma = _memory_analysis(compiled)
+
+        def _f(d, name):
+            try:
+                return float(d.get(name, 0.0) or 0.0)
+            except (TypeError, ValueError):
+                return 0.0
+
+        arg_b = out_b = tmp_b = code_b = 0.0
+        if ma is not None:
+            arg_b = float(getattr(ma, "argument_size_in_bytes", 0) or 0)
+            out_b = float(getattr(ma, "output_size_in_bytes", 0) or 0)
+            tmp_b = float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+            code_b = float(
+                getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        entry = {
+            "entry": _slug(key_repr),
+            "flops": _f(ca, "flops"),
+            "bytes_accessed": _f(ca, "bytes accessed"),
+            "argument_bytes": arg_b,
+            "output_bytes": out_b,
+            "temp_bytes": tmp_b,
+            "code_bytes": code_b,
+            # the capacity number: what this executable pins while it
+            # runs (arguments in, outputs out, temps during)
+            "hbm_bytes": arg_b + out_b + tmp_b,
+        }
+        with _lock:
+            _entries.setdefault(fn_name, {})[key_repr] = entry
+        reg = _metrics.default_registry()
+        for mname, val, help in (
+                ("raft_tpu_program_flops", entry["flops"],
+                 "XLA cost-model flop count per compiled executable"),
+                ("raft_tpu_program_bytes", entry["bytes_accessed"],
+                 "XLA cost-model bytes accessed per compiled "
+                 "executable"),
+                ("raft_tpu_program_hbm_bytes", entry["hbm_bytes"],
+                 "argument+output+temp device footprint per compiled "
+                 "executable")):
+            reg.gauge(mname, help=help, labels=("fn", "entry")).labels(
+                fn=fn_name, entry=entry["entry"]).set(val)
+        return entry
+    except Exception:
+        # observability must never fail the compile it observes
+        return None
+
+
+def snapshot() -> Dict[str, Dict[str, dict]]:
+    """Plain-dict copy: ``{fn: {key_repr: entry}}`` (every entry also
+    carries its short ``entry`` slug — the metric-label join key)."""
+    with _lock:
+        return {fn: {k: dict(e) for k, e in keys.items()}
+                for fn, keys in _entries.items()}
+
+
+def entry_count() -> int:
+    with _lock:
+        return sum(len(keys) for keys in _entries.values())
+
+
+def summary() -> dict:
+    """Per-fn rollup + the device-capacity line: program counts, the
+    largest single-program cost, and the summed footprint of every
+    inventoried executable (after warmup: the whole serving working
+    set; docs/OBSERVABILITY.md "Ops plane")."""
+    snap = snapshot()
+    per_fn = {}
+    total_hbm = 0.0
+    total_programs = 0
+    for fn, keys in sorted(snap.items()):
+        flops = [e["flops"] for e in keys.values()]
+        hbm = sum(e["hbm_bytes"] for e in keys.values())
+        per_fn[fn] = {
+            "programs": len(keys),
+            "max_flops": max(flops) if flops else 0.0,
+            "total_flops": sum(flops),
+            "total_bytes_accessed": sum(
+                e["bytes_accessed"] for e in keys.values()),
+            "total_hbm_bytes": hbm,
+        }
+        total_hbm += hbm
+        total_programs += len(keys)
+    return {"programs": total_programs,
+            "total_hbm_bytes": total_hbm,
+            "per_fn": per_fn}
+
+
+def reset() -> None:
+    """Drop every inventoried entry (test isolation).  Gauges already
+    published stay in the registry until its own reset — the registry
+    owns metric lifetime, the inventory owns the detail dicts."""
+    with _lock:
+        _entries.clear()

@@ -415,7 +415,9 @@ class MetricsRegistry:
         Timers render as summaries: ``<name>{quantile="..."}``,
         ``<name>_sum``, ``<name>_count``, plus a ``<name>_max`` gauge
         (exact lifetime max, which quantiles over a reservoir can't
-        promise).  Gauges additionally expose ``<name>_high_water``.
+        promise).  Gauges additionally expose ``<name>_peak`` — the
+        high-water mark, so a scraper sees the same peak the JSON
+        snapshot carries without needing a second metric.
         """
         lines = []
         for name, fam in sorted(self.snapshot().items()):
@@ -430,7 +432,7 @@ class MetricsRegistry:
                     lines.append("%s %r" % (_fmt(name, lbl), s["value"]))
                 elif kind == "gauge":
                     lines.append("%s %r" % (_fmt(name, lbl), s["value"]))
-                    lines.append("%s %r" % (_fmt(name + "_high_water", lbl),
+                    lines.append("%s %r" % (_fmt(name + "_peak", lbl),
                                             s["high_water"]))
                 else:
                     for q, v in (("0.5", s["p50"]), ("0.95", s["p95"])):

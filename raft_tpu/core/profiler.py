@@ -40,6 +40,7 @@ import time
 import warnings
 from typing import Dict, Optional, Tuple
 
+from raft_tpu.core import inventory as _inventory
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core import tracing
 
@@ -447,6 +448,10 @@ def profiled_jit(fn=None, *, name: Optional[str] = None,
                     compiled = jitted.lower(
                         **static_kw, **dyn_kw).compile()
                     entry = ("aot", compiled)
+                    # cost inventory (docs/OBSERVABILITY.md "Ops
+                    # plane"): the executable is interrogated ONCE,
+                    # here, where it is born — never on the hit path
+                    _inventory.note_compiled(fn_name, key, compiled)
                 except Exception:
                     pass
             out = None

@@ -23,7 +23,13 @@ padded device call per shape bucket, so
   ordering live in :mod:`~raft_tpu.serve.batcher`; replica groups over
   disjoint sub-meshes with hedged re-dispatch of straggling batches
   live in :mod:`~raft_tpu.serve.replicas` (docs/SERVING.md "Traffic
-  shaping").
+  shaping"),
+- the live ops plane — an embedded jax-free HTTP endpoint
+  (``/metrics`` / ``/healthz`` / ``/statusz`` / ``/debug/*``) lives in
+  :mod:`~raft_tpu.serve.opsplane`, and the anomaly sentinel that
+  watches the recorded vitals and flips it degraded lives in
+  :mod:`~raft_tpu.serve.sentinel` (docs/OBSERVABILITY.md "Ops
+  plane").
 
 Every layer also records into the flight recorder
 (:mod:`raft_tpu.core.flight`; docs/OBSERVABILITY.md "Flight recorder &
@@ -40,6 +46,8 @@ maintenance failures included), ``self_heal()`` recovers them, and
 
 from raft_tpu.serve.ann_service import ANNService  # noqa: F401
 from raft_tpu.serve.batcher import MicroBatcher, ServeFuture  # noqa: F401
+from raft_tpu.serve.opsplane import OpsPlane  # noqa: F401
+from raft_tpu.serve.sentinel import AnomalySentinel  # noqa: F401
 from raft_tpu.serve.bucketing import (  # noqa: F401
     BucketPolicy,
     coalesce,
@@ -74,4 +82,5 @@ __all__ = [
     "BreakerState", "CircuitBreaker", "RecoveryManager",
     "ServeFaultInjector", "inject_worker",
     "ReplicaSet", "ReplicaFaultInjector", "inject_replica", "split_mesh",
+    "OpsPlane", "AnomalySentinel",
 ]

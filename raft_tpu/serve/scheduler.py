@@ -61,6 +61,7 @@ import jax.numpy as jnp
 from raft_tpu.core import flight
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import CommTimeoutError, expects
+from raft_tpu.serve import sentinel as _sentinel
 from raft_tpu.serve.batcher import MicroBatcher, _Request
 from raft_tpu.serve.bucketing import BucketPolicy, coalesce, pad_rows
 
@@ -402,6 +403,12 @@ class ServeWorker:
         — a pipelined in-flight batch keeps it set) so ``drain``
         observes maintenance as work in progress: after ``drain()``
         returns, no compaction is mid-flight.  Never raises."""
+        # the anomaly sentinel rides the maintenance seam
+        # (docs/OBSERVABILITY.md "Ops plane"): a loaded serving
+        # process notices a breach within one batch cycle without a
+        # dedicated watcher thread.  Rate-limited + exception-proof
+        # inside; a no-op when no ops plane registered a sentinel.
+        _sentinel.poke()
         fn = self._maintenance
         if fn is None:
             return

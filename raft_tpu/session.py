@@ -35,6 +35,7 @@ import numpy as np
 from raft_tpu.comms import HostComms, default_mesh, selftest
 from raft_tpu.comms.resilience import RetryPolicy
 from raft_tpu.core import flight as _flight
+from raft_tpu.core import inventory as _inventory
 from raft_tpu.core import metrics as _metrics
 from raft_tpu.core import profiler as _profiler
 from raft_tpu.core import tracing
@@ -139,6 +140,7 @@ class Comms:
         self.handle: Optional[Handle] = None
         self._handles: List[Handle] = []
         self._services: Dict[str, object] = {}
+        self._ops_plane = None
         self._owns_distributed = False
 
     # -- lifecycle (reference init/destroy, comms.py:171,228) ---------- #
@@ -250,6 +252,9 @@ class Comms:
                 _sessions.pop(self.sessionId, None)
             return
         try:
+            # ops plane first: scrapers must stop reading service
+            # state before the services it reports on are drained
+            self._close_ops_plane()
             self._close_services()
             self._teardown()
         finally:
@@ -266,6 +271,14 @@ class Comms:
             try:
                 from raft_tpu.mr.buffer import default_zeros_pool
                 default_zeros_pool().release()
+            except Exception:
+                pass
+
+    def _close_ops_plane(self) -> None:
+        plane, self._ops_plane = self._ops_plane, None
+        if plane is not None:
+            try:
+                plane.close()
             except Exception:
                 pass
 
@@ -563,6 +576,40 @@ class Comms:
         """Registered serve services by name (read-only view)."""
         return dict(self._services)
 
+    def serve_ops(self, port: int = 0, **kwargs):
+        """Start the embedded ops plane over this session
+        (docs/OBSERVABILITY.md "Ops plane"): an HTTP endpoint on a
+        daemon thread serving ``/metrics`` (Prometheus), ``/healthz``
+        (cheap liveness + the anomaly sentinel's degraded flag;
+        ``?full=1`` runs the session battery behind a TTL cache),
+        ``/statusz``, ``/debug/traces``, ``/debug/config``,
+        ``/debug/inventory``, ``/debug/snapshot`` and
+        ``POST /debug/blackbox``.  Every handler reads immutable
+        host-side snapshots — a scrape can never compile or perturb
+        serving (the static no-jax ban, ``ci/style_check.py``).
+
+        ``port=0`` binds an ephemeral port (read ``plane.port``);
+        ``kwargs`` forward to
+        :class:`~raft_tpu.serve.opsplane.OpsPlane` (``host=``,
+        ``sentinel=``, ``healthz_ttl_s=``, ...).  One plane per
+        session; :meth:`destroy` closes it before draining services.
+        """
+        expects(self.initialized, "serve_ops: session not initialized")
+        # a manually closed plane must not brick the session: only a
+        # LIVE plane blocks a second one
+        expects(self._ops_plane is None or self._ops_plane.closed,
+                "serve_ops: this session already has a live ops "
+                "plane (close it first)")
+        from raft_tpu.serve.opsplane import OpsPlane
+
+        self._ops_plane = OpsPlane(session=self, port=port, **kwargs)
+        return self._ops_plane
+
+    @property
+    def ops_plane(self):
+        """The session's live ops plane, or None."""
+        return self._ops_plane
+
     # -- observability (docs/OBSERVABILITY.md) ------------------------- #
     def metrics_snapshot(self) -> Dict:
         """One self-contained observability artifact for this process:
@@ -646,6 +693,11 @@ def metrics_snapshot() -> Dict:
     # SLO trackers publishes their gauges, which the registry snapshot
     # below must already see.
     fl = _flight.flight_snapshot()
+    # program cost inventory (docs/OBSERVABILITY.md "Ops plane"):
+    # per-executable flops/bytes/footprint summary + full detail —
+    # after warmup this is the complete serving working set
+    inv = _inventory.summary()
+    inv["detail"] = _inventory.snapshot()
     return {
         "metrics": _metrics.default_registry().snapshot(),
         "compile_cache": _profiler.compile_cache_stats(),
@@ -653,6 +705,7 @@ def metrics_snapshot() -> Dict:
         "profiler_report": _profiler.default_profiler().report(),
         "event_counters": tracing.counters(),
         "flight": fl,
+        "inventory": inv,
     }
 
 

@@ -41,4 +41,11 @@ if [[ "${1:-}" == "--persist" ]]; then
     shift
     exec python -m pytest tests/ -q -m persist "$@"
 fi
+# --ops: only the ops-plane suite (embedded HTTP endpoint, program
+# cost inventory, anomaly sentinel, scrape-under-traffic; also part
+# of the default invocation)
+if [[ "${1:-}" == "--ops" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m ops "$@"
+fi
 exec python -m pytest tests/ -q "$@"

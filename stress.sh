@@ -102,6 +102,23 @@ if [[ "${1:-}" == "chaos" ]]; then
     done
     exit 0
 fi
+if [[ "${1:-}" == "ops" ]]; then
+    # ops-scrape-under-load loop (docs/OBSERVABILITY.md "Ops plane"):
+    # an embedded ops plane scraped at 1 Hz mid-load; every scrape
+    # must succeed, the scraped window must perform 0 post-warmup
+    # compiles, and QPS must stay within noise of the unscraped
+    # baseline — plus the concurrent-scrape test suite (`ops` marker)
+    # shaking handler/worker interleavings with a rotating seed
+    n="${2:-10}"
+    for i in $(seq 1 "$n"); do
+        echo "== ops scrape stress $i/$n (seed=$i) =="
+        python tools/loadgen.py --ops-port 0 --seed "$i" --duration 4 \
+            --concurrency 4 --index-rows 3000 --dim 16 --k 5 \
+            --max-batch-rows 64 --max-wait-ms 1
+        RAFT_TPU_SERVE_SEED="$i" python -m pytest tests/ -q -m ops
+    done
+    exit 0
+fi
 if [[ "${1:-}" == "tenants" ]]; then
     n="${2:-10}"
     for i in $(seq 1 "$n"); do

@@ -196,7 +196,10 @@ class TestPrometheus:
         assert (parsed["raft_tpu_test_bytes_total"]
                 [(("verb", "allreduce"),)] == 4096)
         assert parsed["raft_tpu_test_live_bytes"][()] == 40
-        assert parsed["raft_tpu_test_live_bytes_high_water"][()] == 100
+        # gauge peaks export as a _peak-suffixed series (the JSON
+        # snapshot's high_water field, scraper-visible)
+        assert parsed["raft_tpu_test_live_bytes_peak"][()] == 100
+        assert "raft_tpu_test_live_bytes_high_water" not in parsed
         assert parsed["raft_tpu_test_lat_seconds_count"][()] == 5
         assert parsed["raft_tpu_test_lat_seconds_sum"][()] == (
             pytest.approx(0.110))
@@ -917,12 +920,16 @@ class TestSessionSnapshot:
         loaded = json.loads(path.read_text())
         assert set(loaded) == {"metrics", "compile_cache",
                                "profiler_tree", "profiler_report",
-                               "event_counters", "flight"}
+                               "event_counters", "flight", "inventory"}
         assert loaded["metrics"].keys() == written["metrics"].keys()
         # the flight section (docs/OBSERVABILITY.md "Flight recorder &
         # request tracing") rides in every artifact
         assert {"enabled", "events", "capacity", "blackboxes", "slo",
                 "exemplars"} <= set(loaded["flight"])
+        # the program cost inventory (docs/OBSERVABILITY.md "Ops
+        # plane") does too: {fn: {key: entry}} detail + the summary
+        assert {"programs", "total_hbm_bytes", "per_fn",
+                "detail"} <= set(loaded["inventory"])
 
     def test_module_level_snapshot_matches_session(self):
         from raft_tpu import session as session_mod

@@ -1,0 +1,830 @@
+"""Ops plane (raft_tpu.serve.opsplane + sentinel + core.inventory):
+embedded telemetry endpoint, XLA program cost inventory, anomaly
+sentinel (docs/OBSERVABILITY.md "Ops plane").
+
+Covers: inventory capture at profiled_jit's compile seam (nonzero
+cost-model numbers, snapshot/summary shapes, the metrics_snapshot
+section), every HTTP endpoint's contract (content, status codes,
+_peak gauge series, 404/405/500 taxonomy, request accounting),
+TTL-cached full health, sentinel rule state machines under a fake
+clock (trip-once semantics, breach-frozen baselines, clearance),
+the end-to-end injected-latency trip with its black-box tape,
+16-thread scrape-under-traffic bit-identity, session serve_ops
+lifecycle, the loadgen ops-scrape scenario, and the ops-jax-ban lint.
+``./stress.sh ops N`` loops this file with rotating seeds.
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import config
+from raft_tpu.comms import faults
+from raft_tpu.core import flight
+from raft_tpu.core import inventory
+from raft_tpu.core.metrics import default_registry, parse_prometheus
+from raft_tpu.core.profiler import compile_cache_stats, profiled_jit
+from raft_tpu.serve import AnomalySentinel, KNNService, OpsPlane
+from raft_tpu.serve import sentinel as sentinel_mod
+from raft_tpu.serve.resilience import inject_worker
+from raft_tpu.spatial.knn import brute_force_knn
+
+pytestmark = pytest.mark.ops
+
+SEED = int(os.environ.get("RAFT_TPU_SERVE_SEED", "1234"))
+_uniq = itertools.count()
+
+
+def _name(prefix="opsvc"):
+    return "%s%d" % (prefix, next(_uniq))
+
+
+@pytest.fixture(autouse=True)
+def _flight_isolation():
+    """Sentinel breaches capture black boxes into the process-global
+    bounded deque (BLACKBOX_KEEP=8); left behind, a saturated deque
+    breaks any later suite's grew-by-one assertion (test_persist's
+    scrub test).  Clear flight state after every test here."""
+    yield
+    flight.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(SEED)
+
+
+@pytest.fixture
+def index(rng):
+    return jnp.asarray(rng.standard_normal((400, 16)), jnp.float32)
+
+
+@pytest.fixture
+def service(index):
+    svc = KNNService(index, k=5, max_batch_rows=64, max_wait_ms=1.0,
+                     name=_name())
+    svc.warmup()
+    yield svc
+    svc.close()
+
+
+@pytest.fixture
+def plane(service):
+    p = OpsPlane(services={service.name: service}, port=0,
+                 sentinel_interval_s=0.05)
+    yield p
+    p.close()
+
+
+def _get(url, timeout=10.0):
+    """(status, parsed-json-or-text) tolerating non-2xx statuses."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read().decode("utf-8")
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode("utf-8")
+        code = e.code
+    try:
+        return code, json.loads(body)
+    except ValueError:
+        return code, body
+
+
+def _total_misses():
+    return sum(s["misses"] for fn in compile_cache_stats().values()
+               for s in fn.values())
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------- #
+# program cost inventory (raft_tpu/core/inventory.py)
+# ---------------------------------------------------------------------- #
+class TestInventory:
+    def test_profiled_jit_populates_inventory(self, rng):
+        fn_name = _name("inv_fn")
+
+        @profiled_jit(name=fn_name)
+        def f(x):
+            return (x @ x.T).sum(axis=1)
+
+        x = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+        f(x)
+        snap = inventory.snapshot()
+        assert fn_name in snap
+        assert len(snap[fn_name]) == 1
+        entry = next(iter(snap[fn_name].values()))
+        # the CPU backend answers the cost model: nonzero flops and
+        # bytes, and memory_analysis footprints
+        assert entry["flops"] > 0
+        assert entry["bytes_accessed"] > 0
+        assert entry["hbm_bytes"] > 0
+        assert entry["hbm_bytes"] == pytest.approx(
+            entry["argument_bytes"] + entry["output_bytes"]
+            + entry["temp_bytes"])
+        # a second shape = a second executable = a second entry;
+        # a repeat call at a known shape adds nothing
+        f(jnp.asarray(rng.standard_normal((64, 8)), jnp.float32))
+        f(x)
+        assert len(inventory.snapshot()[fn_name]) == 2
+
+    def test_gauges_exported_per_entry(self, rng):
+        fn_name = _name("inv_gauge")
+
+        @profiled_jit(name=fn_name)
+        def f(x):
+            return x * 2.0
+
+        f(jnp.asarray(rng.standard_normal((16, 4)), jnp.float32))
+        entry = next(iter(inventory.snapshot()[fn_name].values()))
+        for metric in ("raft_tpu_program_flops",
+                       "raft_tpu_program_bytes",
+                       "raft_tpu_program_hbm_bytes"):
+            fam = default_registry().get(metric)
+            assert fam is not None
+            series = {lbls["fn"]: (lbls, s) for lbls, s in fam.series()}
+            assert fn_name in series
+            lbls, _ = series[fn_name]
+            assert lbls["entry"] == entry["entry"]
+
+    def test_summary_rolls_up(self, rng):
+        fn_name = _name("inv_sum")
+
+        @profiled_jit(name=fn_name)
+        def f(x):
+            return x.sum()
+
+        for n in (8, 16, 32):
+            f(jnp.asarray(rng.standard_normal((n, 4)), jnp.float32))
+        s = inventory.summary()
+        assert s["per_fn"][fn_name]["programs"] == 3
+        detail = inventory.snapshot()[fn_name]
+        assert s["per_fn"][fn_name]["total_hbm_bytes"] == pytest.approx(
+            sum(e["hbm_bytes"] for e in detail.values()))
+        assert s["programs"] == inventory.entry_count()
+
+    def test_metrics_snapshot_carries_inventory(self):
+        from raft_tpu.session import metrics_snapshot
+
+        snap = metrics_snapshot()
+        assert {"programs", "total_hbm_bytes", "per_fn",
+                "detail"} <= set(snap["inventory"])
+
+    def test_warmed_service_fully_inventoried(self, service):
+        # the serve path's cached scan program (the donating twin by
+        # default) must appear at every bucket rung with nonzero cost
+        snap = inventory.snapshot()
+        entries = [e for fn, keys in snap.items()
+                   if fn.startswith("tiled_knn")
+                   for e in keys.values()]
+        assert len(entries) >= len(service.policy.rungs)
+        assert all(e["flops"] > 0 and e["bytes_accessed"] > 0
+                   for e in entries)
+
+
+# ---------------------------------------------------------------------- #
+# endpoints
+# ---------------------------------------------------------------------- #
+class TestEndpoints:
+    def _traffic(self, service, index, n=3):
+        for f in service.submit_many([index[:3], index[3:7]] * n):
+            f.result(timeout=30)
+
+    def test_metrics_prometheus(self, plane, service, index):
+        self._traffic(service, index)
+        code, body = _get(plane.url + "/metrics")
+        assert code == 200
+        parsed = parse_prometheus(body)
+        # serve families, gauge peaks, and the program inventory all
+        # ride one scrape
+        assert "raft_tpu_serve_requests_total" in parsed
+        assert any(k.endswith("_peak") for k in parsed)
+        assert not any(k.endswith("_high_water") for k in parsed)
+        assert "raft_tpu_program_flops" in parsed
+
+    def test_healthz_ok(self, plane, service):
+        code, body = _get(plane.url + "/healthz")
+        assert code == 200
+        assert body["ok"] is True
+        assert body["degraded"] is False
+        flags = body["services"][service.name]
+        assert flags["worker_alive"] is True
+        assert flags["breaker"] == "closed"
+
+    def test_statusz(self, plane, service, index):
+        self._traffic(service, index)
+        code, body = _get(plane.url + "/statusz")
+        assert code == 200
+        assert service.name in body["services"]
+        assert body["services"][service.name]["worker_alive"] is True
+        assert body["inventory"]["programs"] > 0
+        # the roofline join: a fn that has executed carries its
+        # measured mean next to the cost-model numbers
+        assert any("exec_mean_s" in st
+                   for st in body["inventory"]["per_fn"].values())
+        assert body["sentinel"]["degraded"] is False
+        assert {"enabled", "events", "capacity"} <= set(body["flight"])
+        assert body["uptime_s"] >= 0
+
+    def test_debug_config_layers(self, plane):
+        code, body = _get(plane.url + "/debug/config")
+        assert code == 200
+        knob = body["knobs"]["select_impl"]
+        assert {"value", "layer"} <= set(knob)
+
+    def test_debug_traces(self, plane, service, index):
+        self._traffic(service, index)
+        code, body = _get(plane.url + "/debug/traces?k=2")
+        assert code == 200
+        assert body["k"] == 2
+        assert body["traces"], "exemplars should exist after traffic"
+        tr = body["traces"][0]
+        assert tr["service"] == service.name
+        kinds = {e["kind"] for e in tr["events"]}
+        assert {"batch_formed", "resolved"} <= kinds
+        code, body = _get(plane.url + "/debug/traces?k=bogus")
+        assert code == 400
+
+    def test_debug_inventory_and_snapshot(self, plane, service):
+        code, body = _get(plane.url + "/debug/inventory")
+        assert code == 200
+        assert body["summary"]["programs"] > 0
+        code, snap = _get(plane.url + "/debug/snapshot")
+        assert code == 200
+        assert {"metrics", "compile_cache", "flight",
+                "inventory"} <= set(snap)
+        # the --watch source renders through the standard digest
+        import tools.metrics_report as mr
+
+        text = mr.render_report(snap)
+        assert "program inventory" in text
+
+    def test_blackbox_post_only(self, plane):
+        before = len(flight.default_recorder().blackboxes())
+        req = urllib.request.Request(
+            plane.url + "/debug/blackbox?reason=test", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        assert body["reason"] == "ops_test"
+        assert len(flight.default_recorder().blackboxes()) == before + 1
+        code, _ = _get(plane.url + "/debug/blackbox")
+        assert code == 405
+
+    def test_unknown_endpoint_404_lists_routes(self, plane):
+        code, body = _get(plane.url + "/nope")
+        assert code == 404
+        assert "/metrics" in body["endpoints"]
+        # review regression: arbitrary probed paths must not mint one
+        # registry series each — 404s land under one "unknown" label
+        _get(plane.url + "/nope2")
+        _get(plane.url + "/favicon.ico")
+        fam = default_registry().get("raft_tpu_ops_requests_total")
+        endpoints = {lbls["endpoint"] for lbls, _ in fam.series()}
+        assert "unknown" in endpoints
+        assert not {"/nope", "/nope2", "/favicon.ico"} & endpoints
+
+    def test_request_accounting(self, plane):
+        _get(plane.url + "/metrics")
+        _get(plane.url + "/metrics")
+        fam = default_registry().get("raft_tpu_ops_requests_total")
+        total = sum(s.value for lbls, s in fam.series()
+                    if lbls.get("endpoint") == "/metrics")
+        assert total >= 2
+        fam = default_registry().get("raft_tpu_ops_request_seconds")
+        assert any(lbls.get("endpoint") == "/metrics"
+                   for lbls, _ in fam.series())
+
+    def test_lifecycle(self, service):
+        with OpsPlane(services={service.name: service}, port=0) as p:
+            url = p.url
+            assert p.port > 0
+            assert not p.closed
+            assert _get(url + "/healthz")[0] == 200
+        # closed: the socket is gone and close is idempotent
+        assert p.closed
+        p.close()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+
+    def test_bind_failure_leaks_no_sentinel(self, service):
+        """Review regression: a failed bind (port in use) must not
+        leave a permanently registered zombie sentinel behind."""
+        import raft_tpu.serve.sentinel as smod
+
+        with OpsPlane(services={service.name: service}, port=0) as p:
+            with smod._reg_lock:
+                before = list(smod._registered)
+            with pytest.raises(OSError):
+                OpsPlane(services={service.name: service},
+                         host="127.0.0.1", port=p.port)
+            with smod._reg_lock:
+                assert list(smod._registered) == before
+
+
+# ---------------------------------------------------------------------- #
+# full health behind the TTL cache
+# ---------------------------------------------------------------------- #
+class _FakeSession:
+    def __init__(self):
+        self.calls = 0
+        self.services = {}
+
+    def health_check(self):
+        self.calls += 1
+        return {"ok": True, "tests": {}, "devices": {}}
+
+
+class TestFullHealth:
+    def test_ttl_caches_the_battery(self):
+        fake = _FakeSession()
+        with OpsPlane(session=fake, port=0, healthz_ttl_s=60.0,
+                      sentinel=False) as p:
+            code, body = _get(p.url + "/healthz?full=1")
+            assert code == 200 and body["full"]["ok"] is True
+            _get(p.url + "/healthz?full=1")
+            _get(p.url + "/healthz?full=1")
+            assert fake.calls == 1          # TTL shared one run
+            _get(p.url + "/healthz")
+            assert fake.calls == 1          # the cheap path never runs it
+
+    def test_ttl_zero_reruns(self):
+        fake = _FakeSession()
+        with OpsPlane(session=fake, port=0, healthz_ttl_s=0.0,
+                      sentinel=False) as p:
+            _get(p.url + "/healthz?full=1")
+            time.sleep(0.01)
+            _get(p.url + "/healthz?full=1")
+            assert fake.calls == 2
+
+
+# ---------------------------------------------------------------------- #
+# anomaly sentinel (unit, fake clock)
+# ---------------------------------------------------------------------- #
+class _Dummy:
+    """Service-shaped nothing: the sentinel must cope with objects
+    exposing none of the optional surfaces."""
+
+
+class TestSentinelRules:
+    def _sentinel(self, services, clock=None, **knobs):
+        with config.override(**{k: str(v) for k, v in knobs.items()}):
+            return AnomalySentinel(lambda: services, interval_s=0.0,
+                                   clock=clock or FakeClock())
+
+    def _exec_timer(self, svc_name):
+        return default_registry().timer(
+            "raft_tpu_serve_exec_seconds", labels=("service",)
+        ).labels(service=svc_name)
+
+    def test_exec_latency_trip_freeze_clear(self):
+        name = _name("sent")
+        clock = FakeClock()
+        sent = self._sentinel({name: _Dummy()}, clock=clock,
+                              ops_sentinel_min_samples=5,
+                              ops_sentinel_latency_factor=3)
+        t = self._exec_timer(name)
+        counter0 = default_registry().family_total(
+            "raft_tpu_anomaly_total")
+        # window 1: cursor init; windows 2-3: healthy baseline
+        sent.tick(force=True)
+        for _ in range(2):
+            for _ in range(5):
+                t.observe(0.002)
+            clock.advance(1.0)
+            sent.tick(force=True)
+        assert not sent.degraded()
+        w = sent.status()["watches"]["exec_latency/%s" % name]
+        assert w["baseline"] == pytest.approx(0.002, rel=0.5)
+        # regression: 10x the baseline trips on ONE window
+        t.observe(0.02)
+        clock.advance(1.0)
+        sent.tick(force=True)
+        assert sent.degraded()
+        active = sent.active()
+        assert [a["rule"] for a in active] == ["exec_latency"]
+        assert default_registry().family_total(
+            "raft_tpu_anomaly_total") == counter0 + 1
+        # breach persists: baseline FROZEN, counter NOT re-bumped
+        base_before = sent.status()["watches"][
+            "exec_latency/%s" % name]["baseline"]
+        t.observe(0.02)
+        clock.advance(1.0)
+        sent.tick(force=True)
+        assert sent.degraded()
+        assert sent.status()["watches"][
+            "exec_latency/%s" % name]["baseline"] == base_before
+        assert default_registry().family_total(
+            "raft_tpu_anomaly_total") == counter0 + 1
+        # recovery clears and records the clearance event
+        for _ in range(5):
+            t.observe(0.002)
+        clock.advance(1.0)
+        sent.tick(force=True)
+        assert not sent.degraded()
+        cleared = flight.default_recorder().events(
+            kind="anomaly_cleared", service=name)
+        assert cleared and cleared[-1].attrs["rule"] == "exec_latency"
+
+    def test_quiet_window_neither_trips_nor_learns(self):
+        name = _name("sent")
+        clock = FakeClock()
+        sent = self._sentinel({name: _Dummy()}, clock=clock,
+                              ops_sentinel_min_samples=2)
+        t = self._exec_timer(name)
+        sent.tick(force=True)
+        for _ in range(3):
+            t.observe(0.005)
+        clock.advance(1.0)
+        sent.tick(force=True)
+        base = sent.status()["watches"]["exec_latency/%s" % name][
+            "baseline"]
+        clock.advance(1.0)
+        sent.tick(force=True)   # no new batches
+        assert sent.status()["watches"]["exec_latency/%s" % name][
+            "baseline"] == base
+        assert not sent.degraded()
+
+    def test_queue_depth_rule(self):
+        name = _name("sent")
+
+        class Batcher:
+            queue_cap = 100
+
+            def __init__(self):
+                self._depth = 0
+
+            def depth(self):
+                return self._depth
+
+        svc = _Dummy()
+        svc.batcher = Batcher()
+        sent = self._sentinel({name: svc},
+                              ops_sentinel_queue_frac=0.5)
+        sent.tick(force=True)
+        assert not sent.degraded()
+        svc.batcher._depth = 80
+        sent.tick(force=True)
+        assert [a["rule"] for a in sent.active()] == ["queue_depth"]
+        svc.batcher._depth = 3
+        sent.tick(force=True)
+        assert not sent.degraded()
+
+    def test_persist_rules(self):
+        name = _name("sent")
+
+        class Persist:
+            corruption_detected = False
+            stats_dict = {"wal_records": 0, "snapshot_age_s": 1.0,
+                          "snapshot_interval_s": 30.0,
+                          "snapshot_stale": False,
+                          "corruption_detected": False}
+
+            def stats(self):
+                return dict(self.stats_dict,
+                            corruption_detected=self.corruption_detected)
+
+        svc = _Dummy()
+        svc._persist = Persist()
+        sent = self._sentinel({name: svc},
+                              ops_sentinel_wal_records=50)
+        sent.tick(force=True)
+        assert not sent.degraded()
+        svc._persist.stats_dict["wal_records"] = 51
+        svc._persist.corruption_detected = True
+        svc._persist.stats_dict["snapshot_stale"] = True
+        sent.tick(force=True)
+        rules = sorted(a["rule"] for a in sent.active())
+        assert rules == ["scrub_corruption", "snapshot_age",
+                         "wal_depth"]
+
+    def test_slo_burn_rule(self):
+        name = _name("sent")
+        clock = FakeClock(100.0)
+        tracker = flight.slo_for(name, target_s=0.01, objective=0.9,
+                                 windows_s=(60.0,), clock=clock)
+        svc = _Dummy()
+        svc.slo = tracker
+        sent = self._sentinel({name: svc}, clock=clock,
+                              ops_sentinel_min_samples=5,
+                              ops_sentinel_burn=2)
+        for _ in range(10):
+            tracker.observe("default", 0.001)
+        sent.tick(force=True)
+        assert not sent.degraded()
+        for _ in range(10):
+            tracker.observe("default", 0.5)   # all misses: burn = 5
+        sent.tick(force=True)
+        assert [a["rule"] for a in sent.active()] == ["slo_burn"]
+
+    def test_rate_limit_and_poke(self):
+        name = _name("sent")
+        clock = FakeClock()
+        with config.override(ops_sentinel_interval_s="10"):
+            sent = AnomalySentinel(lambda: {name: _Dummy()},
+                                   clock=clock)
+        assert sent.tick() is True
+        assert sent.tick() is False          # inside the interval
+        clock.advance(11.0)
+        assert sent.tick() is True
+        ticks = sent.status()["ticks"]
+        sentinel_mod.register(sent)
+        try:
+            sentinel_mod.poke()              # rate-limited: no-op
+            assert sent.status()["ticks"] == ticks
+            clock.advance(11.0)
+            sentinel_mod.poke()
+            assert sent.status()["ticks"] == ticks + 1
+        finally:
+            sentinel_mod.unregister(sent)
+
+    def test_tile_stall_first_sighting_not_judged(self):
+        """Review regression: the first tick sees the pool's LIFETIME
+        h2d/stall totals — warmup's inherently-unhidden streams must
+        not trip tile_stall on a healthy freshly-watched service."""
+        name = _name("sent")
+        reg = default_registry()
+        h2d = reg.timer("raft_tpu_h2d_seconds",
+                        labels=("pool",)).labels(pool=name)
+        stall = reg.timer("raft_tpu_h2d_stall_seconds",
+                          labels=("pool",)).labels(pool=name)
+        h2d.observe(1.0)
+        stall.observe(0.9)     # lifetime fraction 0.9 > 0.5 threshold
+        sent = self._sentinel({name: _Dummy()},
+                              ops_sentinel_stall_frac=0.5)
+        sent.tick(force=True)
+        assert not sent.degraded()       # first sighting: cursor only
+        h2d.observe(1.0)
+        stall.observe(0.9)               # a genuinely stalled WINDOW
+        sent.tick(force=True)
+        assert [a["rule"] for a in sent.active()] == ["tile_stall"]
+
+    def test_broken_services_fn_counted_not_raised(self):
+        def boom():
+            raise RuntimeError("broken registry")
+
+        sent = AnomalySentinel(boom, interval_s=0.0,
+                               clock=FakeClock())
+        before = default_registry().family_total(
+            "raft_tpu_ops_sentinel_errors_total")
+        assert sent.tick(force=True) is True
+        assert default_registry().family_total(
+            "raft_tpu_ops_sentinel_errors_total") == before + 1
+
+
+# ---------------------------------------------------------------------- #
+# sentinel end to end: injected latency fault -> trip -> tape
+# ---------------------------------------------------------------------- #
+class TestSentinelIntegration:
+    def test_delay_fault_trips_and_tapes(self, index):
+        svc = KNNService(index, k=5, max_batch_rows=64,
+                         max_wait_ms=0.2, name=_name("sint"))
+        svc.warmup()
+        plane = OpsPlane(services={svc.name: svc}, port=0,
+                         sentinel_interval_s=0.02)
+        sent = plane.sentinel
+        try:
+            for _ in range(30):
+                for f in svc.submit_many([index[:3], index[3:7]]):
+                    f.result(timeout=30)
+                sent.tick(force=True)
+            assert not sent.degraded()
+            delay_s = 0.2
+            with inject_worker(svc.worker, faults.Delay(delay_s)):
+                for f in svc.submit_many([index[:3], index[3:7]]):
+                    f.result(timeout=60)
+                sent.tick(force=True)
+            assert "exec_latency" in [a["rule"] for a in sent.active()]
+            # /healthz flips degraded (503) while breached
+            code, body = _get(plane.url + "/healthz")
+            assert code == 503 and body["degraded"] is True
+            assert any(a["rule"] == "exec_latency"
+                       for a in body["anomalies"])
+            # the automatic black box holds the breaching batch: an
+            # execute bracket carrying the injected delay
+            boxes = [b for b in flight.default_recorder().blackboxes()
+                     if b["reason"] == "anomaly_exec_latency"
+                     and b["service"] == svc.name]
+            assert boxes
+            assert any(ev.get("kind") == "execute_ready"
+                       and ev.get("exec_s", 0.0) >= delay_s
+                       for ev in boxes[-1]["events"])
+            # healthy traffic clears the breach and /healthz recovers
+            for _ in range(20):
+                for f in svc.submit_many([index[:3], index[3:7]]):
+                    f.result(timeout=30)
+                sent.tick(force=True)
+                if not sent.degraded():
+                    break
+            assert not sent.degraded()
+            assert _get(plane.url + "/healthz")[0] == 200
+        finally:
+            plane.close()
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# concurrent scrape under traffic: 16 threads, bit-identical results
+# ---------------------------------------------------------------------- #
+@pytest.mark.serve
+class TestScrapeUnderTraffic:
+    def test_sixteen_threads_with_scraper(self, rng):
+        index = jnp.asarray(rng.standard_normal((600, 24)), jnp.float32)
+        svc = KNNService(index, k=5, max_batch_rows=128,
+                         max_wait_ms=0.5, name=_name("traffic"))
+        svc.warmup()
+        plane = OpsPlane(services={svc.name: svc}, port=0)
+        queries = [jnp.asarray(rng.standard_normal((4, 24)),
+                               jnp.float32) for _ in range(8)]
+        expected = [tuple(np.asarray(a) for a in
+                          brute_force_knn(index, q, 5))
+                    for q in queries]
+        misses0 = _total_misses()
+        stop = threading.Event()
+        errors = []
+        scrape = {"n": 0, "failures": 0}
+
+        def client(tid):
+            i = tid
+            while not stop.is_set():
+                q = queries[i % len(queries)]
+                want = expected[i % len(queries)]
+                try:
+                    d, ids = svc.submit(q).result(timeout=30)
+                    if not (np.array_equal(np.asarray(d), want[0])
+                            and np.array_equal(np.asarray(ids),
+                                               want[1])):
+                        errors.append("mismatch")
+                        return
+                except Exception as e:
+                    errors.append(repr(e))
+                    return
+                i += 1
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    code, body = _get(plane.url + "/metrics",
+                                      timeout=5)
+                    assert code == 200
+                    parse_prometheus(body)
+                    code, _ = _get(plane.url + "/statusz", timeout=5)
+                    assert code == 200
+                except Exception:
+                    scrape["failures"] += 1
+                scrape["n"] += 1
+
+        threads = [threading.Thread(target=client, args=(t,),
+                                    daemon=True) for t in range(16)]
+        threads.append(threading.Thread(target=scraper, daemon=True))
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(1.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        try:
+            assert not errors, errors[:3]
+            assert scrape["n"] > 3
+            assert scrape["failures"] == 0
+            # served results stayed bit-identical, the worker loop
+            # never stalled (alive + still serving), and the scrape
+            # loop compiled NOTHING
+            assert svc.worker.is_alive()
+            assert _total_misses() == misses0
+            # bounded handler latency even while hammered
+            fam = default_registry().get("raft_tpu_ops_request_seconds")
+            for lbls, series in fam.series():
+                if lbls.get("endpoint") in ("/metrics", "/statusz"):
+                    assert series.quantile(0.95) < 2.0
+        finally:
+            plane.close()
+            svc.close()
+
+
+# ---------------------------------------------------------------------- #
+# session integration
+# ---------------------------------------------------------------------- #
+class TestSessionServeOps:
+    def test_serve_ops_lifecycle(self, index):
+        from raft_tpu.session import Session
+
+        s = Session().init()
+        try:
+            svc = s.serve(kind="knn", index=index, k=3,
+                          max_batch_rows=32, retry_policy=None)
+            svc.warmup()
+            plane = s.serve_ops(port=0)
+            assert s.ops_plane is plane
+            # the plane sees the SESSION's registry (live view)
+            code, body = _get(plane.url + "/statusz")
+            assert code == 200 and svc.name in body["services"]
+            # one LIVE plane per session
+            with pytest.raises(Exception):
+                s.serve_ops(port=0)
+            # review regression: manually closing the plane must not
+            # brick the session — a fresh one can be started
+            plane.close()
+            plane2 = s.serve_ops(port=0)
+            assert _get(plane2.url + "/healthz")[0] == 200
+            url = plane2.url
+        finally:
+            s.destroy()
+        # destroy closed the plane with the session
+        assert s.ops_plane is None
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------- #
+# loadgen ops-scrape scenario
+# ---------------------------------------------------------------------- #
+class TestLoadgenOpsScrape:
+    def test_scenario_report(self, rng):
+        from tools.loadgen import build_service, run_ops_scrape
+
+        svc = build_service("knn", 2000, 16, 5, seed=SEED,
+                            max_batch_rows=64, max_wait_ms=1.0)
+        svc.warmup()
+        try:
+            rep = run_ops_scrape(svc, port=0, duration=2.0,
+                                 concurrency=4, rows=4, seed=SEED)
+        finally:
+            svc.close()
+        assert rep["scrapes"] > 0
+        assert rep["scrape_failures"] == 0
+        assert rep["post_warmup_compiles"] == 0
+        assert rep["ops_port"] > 0
+        assert rep["baseline_qps"] > 0 and rep["scraped_qps"] > 0
+
+
+# ---------------------------------------------------------------------- #
+# CI hygiene: the ops-jax ban
+# ---------------------------------------------------------------------- #
+class TestOpsJaxBanLint:
+    def _check(self, tmp_path, relpath, src, monkeypatch):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(os.path.dirname(__file__),
+                                        "..", "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(mod, "REPO", str(tmp_path))
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        return [p for p in mod.check_file(str(path))
+                if "ops plane" in p]
+
+    def test_jax_flagged_in_ops_modules(self, tmp_path, monkeypatch):
+        for src in ("import jax\n", "from jax import jit\n",
+                    "x = jax.devices()\n", "j = jax\n"):
+            assert self._check(tmp_path, "raft_tpu/serve/opsplane.py",
+                               src, monkeypatch), src
+        assert self._check(tmp_path, "raft_tpu/serve/sentinel.py",
+                           "import jax.numpy as jnp\n", monkeypatch)
+
+    def test_marker_escapes_and_scope_is_tight(self, tmp_path,
+                                               monkeypatch):
+        assert not self._check(
+            tmp_path, "raft_tpu/serve/opsplane.py",
+            "import jax  # ops-jax-ok: fixture\n", monkeypatch)
+        assert not self._check(tmp_path, "raft_tpu/serve/opsplane.py",
+                               "import json\n", monkeypatch)
+        # the rest of serve/ may use jax freely
+        assert not self._check(tmp_path, "raft_tpu/serve/scheduler.py",
+                               "import jax\n", monkeypatch)
+
+    def test_real_modules_are_clean(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "style_check", os.path.join(os.path.dirname(__file__),
+                                        "..", "ci", "style_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        repo = os.path.join(os.path.dirname(__file__), "..")
+        for rel in ("raft_tpu/serve/opsplane.py",
+                    "raft_tpu/serve/sentinel.py"):
+            assert mod.check_file(os.path.join(repo, rel)) == []

@@ -70,6 +70,14 @@ bit-identical post-restore search vs a kept reference, typed-only
 errors, and 0 post-warmup compiles after restore (exit 1 otherwise).
 ``stress.sh chaos N`` rotates it alongside the other chaos arms.
 
+``--ops-port P`` runs the **ops-scrape scenario**
+(docs/OBSERVABILITY.md "Ops plane"): a baseline load window, then the
+same load with an embedded :class:`raft_tpu.serve.OpsPlane` on port P
+(0 = ephemeral) scraped at 1 Hz (``/metrics`` parsed back +
+``/healthz``) — asserting every scrape succeeded, the scraped window
+performed 0 post-warmup compiles, and QPS stayed within noise of the
+baseline (exit 1 otherwise).  ``./stress.sh ops N`` loops it.
+
 ``--trace [K]`` captures the flight-recorder timelines of the K
 slowest requests (default 3) and prints their waterfalls next to the
 p99 row (docs/OBSERVABILITY.md "Flight recorder & request tracing");
@@ -524,6 +532,95 @@ def run_load(service, *, mode="closed", duration=5.0, concurrency=8,
         report["slow_traces"] = slow
     report.update(_registry_serve_stats(service.name,
                                         ooc_base=ooc_base))
+    return report
+
+
+def run_ops_scrape(service, *, port=0, duration=6.0, concurrency=8,
+                   rows=4, seed=0, query_pool=None, scrape_hz=1.0):
+    """Steady serve load with a live ops plane being scraped — the
+    scrape-safety scenario (docs/OBSERVABILITY.md "Ops plane").
+
+    Two equal windows over one warmed service: a BASELINE window with
+    no ops plane traffic, then a SCRAPED window with an embedded
+    :class:`~raft_tpu.serve.opsplane.OpsPlane` and a ``scrape_hz``
+    scraper thread pulling ``/metrics`` (parsed back — a scrape that
+    returns garbage counts as a failure) and ``/healthz``.  Asserts
+    (``ops_ok``): every scrape succeeded, the scraped window performed
+    0 post-warmup compiles, and its QPS stayed within noise of the
+    baseline (>= 0.6x here — a deliberately loose band for the loop
+    venue; the ``ops_scrape_overhead`` bench rung measures the strict
+    interleaved <= 3% bound).
+    """
+    import urllib.error
+    import urllib.request
+
+    from raft_tpu.core.metrics import parse_prometheus
+    from raft_tpu.serve.opsplane import OpsPlane
+
+    per_window = max(1.0, duration / 2)
+    baseline = run_load(service, mode="closed", duration=per_window,
+                        concurrency=concurrency, rows=rows, seed=seed,
+                        query_pool=query_pool)
+    scrape_stats = {"n": 0, "failures": 0, "latencies": []}
+    stop = threading.Event()
+    plane = OpsPlane(services={service.name: service}, port=port)
+    bound_port = plane.port   # read before close() drops the socket
+
+    def scraper():
+        url = plane.url
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(url + "/metrics",
+                                            timeout=5) as resp:
+                    parsed = parse_prometheus(
+                        resp.read().decode("utf-8"))
+                if "raft_tpu_serve_requests_total" not in parsed:
+                    raise ValueError("scrape missing serve families")
+                # the liveness probe a real scraper would pair it with
+                # (503 while degraded still counts as a served scrape)
+                try:
+                    urllib.request.urlopen(url + "/healthz",
+                                           timeout=5).close()
+                except urllib.error.HTTPError:
+                    pass
+            except Exception:
+                scrape_stats["failures"] += 1
+            scrape_stats["n"] += 1
+            scrape_stats["latencies"].append(time.monotonic() - t0)
+            stop.wait(timeout=1.0 / scrape_hz)
+
+    thread = threading.Thread(target=scraper, daemon=True)
+    thread.start()
+    try:
+        scraped = run_load(service, mode="closed", duration=per_window,
+                           concurrency=concurrency, rows=rows,
+                           seed=seed, query_pool=query_pool)
+    finally:
+        stop.set()
+        thread.join(timeout=10.0)
+        plane.close()
+    lat = sorted(scrape_stats["latencies"])
+    ratio = (scraped["qps"] / baseline["qps"]
+             if baseline["qps"] else 0.0)
+    report = {
+        "baseline_qps": baseline["qps"],
+        "scraped_qps": scraped["qps"],
+        "qps_ratio": round(ratio, 4),
+        "scrapes": scrape_stats["n"],
+        "scrape_failures": scrape_stats["failures"],
+        "scrape_p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
+        "post_warmup_compiles": scraped["post_warmup_compiles"],
+        "p99_ms": scraped["p99_ms"],
+        "ops_port": bound_port,
+        "ops_ok": (scrape_stats["n"] > 0
+                   and scrape_stats["failures"] == 0
+                   and scraped["post_warmup_compiles"] == 0
+                   and ratio >= 0.6),
+    }
+    report.update({k: v for k, v in scraped.items()
+                   if k in ("host_staged_bytes", "requests_ok",
+                            "rejected", "errors")})
     return report
 
 
@@ -1300,6 +1397,14 @@ def main(argv=None) -> int:
                     help="--tenants: open-loop bulk arrival rate")
     ap.add_argument("--bulk-rows", type=int, default=32,
                     help="--tenants: query rows per bulk request")
+    ap.add_argument("--ops-port", type=int, default=None, metavar="P",
+                    help="run the ops-scrape scenario: baseline window,"
+                         " then the same load with an embedded ops "
+                         "plane on port P (0 = ephemeral) scraped at "
+                         "1 Hz — asserts every scrape succeeded, 0 "
+                         "post-warmup compiles, and QPS within noise "
+                         "of the baseline (exit 1 otherwise; "
+                         "docs/OBSERVABILITY.md \"Ops plane\")")
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--qps", type=float, default=100.0,
                     help="open-loop arrival rate")
@@ -1411,7 +1516,8 @@ def main(argv=None) -> int:
         opts["merge"] = args.merge
     if args.kill_shard and (args.mesh is None or args.mesh < 2):
         raise SystemExit("--kill-shard requires --mesh >= 2")
-    if args.trace and (args.chaos or args.hedge_chaos or args.tenants):
+    if args.trace and (args.chaos or args.hedge_chaos or args.tenants
+                       or args.ops_port is not None):
         # slow-request capture rides the plain load loop only; a
         # silently ignored flag would read as "tracing is broken" to
         # exactly the user debugging a chaos run
@@ -1421,6 +1527,11 @@ def main(argv=None) -> int:
                          "chaos assertions dump it automatically)")
     if args.hedge_chaos and (args.replicas is None or args.replicas < 2):
         raise SystemExit("--hedge-chaos requires --replicas >= 2")
+    if args.ops_port is not None and (args.chaos or args.hedge_chaos
+                                      or args.tenants):
+        raise SystemExit("--ops-port runs the steady ops-scrape "
+                         "scenario; it does not compose with the "
+                         "chaos/tenant scenarios")
     if args.hedge_ms is not None:
         if args.replicas is None:
             raise SystemExit("--hedge-ms requires --replicas")
@@ -1523,6 +1634,26 @@ def main(argv=None) -> int:
             # a failed chaos assertion always leaves the tape behind
             _dump_flight("flight_chaos_seed%d.json" % args.seed)
         return 0 if report["chaos_ok"] else 1
+    if args.ops_port is not None:
+        try:
+            report = run_ops_scrape(service, port=args.ops_port,
+                                    duration=args.duration,
+                                    concurrency=args.concurrency,
+                                    rows=args.rows, seed=args.seed)
+        finally:
+            service.close()
+        report["warmup_s"] = round(warmup_s, 3)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print("== loadgen: %s ops-scrape ==" % args.service)
+            for key in ("baseline_qps", "scraped_qps", "qps_ratio",
+                        "scrapes", "scrape_failures", "scrape_p95_ms",
+                        "post_warmup_compiles", "host_staged_bytes",
+                        "p99_ms", "ops_port", "warmup_s", "ops_ok"):
+                if key in report:
+                    print("  %-20s %s" % (key, report[key]))
+        return 0 if report["ops_ok"] else 1
     want_recall = args.recall or args.service == "ann"
     pool = None
     if want_recall:

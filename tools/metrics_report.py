@@ -17,10 +17,17 @@ Usage:
     python tools/metrics_report.py --demo
     python tools/metrics_report.py --demo --out snapshot.json
 
+    # live mode against an embedded ops plane (Session.serve_ops /
+    # OpsPlane; docs/OBSERVABILITY.md "Ops plane"): poll
+    # /debug/snapshot every N seconds, re-render in place
+    python tools/metrics_report.py --url http://127.0.0.1:9100 --watch 2
+    python tools/metrics_report.py --url http://127.0.0.1:9100 --format prom
+    python tools/metrics_report.py snapshot.json --watch 5   # re-read file
+
 Formats: ``report`` (default; human-readable tables + span tree),
 ``json`` (the raw snapshot), ``prom`` (Prometheus text format for the
-registry half — only available with --demo or a live process, since a
-dumped snapshot has already flattened the registry).
+registry half — available with --demo or --url, since a dumped
+snapshot has already flattened the registry).
 """
 
 from __future__ import annotations
@@ -89,6 +96,15 @@ def render_report(snap: dict) -> str:
         lines.append("== tuning (docs/TUNING.md \"Bench-driven "
                      "autotuning\") ==")
         lines.extend(tuning)
+    inv = _inventory_summary(snap)
+    if inv:
+        lines.append("== program inventory (XLA cost model; "
+                     "docs/OBSERVABILITY.md \"Ops plane\") ==")
+        lines.extend(inv)
+    ops = _ops_summary(metrics)
+    if ops:
+        lines.append("== ops plane & anomaly sentinel ==")
+        lines.extend(ops)
     cc = snap.get("compile_cache", {})
     if cc:
         lines.append("== jit compile cache (per fn: shapes / hits / "
@@ -436,6 +452,82 @@ def _tuning_summary(metrics: dict) -> list:
     return out
 
 
+def _inventory_summary(snap: dict) -> list:
+    """Program cost inventory digest (docs/OBSERVABILITY.md "Ops
+    plane"): per-fn program counts, cost-model flops/footprints, the
+    summed device-capacity claim, and a roofline-style achieved-
+    throughput figure joining the cost model to the measured
+    ``raft_tpu_jit_<fn>_seconds`` execution timer (host-side dispatch
+    — an upper bound on achieved FLOP/s, honest for retrace and
+    capacity questions rather than kernel tuning)."""
+    inv = snap.get("inventory") or {}
+    per_fn = inv.get("per_fn") or {}
+    if not per_fn:
+        return []
+    metrics = snap.get("metrics", {})
+    lines = ["  programs=%d  pinned footprint (args+outs+temps) "
+             "= %.1f MB"
+             % (inv.get("programs", 0),
+                inv.get("total_hbm_bytes", 0.0) / 1e6)]
+    for fn, st in sorted(per_fn.items()):
+        line = ("  %-32s programs=%-3d max_flops=%.3g  hbm=%.1fMB"
+                % (fn, st["programs"], st["max_flops"],
+                   st["total_hbm_bytes"] / 1e6))
+        timer = metrics.get("raft_tpu_jit_%s_seconds" % fn, {})
+        series = timer.get("series") or []
+        if series and series[0].get("count"):
+            mean_s = series[0]["mean"]
+            line += "  exec mean=%s" % _fmt_s(mean_s)
+            if mean_s > 0 and st["max_flops"] > 0:
+                line += (" -> <=%.1f GFLOP/s"
+                         % (st["max_flops"] / mean_s / 1e9))
+        lines.append(line)
+    return lines
+
+
+def _ops_summary(metrics: dict) -> list:
+    """Ops-plane scrape traffic + anomaly-sentinel ledger."""
+    lines = []
+    by_ep = {}
+    for s in metrics.get("raft_tpu_ops_requests_total",
+                         {}).get("series", []):
+        ep = s["labels"].get("endpoint", "?")
+        d = by_ep.setdefault(ep, {"n": 0, "errors": 0})
+        d["n"] += int(s["value"])
+        if s["labels"].get("code", "200") not in ("200", "503"):
+            d["errors"] += int(s["value"])
+    lat = {}
+    for s in metrics.get("raft_tpu_ops_request_seconds",
+                         {}).get("series", []):
+        ep = s["labels"].get("endpoint")
+        if ep is not None and s.get("count"):
+            lat[ep] = s
+    for ep, d in sorted(by_ep.items()):
+        line = "  %-32s requests=%-7d" % (ep, d["n"])
+        if d["errors"]:
+            line += " errors=%d" % d["errors"]
+        if ep in lat:
+            line += ("  handler p50=%s p95=%s"
+                     % (_fmt_s(lat[ep]["p50"]), _fmt_s(lat[ep]["p95"])))
+        lines.append(line)
+    anomalies = {}
+    for s in metrics.get("raft_tpu_anomaly_total",
+                         {}).get("series", []):
+        anomalies[s["labels"].get("rule", "?")] = int(s["value"])
+    active = []
+    for s in metrics.get("raft_tpu_anomaly_active",
+                         {}).get("series", []):
+        if s["value"]:
+            active.append("%s/%s" % (s["labels"].get("service", "?"),
+                                     s["labels"].get("rule", "?")))
+    if anomalies:
+        lines.append("  anomalies: %s%s" % (
+            "  ".join("%s=%d" % kv for kv in sorted(anomalies.items())),
+            ("  ACTIVE: " + " ".join(sorted(active))) if active
+            else ""))
+    return lines
+
+
 def _serve_resilience_summary(metrics: dict) -> list:
     """Self-healing digest (docs/FAULT_MODEL.md "Serving failure
     model"): live breaker state plus the outage ledger — trips,
@@ -617,6 +709,31 @@ def run_demo() -> dict:
     return metrics_snapshot()
 
 
+def _load_snapshot(args) -> dict:
+    """One snapshot from whichever source the CLI named: the ops
+    plane's ``/debug/snapshot`` (``--url``), a dumped JSON file, or
+    the --demo workload."""
+    if args.demo:
+        return run_demo()
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/debug/snapshot"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp)
+    with open(args.snapshot, encoding="utf-8") as f:
+        snap = json.load(f)
+    # bench.py artifact? unwrap to its embedded snapshot
+    for path in (("metrics_snapshot",), ("detail", "metrics_snapshot")):
+        cur = snap
+        for k in path:
+            cur = cur.get(k, {}) if isinstance(cur, dict) else {}
+        if cur:
+            snap = cur
+            break
+    return snap
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("snapshot", nargs="?",
@@ -625,27 +742,48 @@ def main(argv=None) -> int:
     ap.add_argument("--demo", action="store_true",
                     help="run a small instrumented workload instead of "
                          "reading a file")
+    ap.add_argument("--url", metavar="URL",
+                    help="poll a live ops plane (Session.serve_ops / "
+                         "OpsPlane) at URL instead of reading a file — "
+                         "fetches /debug/snapshot")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="live mode: re-fetch (--url) or re-read (a "
+                         "snapshot file) every N seconds and re-render "
+                         "the digest in place; Ctrl-C exits")
     ap.add_argument("--format", choices=("report", "json", "prom"),
                     default="report")
     ap.add_argument("--out", help="also write the snapshot JSON here")
     args = ap.parse_args(argv)
 
-    if args.demo == (args.snapshot is not None):
-        ap.error("pass exactly one of: a snapshot file, or --demo")
+    n_sources = sum((args.demo, args.snapshot is not None,
+                     args.url is not None))
+    if n_sources != 1:
+        ap.error("pass exactly one of: a snapshot file, --url, or "
+                 "--demo")
+    if args.watch is not None:
+        if args.demo:
+            ap.error("--watch needs a re-readable source: --url or a "
+                     "snapshot file")
+        if args.watch <= 0:
+            ap.error("--watch N must be positive seconds")
+        import time as _time
 
-    if args.demo:
-        snap = run_demo()
-    else:
-        with open(args.snapshot, encoding="utf-8") as f:
-            snap = json.load(f)
-        # bench.py artifact? unwrap to its embedded snapshot
-        for path in (("metrics_snapshot",), ("detail", "metrics_snapshot")):
-            cur = snap
-            for k in path:
-                cur = cur.get(k, {}) if isinstance(cur, dict) else {}
-            if cur:
-                snap = cur
-                break
+        try:
+            while True:
+                snap = _load_snapshot(args)
+                # clear + home, then one full render — the digest
+                # redraws in place like `watch(1)` would
+                sys.stdout.write("\x1b[2J\x1b[H")
+                print("[%s  every %gs  source: %s]" % (
+                    _time.strftime("%H:%M:%S"), args.watch,
+                    args.url or args.snapshot))
+                print(render_report(snap))
+                sys.stdout.flush()
+                _time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+    snap = _load_snapshot(args)
 
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
@@ -659,9 +797,16 @@ def main(argv=None) -> int:
             from raft_tpu.core.metrics import default_registry
 
             print(default_registry().to_prometheus(), end="")
+        elif args.url:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    args.url.rstrip("/") + "/metrics",
+                    timeout=10) as resp:
+                sys.stdout.write(resp.read().decode("utf-8"))
         else:
             print("--format prom needs a live registry; use --demo "
-                  "(a dumped snapshot is already flattened)",
+                  "or --url (a dumped snapshot is already flattened)",
                   file=sys.stderr)
             return 2
     else:
