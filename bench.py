@@ -1709,6 +1709,262 @@ def _bench_serve_ann_persist(index_rows, dim, k, duration, concurrency,
     }
 
 
+def _bench_serve_fleet(index_rows, dim, k, duration, concurrency,
+                       nlist=16):
+    """Fault-domain fleet rung (docs/FAULT_MODEL.md "Fleet fault
+    domains"): the serving fleet measured end-to-end through the
+    router process boundary, then put through the kill-one-worker
+    drill.  Two parts:
+
+    - **scaling table** — closed-loop router QPS with 1 worker vs 2.
+      Informational on this box: the worker PROCESSES share the same
+      host cores (the serve_knn_sharded virtual-mesh caveat applies
+      verbatim), so wall-clock scaling is bounded by the core count,
+      not the fleet protocol.
+    - **chaos arm** (the hard gates) — steady query traffic plus a
+      live WAL-acked insert stream against the 2-worker fleet;
+      SIGKILL one worker mid-ingestion.  ``/fleet/healthz`` must read
+      degraded during the outage and healthy again after the
+      crash-restored rejoin; ZERO acknowledged rows may be lost
+      (every acked id must answer under its exact vector from the
+      healed fleet); every admitted request must carry exactly one
+      typed terminal flight event; and the recovered QPS window must
+      hold >= 0.9x the pre-kill window."""
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    import numpy as np
+
+    from raft_tpu.core import flight as _flight
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.fleet import Fleet
+    from raft_tpu.fleet.worker import _synth
+
+    def note(msg):
+        if os.environ.get("RAFT_TPU_BENCH_DEBUG"):
+            print("[serve_fleet +%.1fs] %s"
+                  % (time.time() - note.t0, msg),
+                  file=sys.stderr, flush=True)
+    note.t0 = time.time()
+
+    data = _synth(index_rows, dim, 5, 8)
+
+    def drive(router, dur):
+        stop = _threading.Event()
+        lock = _threading.Lock()
+        counts = {"calls": 0, "errors": 0}
+
+        def client(idx):
+            rng = np.random.default_rng(100 + idx)
+            while not stop.is_set():
+                picks = rng.integers(0, index_rows, 4)
+                try:
+                    router.search([data[i].tolist() for i in picks],
+                                  timeout_s=10.0)
+                except RaftError:
+                    with lock:
+                        counts["errors"] += 1
+                    continue
+                with lock:
+                    counts["calls"] += 1
+
+        threads = [_threading.Thread(target=client, args=(i,),
+                                     daemon=True)
+                   for i in range(concurrency)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(dur)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        el = max(time.time() - t0, 1e-9)
+        return {"qps": round(4 * counts["calls"] / el, 1),
+                "requests_s": round(counts["calls"] / el, 1),
+                "errors": counts["errors"]}
+
+    fleet_kw = dict(index_rows=index_rows, dim=dim, k=k, seed=5,
+                    clusters=8, nlist=nlist,
+                    service_opts={"delta_cap": 8192})
+    roots = [tempfile.mkdtemp(prefix="raft_tpu_bench_fleet%d_" % n)
+             for n in (1, 2)]
+    try:
+        t0 = time.time()
+        with Fleet(1, root=roots[0], **fleet_kw) as f1:
+            f1.wait_ready(timeout=180.0)
+            boot1_s = time.time() - t0
+            note("fleet(1) ready in %.1fs" % boot1_s)
+            one = drive(f1.router, duration)
+            note("drive(1) %s" % one)
+
+        t0 = time.time()
+        with Fleet(2, root=roots[1], **fleet_kw) as f2:
+            router = f2.router
+            f2.wait_ready(timeout=180.0)
+            boot2_s = time.time() - t0
+            note("fleet(2) ready in %.1fs" % boot2_s)
+            two = drive(router, duration)
+            note("drive(2) %s" % two)
+
+            # ---------------- chaos arm ---------------- #
+            _flight.reset()
+            acked = {}
+            attempted = {}
+            ilock = _threading.Lock()
+            istop = _threading.Event()
+            irng = np.random.default_rng(17)
+
+            def inserter():
+                n = 0
+                while not istop.is_set():
+                    ids = list(range(10_000_000 + n,
+                                     10_000_000 + n + 8))
+                    vecs = irng.standard_normal(
+                        (8, dim)).astype(np.float32)
+                    with ilock:
+                        for j, i in enumerate(ids):
+                            attempted[i] = vecs[j]
+                    try:
+                        rep = router.insert(
+                            ids, [v.tolist() for v in vecs],
+                            timeout_s=6.0)
+                    except RaftError:
+                        time.sleep(0.02)
+                        continue
+                    ok_ids = set(rep["acked_ids"])
+                    with ilock:
+                        for j, i in enumerate(ids):
+                            if i in ok_ids:
+                                acked[i] = vecs[j]
+                    n += 8
+                    # throttled: the gate is zero acked-row LOSS, not
+                    # ingest volume — an unthrottled stream acks tens
+                    # of thousands of rows and the verification scan
+                    # dominates the rung's wall clock
+                    time.sleep(0.05)
+
+            it = _threading.Thread(target=inserter, daemon=True)
+            it.start()
+            pre = drive(router, duration)
+            note("pre-kill drive %s" % pre)
+            gen_before = router.registry()["w1"]["generation"]
+            f2.kill("w1")
+            degraded_seen = False
+            deadline = time.time() + 20.0
+            while time.time() < deadline:
+                ok, payload = router.fleet_health()
+                if ok and payload["degraded"]:
+                    degraded_seen = True
+                    break
+                time.sleep(0.1)
+            note("degraded_seen=%s" % degraded_seen)
+            f2.restart("w1")
+            # wait for the rejoin itself (generation bump), not for a
+            # merely-active state: the restart can land before the
+            # lease eviction, while w1 still reads active under its
+            # stale registration
+            deadline = time.time() + 150.0
+            while time.time() < deadline:
+                pub = router.registry()["w1"]
+                if (pub["state"] == "active"
+                        and pub["generation"] > gen_before):
+                    break
+                time.sleep(0.1)
+            rejoined = (router.registry()["w1"]["generation"]
+                        > gen_before)
+            note("rejoined=%s" % rejoined)
+            healthy_after = False
+            deadline = time.time() + 30.0
+            while rejoined and time.time() < deadline:
+                ok, payload = router.fleet_health()
+                if ok and not payload["degraded"]:
+                    healthy_after = True
+                    break
+                time.sleep(0.2)
+            note("healthy_after=%s" % healthy_after)
+            # settle window (discarded): "recovered" means the healed
+            # steady state, not the first second after rejoin while
+            # the worker is still folding its replayed delta
+            drive(router, 1.5)
+            rec = drive(router, duration)
+            note("recovered drive %s" % rec)
+            istop.set()
+            it.join(timeout=30.0)
+
+            # zero acked-row loss: every acked id answers under its
+            # exact vector from the healed fleet
+            lost = 0
+            items = sorted(acked.items())
+            note("loss scan over %d acked rows" % len(items))
+            for off in range(0, len(items), 128):
+                chunk = items[off:off + 128]
+                try:
+                    out = router.search(
+                        [v.tolist() for _, v in chunk],
+                        timeout_s=15.0)
+                except RaftError:
+                    lost += len(chunk)
+                    continue
+                for (i, _v), row in zip(chunk, out["ids"]):
+                    if row[0] != i:
+                        lost += 1
+            note("loss scan done: lost=%d" % lost)
+
+            # exactly one typed terminal per admitted request (the
+            # flight ring is FIFO: a surviving admitted event's
+            # terminal is newer, so the pairing is overflow-safe)
+            rec_fl = _flight.default_recorder()
+            admitted = [e.attrs.get("rid")
+                        for e in rec_fl.events(kind="fleet_admitted")]
+            terminals = {}
+            for kind in ("fleet_resolved", "fleet_failed",
+                         "fleet_expired"):
+                for e in rec_fl.events(kind=kind):
+                    rid = e.attrs.get("rid")
+                    terminals[rid] = terminals.get(rid, 0) + 1
+            exactly_once = bool(admitted) and all(
+                terminals.get(rid, 0) == 1 for rid in admitted)
+            rejoin_stats = (router.fleet_stats().get("last_rejoin")
+                            or {})
+    finally:
+        for root in roots:
+            shutil.rmtree(root, ignore_errors=True)
+
+    ratio = rec["qps"] / max(pre["qps"], 1e-9)
+    gates = {
+        "degraded_during_outage": degraded_seen,
+        "healthy_after_rejoin": healthy_after,
+        "zero_acked_loss": lost == 0,
+        "exactly_once_terminals": exactly_once,
+        "recovered_qps_ok": ratio >= 0.9,
+    }
+    return {
+        "qps_workers_1": one["qps"],
+        "qps_workers_2": two["qps"],
+        "scaling_x": round(two["qps"] / max(one["qps"], 1e-9), 2),
+        "boot_s_workers_1": round(boot1_s, 1),
+        "boot_s_workers_2": round(boot2_s, 1),
+        "prekill_qps": pre["qps"],
+        "recovered_qps": rec["qps"],
+        "recovered_ratio": round(ratio, 3),
+        "acked_rows": len(acked),
+        "attempted_rows": len(attempted),
+        "lost_rows": lost,
+        "admitted_requests": len(admitted),
+        "rejoin_replayed_records": rejoin_stats.get(
+            "replayed_records"),
+        "rejoin_restore_s": rejoin_stats.get("restore_s"),
+        **gates,
+        "fleet_ok": all(gates.values()),
+        "note": ("scaling_x is informational on shared cores; the "
+                 "chaos-arm gates are the rung's claim"),
+        "config": {"index_rows": index_rows, "dim": dim, "k": k,
+                   "nlist": nlist, "concurrency": concurrency,
+                   "duration_s": duration},
+    }
+
+
 def _bench_comms_p2p(rows, dim, iters):
     """Tagged-p2p staging A/B (docs/ZERO_COPY.md): one full ring
     (every rank sends a (rows, dim) f32 block to its neighbor) per
@@ -2220,6 +2476,15 @@ def child_main():
              lambda: _bench_serve_ann_persist(200_000, 64, 10, 3.0, 6,
                                               nlist=512,
                                               train_rows=65536)),
+            # fault-domain fleet drill (docs/FAULT_MODEL.md "Fleet
+            # fault domains"): router QPS with 1 vs 2 worker
+            # processes (informational on shared cores), then the
+            # kill-one-worker chaos arm's hard gates — zero acked-row
+            # loss across SIGKILL + crash-restore, exactly-once typed
+            # terminals, /fleet/healthz degraded during the outage
+            # and healthy after rejoin, recovered QPS >= 0.9x pre-kill
+            ("serve_fleet", 280,
+             lambda: _bench_serve_fleet(2_000, 16, 5, 3.0, 4)),
             # the out-of-core tier at the same 1M x 128 scale: device
             # budget = 1/4 of the slot store (~4x oversubscription),
             # recall must EQUAL the resident arm, and the double-

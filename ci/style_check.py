@@ -106,9 +106,11 @@ TIMING_ALLOWLIST = (
     os.path.join("raft_tpu", "core", "profiler.py"),
 )
 
-# raw-Thread ban (raft_tpu/ only): serve/ owns worker threads; the
-# resilience watchdog and the timing allowlist predate it
-THREAD_DIR_ALLOWLIST = (os.path.join("raft_tpu", "serve") + os.sep,)
+# raw-Thread ban (raft_tpu/ only): serve/ owns worker threads, and
+# fleet/ owns the router's lease/chaos/harness threads; the resilience
+# watchdog and the timing allowlist predate it
+THREAD_DIR_ALLOWLIST = (os.path.join("raft_tpu", "serve") + os.sep,
+                        os.path.join("raft_tpu", "fleet") + os.sep)
 THREAD_ALLOWLIST = TIMING_ALLOWLIST + (
     os.path.join("raft_tpu", "comms", "resilience.py"),
 )
@@ -188,7 +190,10 @@ NP_SAVE_ATTRS = ("save", "savez", "savez_compressed")
 # modules: no `import jax`, no `from jax import ...`, no `jax.`
 # attribute use.  A deliberate exception marks its line `ops-jax-ok`.
 OPS_JAX_FILES = (os.path.join("raft_tpu", "serve", "opsplane.py"),
-                 os.path.join("raft_tpu", "serve", "sentinel.py"))
+                 os.path.join("raft_tpu", "serve", "sentinel.py"),
+                 # the fleet router aggregates worker scrapes and must
+                 # never compile: same ban as the ops handlers
+                 os.path.join("raft_tpu", "fleet", "router.py"))
 OPS_JAX_MARKER = "ops-jax-ok"
 
 # tuning-registry drift lint: every config._KNOBS entry with a non-None

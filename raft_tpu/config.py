@@ -229,6 +229,47 @@ ops_sentinel_stall_frac
     ``tile_stall`` rule threshold on the exposed-stall fraction of
     H2D transfer time over the last window (the prefetch stopped
     hiding transfers).  Free-form float in (0, 1]; runtime-resolved.
+ops_sentinel_rejoin_ms_per_record
+    ``rejoin_lag`` rule threshold: a rejoining fleet worker's restore
+    time divided by its replayed WAL records, in milliseconds per
+    record — replay time is judged *relative to WAL depth*, so a deep
+    journal is allowed a long restore but a shallow one is not.
+    Free-form float; runtime-resolved.
+ops_sentinel_rejoin_hold_s
+    How long after a rejoin the ``rejoin_lag`` rule keeps judging it
+    (seconds).  A slow restore is an incident about ONE rejoin, not a
+    steady state: the breach clears once the rejoin ages past this
+    hold (the edge was already counted and flight-recorded), so a
+    healed fleet's ``/fleet/healthz`` goes back to healthy.  Free-form
+    float; runtime-resolved.
+fleet_lease_interval_s
+    Fleet worker heartbeat period (:mod:`raft_tpu.fleet.router`); the
+    router's lease monitor runs at the same cadence.  Free-form
+    float; runtime-resolved at :class:`~raft_tpu.fleet.router.Router`
+    construction.
+fleet_lease_misses
+    Consecutive missed heartbeat intervals before the router evicts a
+    worker (typed eviction, ``worker_dead`` sentinel rule).
+    Free-form int; runtime-resolved.
+fleet_retry_max
+    Per-shard/worker dispatch retry budget at the router (transient
+    comm faults, worker restarts).  Free-form int; runtime-resolved.
+fleet_retry_backoff_s
+    Initial router retry backoff (doubles per attempt; worker
+    ``retry_after_s`` hints override it upward).  Free-form float;
+    runtime-resolved.
+fleet_hedge_ms
+    Replicated-mode hedge delay: a primary silent this long gets a
+    hedged re-dispatch to the next worker in rendezvous order; ``0``
+    disables hedging.  Free-form float; runtime-resolved.
+fleet_timeout_s
+    Default end-to-end deadline for router requests (search/insert)
+    when the caller passes none.  Free-form float; runtime-resolved.
+fleet_inflight_cap
+    Router global admission cap: in-flight requests at or above this
+    shed with a typed :class:`~raft_tpu.core.error
+    .ServiceOverloadError` before any dispatch.  Free-form int;
+    runtime-resolved.
 """
 
 from __future__ import annotations
@@ -314,6 +355,20 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
                                  "100000", None),
     "ops_sentinel_stall_frac": ("RAFT_TPU_OPS_SENTINEL_STALL_FRAC",
                                 "0.5", None),
+    "ops_sentinel_rejoin_ms_per_record": (
+        "RAFT_TPU_OPS_SENTINEL_REJOIN_MS_PER_RECORD", "50", None),
+    "ops_sentinel_rejoin_hold_s": (
+        "RAFT_TPU_OPS_SENTINEL_REJOIN_HOLD_S", "10", None),
+    "fleet_lease_interval_s": ("RAFT_TPU_FLEET_LEASE_INTERVAL_S",
+                               "0.5", None),
+    "fleet_lease_misses": ("RAFT_TPU_FLEET_LEASE_MISSES", "3", None),
+    "fleet_retry_max": ("RAFT_TPU_FLEET_RETRY_MAX", "3", None),
+    "fleet_retry_backoff_s": ("RAFT_TPU_FLEET_RETRY_BACKOFF_S",
+                              "0.05", None),
+    "fleet_hedge_ms": ("RAFT_TPU_FLEET_HEDGE_MS", "100", None),
+    "fleet_timeout_s": ("RAFT_TPU_FLEET_TIMEOUT_S", "10", None),
+    "fleet_inflight_cap": ("RAFT_TPU_FLEET_INFLIGHT_CAP",
+                           "256", None),
 }
 
 # knobs resolved at *runtime* (service/object construction), never baked
@@ -334,7 +389,11 @@ _RUNTIME_KNOBS = frozenset(
      "ops_healthz_ttl_s", "ops_sentinel_interval_s",
      "ops_sentinel_latency_factor", "ops_sentinel_min_samples",
      "ops_sentinel_queue_frac", "ops_sentinel_burn",
-     "ops_sentinel_wal_records", "ops_sentinel_stall_frac"))
+     "ops_sentinel_wal_records", "ops_sentinel_stall_frac",
+     "ops_sentinel_rejoin_ms_per_record", "ops_sentinel_rejoin_hold_s",
+     "fleet_lease_interval_s",
+     "fleet_lease_misses", "fleet_retry_max", "fleet_retry_backoff_s",
+     "fleet_hedge_ms", "fleet_timeout_s", "fleet_inflight_cap"))
 
 # sentinel for "no layer claimed this knob" during resolution — distinct
 # from None, which a caller may store in an override frame to mean

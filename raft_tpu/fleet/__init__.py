@@ -1,0 +1,34 @@
+"""Process-level serving fleet: router + worker processes.
+
+Every capability below this package — ANN serving, WAL-durable state,
+the ops plane and sentinel — lives happily in one Python process; this
+package is the fault-domain layer that keeps *tenants* alive when a
+*process* dies (docs/FAULT_MODEL.md "Fleet fault domains").  The
+pieces:
+
+- :mod:`raft_tpu.fleet.protocol` — the JSON-over-HTTP wire format,
+  typed-error round-tripping, rendezvous placement, top-k merge.
+- :mod:`raft_tpu.fleet.router` — the front-end router (stdlib-only,
+  no jax: the ``ops-jax-ban`` lint covers it): placement, admission,
+  retry/hedging, shard fan-out + merge, heartbeat leases with typed
+  eviction, and the aggregated ``/fleet/metrics`` + ``/fleet/healthz``
+  scrape surface.
+- :mod:`raft_tpu.fleet.worker` — the worker subprocess entrypoint:
+  builds (or crash-restores, PR 14) its service, binds its data plane
+  and ops plane on ephemeral ports, registers with the router, and
+  heartbeats.
+- :mod:`raft_tpu.fleet.supervisor` — spawns/kills/restarts worker
+  processes, rolling restart/drain choreography, autoheal.
+- :mod:`raft_tpu.fleet.chaos` — the seeded process-fault harness
+  (SIGKILL, hang, slow-join, dropped/garbled frames, fsync stall)
+  driven from ``tools/loadgen.py --fleet``.
+
+The hierarchical host-group decomposition from HiCCL (PAPERS.md) that
+shapes the intra-mesh merge since PR 7 is lifted one level here:
+shard-per-worker indexes with a router-side top-k merge.
+"""
+
+from raft_tpu.fleet.router import Router
+from raft_tpu.fleet.supervisor import Fleet, WorkerSpec
+
+__all__ = ["Router", "Fleet", "WorkerSpec"]

@@ -60,7 +60,17 @@ def _dtype_tag(dtype: np.dtype) -> bytes:
     return tag.ljust(8, b"\0")
 
 
+# Chaos/test seam: when set, called (no args) immediately before every
+# fsync — the fleet chaos harness (raft_tpu/fleet/chaos.py) injects
+# fsync stalls here to prove the acknowledge path degrades to typed
+# backpressure rather than silent loss.  None in production.
+FSYNC_HOOK = None
+
+
 def _fsync(f) -> None:
+    hook = FSYNC_HOOK
+    if hook is not None:
+        hook()
     f.flush()
     os.fsync(f.fileno())
 

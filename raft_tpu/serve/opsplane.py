@@ -156,6 +156,7 @@ class OpsPlane:
         self._health_cache: Optional[dict] = None
         self._health_cache_t: Optional[float] = None
         self._started_t: Optional[float] = None
+        self._bound_port: Optional[int] = None
         self._server = None
         self._server_thread = None
         self._ticker = None
@@ -200,6 +201,12 @@ class OpsPlane:
 
         self._server = http.server.ThreadingHTTPServer(
             (self._host, self._want_port), _Handler)
+        # remember the ACTUAL bound port (port=0 means the kernel
+        # picked one): fleet workers bind ephemeral and report this
+        # through the registration handshake, and it must survive
+        # close() so a supervisor can still log where a dead worker
+        # had been listening
+        self._bound_port = int(self._server.server_address[1])
         self._server.daemon_threads = True
         self._server_thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
@@ -217,8 +224,11 @@ class OpsPlane:
 
     @property
     def port(self) -> Optional[int]:
-        return (None if self._server is None
-                else int(self._server.server_address[1]))
+        """Actual bound port (None until first :meth:`start`).  With
+        ``port=0`` this is the kernel-assigned ephemeral port; it
+        stays readable after :meth:`close` (the registration
+        handshake and post-mortem logs need it)."""
+        return self._bound_port
 
     @property
     def url(self) -> Optional[str]:

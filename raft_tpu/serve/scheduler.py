@@ -116,6 +116,14 @@ def _bucket_counter(service: str, bucket: int):
                                              bucket=bucket)
 
 
+def _rung_timer(service: str, bucket: int):
+    return _metrics.default_registry().timer(
+        "raft_tpu_serve_exec_rung_seconds",
+        help="padded device call latency per shape-bucket rung",
+        labels=("service", "rung")).labels(service=service,
+                                           rung=bucket)
+
+
 def _tenant_counter(name: str, help: str, service: str, tenant: str):
     return _metrics.default_registry().counter(
         name, help=help, labels=("service", "tenant")).labels(
@@ -694,6 +702,12 @@ class ServeWorker:
                    "result-ready (upper bound under the overlapped "
                    "loop)", self.name).observe(
                        max(0.0, t_ready - inflight.t_launch))
+            # same latency, keyed by shape rung: the sentinel's
+            # exec_latency rule watches per-(service, rung) series so
+            # a regression in one bucket cannot hide inside a healthy
+            # mix (docs/OBSERVABILITY.md)
+            _rung_timer(self.name, bucket).observe(
+                max(0.0, t_ready - inflight.t_launch))
             _timer("raft_tpu_serve_block_seconds",
                    "time the worker blocked on device results "
                    "(lower bound on device latency at split time)",

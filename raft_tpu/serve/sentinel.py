@@ -27,15 +27,20 @@ loop in-process (docs/OBSERVABILITY.md "Ops plane"):
 Rules (knobs in :mod:`raft_tpu.config`, all ``ops_sentinel_*``):
 
 ========================  ============================================
-``exec_latency``          per-service windowed MEAN exec latency
-                          (exact, from the timer's lifetime
-                          count/total deltas between ticks — a
-                          reservoir p99 full of healthy history
-                          would need dozens of slow batches to
-                          move; the window mean trips on the first
-                          one) > ``latency_factor`` × rolling
-                          baseline (min ``min_samples`` lifetime
-                          batches before judging)
+``exec_latency``          windowed MEAN exec latency (exact, from
+                          the timer's lifetime count/total deltas
+                          between ticks — a reservoir p99 full of
+                          healthy history would need dozens of slow
+                          batches to move; the window mean trips on
+                          the first one) > ``latency_factor`` ×
+                          rolling baseline (min ``min_samples``
+                          lifetime batches before judging).  Watched
+                          per service AND per (service, rung) — one
+                          watch per shape bucket from the
+                          ``raft_tpu_serve_exec_rung_seconds``
+                          family, scoped ``<service>:r<rung>`` — so
+                          a regression confined to one bucket
+                          cannot hide inside a healthy traffic mix
 ``queue_depth``           queued requests > ``queue_frac`` × the
                           service's admission cap
 ``slo_burn``              any tenant's shortest-window burn rate >
@@ -48,6 +53,18 @@ Rules (knobs in :mod:`raft_tpu.config`, all ``ops_sentinel_*``):
 ``tile_stall``            exposed-stall fraction of H2D time over the
                           last window > ``stall_frac`` (the prefetch
                           stopped hiding transfers)
+``worker_dead``           fleet only (the watched object exposes
+                          ``fleet_stats``): any registered worker is
+                          lease-evicted and not yet rejoined — the
+                          fleet is serving degraded
+``rejoin_lag``            fleet only: the last crash-rejoin's WAL
+                          replay ran slower than
+                          ``rejoin_ms_per_record`` per replayed
+                          record — recovery time is outgrowing the
+                          journal, snapshot cadence needs tightening.
+                          Clears once the rejoin ages past
+                          ``rejoin_hold_s`` (an incident, not a
+                          latched state)
 ========================  ============================================
 
 The sentinel is driven two ways, both cheap: every
@@ -125,6 +142,10 @@ class AnomalySentinel:
         self._burn = config.get_float("ops_sentinel_burn")
         self._wal_records = config.get_int("ops_sentinel_wal_records")
         self._stall_frac = config.get_float("ops_sentinel_stall_frac")
+        self._rejoin_ms = config.get_float(
+            "ops_sentinel_rejoin_ms_per_record")
+        self._rejoin_hold = config.get_float(
+            "ops_sentinel_rejoin_hold_s")
         self._clock = clock
         self._lock = threading.Lock()
         self._watches: Dict[tuple, _Watch] = {}
@@ -157,7 +178,7 @@ class AnomalySentinel:
         for name, svc in services.items():
             for rule_fn in (self._rule_latency, self._rule_queue,
                             self._rule_slo_burn, self._rule_persist,
-                            self._rule_tile_stall):
+                            self._rule_tile_stall, self._rule_fleet):
                 try:
                     rule_fn(name, svc, now)
                 except Exception:
@@ -245,6 +266,9 @@ class AnomalySentinel:
         return None
 
     def _rule_latency(self, name: str, svc, now: float) -> None:
+        # rungs first: their cursors must warm even on ticks where the
+        # service-level cursor has nothing to judge (early returns)
+        self._rule_latency_rungs(name, now)
         s = self._series("raft_tpu_serve_exec_seconds", name)
         if s is None:
             return
@@ -260,6 +284,29 @@ class AnomalySentinel:
         self._judge_baseline("exec_latency", name, window_mean,
                              self._latency_factor, now,
                              judge=count >= self._min_samples)
+
+    def _rule_latency_rungs(self, name: str, now: float) -> None:
+        """Per-(service, rung) exec_latency watches (module doc): each
+        shape bucket gets its own cursor, baseline, and watch scoped
+        ``<service>:r<rung>`` so a one-bucket regression is judged
+        against that bucket's own history, not the mixed mean."""
+        fam = _metrics.default_registry().get(
+            "raft_tpu_serve_exec_rung_seconds")
+        if fam is None:
+            return
+        for labels, s in fam.series():
+            if labels.get("service") != name:
+                continue
+            scope = "%s:r%s" % (name, labels.get("rung"))
+            count, total = int(s.count), float(s.total)
+            prev = self._exec_cursor.get(scope)
+            self._exec_cursor[scope] = (count, total)
+            if prev is None or count <= prev[0]:
+                continue
+            window_mean = (total - prev[1]) / (count - prev[0])
+            self._judge_baseline("exec_latency", scope, window_mean,
+                                 self._latency_factor, now,
+                                 judge=count >= self._min_samples)
 
     def _rule_queue(self, name: str, svc, now: float) -> None:
         batcher = getattr(svc, "batcher", None)
@@ -324,6 +371,28 @@ class AnomalySentinel:
             return  # no transfers this window
         frac = max(0.0, stall_t - prev[1]) / dh
         self._judge("tile_stall", name, frac, self._stall_frac, now)
+
+    def _rule_fleet(self, name: str, svc, now: float) -> None:
+        stats_fn = getattr(svc, "fleet_stats", None)
+        if stats_fn is None:
+            return
+        st = stats_fn()
+        # worker_dead: edge-fires on the first eviction, clears when
+        # the worker rejoins (or is replaced) — the degraded window
+        self._judge("worker_dead", name,
+                    float(st.get("workers_dead", 0)), 0.0, now)
+        rj = st.get("last_rejoin") or {}
+        replayed = int(rj.get("replayed_records") or 0)
+        if replayed > 0:
+            lag_ms = 1000.0 * float(rj.get("restore_s") or 0.0) / replayed
+            # a slow restore is an incident about ONE rejoin, not a
+            # steady state: judge it only while the rejoin is fresh
+            # (``age_s`` from the router's stats), then clear — the
+            # breach edge was already counted and flight-recorded
+            age = rj.get("age_s")
+            fresh = age is None or float(age) < self._rejoin_hold
+            self._judge("rejoin_lag", name, lag_ms, self._rejoin_ms,
+                        now, breach=fresh and lag_ms > self._rejoin_ms)
 
     # ------------------------------------------------------------------ #
     # consumers (the ops plane's /healthz and /statusz)

@@ -48,4 +48,12 @@ if [[ "${1:-}" == "--ops" ]]; then
     shift
     exec python -m pytest tests/ -q -m ops "$@"
 fi
+# --fleet: only the multi-process fleet suite (router fan-out/merge,
+# crash-restart rejoin under live ingestion, drain choreography,
+# chaos harness; also part of the default invocation — see
+# stress.sh fleet for the seed-rotating chaos loop)
+if [[ "${1:-}" == "--fleet" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m fleet "$@"
+fi
 exec python -m pytest tests/ -q "$@"

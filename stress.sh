@@ -130,6 +130,25 @@ if [[ "${1:-}" == "tenants" ]]; then
     done
     exit 0
 fi
+if [[ "${1:-}" == "fleet" ]]; then
+    # fleet chaos loop (docs/FAULT_MODEL.md "Fleet fault domains"):
+    # a router + N worker PROCESSES under concurrent search+insert
+    # traffic while a seeded ChaosSchedule injects process faults
+    # (SIGKILL mid-WAL-append, hang, slow rejoin, dropped/garbled
+    # frames, fsync stall).  Assertions per round: zero acknowledged
+    # rows lost across the kill, every admitted request gets exactly
+    # one typed terminal flight event, no untyped errors, the router
+    # never crashes.  A failure reproduces with the printed seed.
+    n="${2:-10}"
+    for i in $(seq 1 "$n"); do
+        echo "== fleet chaos $i/$n (seed=$i) =="
+        python tools/loadgen.py --fleet --fleet-workers 2 \
+            --seed "$i" --duration 6 --concurrency 4 \
+            --index-rows 2000 --dim 16 --k 5 --nlist 16 \
+            --max-batch-rows 64 --max-wait-ms 1
+    done
+    exit 0
+fi
 if [[ "${1:-}" == "serve" ]]; then
     n="${2:-10}"
     for i in $(seq 1 "$n"); do

@@ -1285,6 +1285,243 @@ def run_crash_restart(persist_dir, *, index_rows=4000, dim=16, k=5,
     return report
 
 
+def run_fleet(root, *, n_workers=2, mode="sharded", index_rows=2000,
+              dim=16, k=5, seed=0, duration=6.0, concurrency=4,
+              rows=4, nlist=16, clusters=8, insert_rows=8,
+              chaos=True):
+    """Fleet chaos scenario (docs/FAULT_MODEL.md "Fleet fault
+    domains"): a router + ``n_workers`` worker PROCESSES under
+    concurrent closed-loop search traffic plus (sharded mode) an
+    insert stream, while a seeded :class:`ChaosSchedule` injects
+    process faults — SIGKILL + restart, hang, slow rejoin, dropped/
+    garbled frames, fsync stall.  After the schedule drains and the
+    fleet heals, ``fleet_ok`` requires ALL of:
+
+    - **zero acknowledged-insert loss** — every id the router reported
+      in ``acked_ids`` is findable post-heal (its exact vector returns
+      the id in top-k; the WAL-ack contract held across the kill);
+    - **exactly-once, typed-only** — terminal outcome counters equal
+      admitted calls (client calls minus typed sheds), no request id
+      carries two terminal flight events, and no client ever saw an
+      untyped error;
+    - **healed** — every worker is active again (the killed worker
+      rejoined from snapshot+WAL; the hung worker re-registered via
+      the heartbeat ``rereg`` handshake), and a process fault that
+      actually fired produced a ``fleet_rejoin``.
+
+    The router never crashing is implicit: a dead router fails every
+    subsequent call untyped.
+    """
+    import numpy as np
+
+    from raft_tpu.core import flight
+    from raft_tpu.core import metrics as _metrics
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.fleet import Fleet, Router
+    from raft_tpu.fleet.chaos import (ChaosHarness, ChaosSchedule,
+                                      FrameFaults)
+
+    rng = np.random.default_rng(seed)
+    frame = FrameFaults(seed + 1)
+    router = Router(
+        mode=mode,
+        shard_count=(n_workers if mode == "sharded" else 1),
+        transport=frame)
+    fleet = Fleet(n_workers, root=root, index_rows=index_rows,
+                  dim=dim, k=k, mode=mode, seed=seed,
+                  clusters=clusters, nlist=nlist, router=router,
+                  service_opts={"delta_cap": 8192})
+    report = {"seed": seed, "duration_s": duration, "mode": mode,
+              "workers": n_workers}
+    harness = None
+    try:
+        fleet.wait_ready()
+        data = synth_data(index_rows, dim, seed=seed,
+                          clusters=clusters)
+        q_idx = rng.integers(0, index_rows, size=(16, rows))
+        qpool = [data[ix] for ix in q_idx]
+
+        lock = threading.Lock()
+        counts = {"calls": 0, "search_ok": 0, "degraded": 0,
+                  "typed_errors": 0, "untyped_errors": 0,
+                  "insert_batches": 0, "insert_partial": 0}
+        acked = {}
+        stop = threading.Event()
+
+        def client(tid):
+            i = tid
+            while not stop.is_set():
+                q = qpool[i % len(qpool)]
+                i += concurrency
+                with lock:
+                    counts["calls"] += 1
+                try:
+                    out = router.search(q.tolist(), timeout_s=8.0)
+                except RaftError:
+                    with lock:
+                        counts["typed_errors"] += 1
+                    time.sleep(0.01)
+                except Exception:
+                    with lock:
+                        counts["untyped_errors"] += 1
+                    time.sleep(0.01)
+                else:
+                    with lock:
+                        counts["search_ok"] += 1
+                        if out["degraded"]:
+                            counts["degraded"] += 1
+
+        def inserter():
+            base = max(1_000_000, index_rows * 10)
+            n = 0
+            while not stop.is_set():
+                ids = list(range(base + n, base + n + insert_rows))
+                vecs = rng.standard_normal(
+                    (insert_rows, dim)).astype(np.float32)
+                with lock:
+                    counts["calls"] += 1
+                    counts["insert_batches"] += 1
+                try:
+                    rep = router.insert(
+                        ids, [v.tolist() for v in vecs],
+                        timeout_s=8.0)
+                except RaftError:
+                    with lock:
+                        counts["typed_errors"] += 1
+                    time.sleep(0.05)
+                    continue
+                except Exception:
+                    with lock:
+                        counts["untyped_errors"] += 1
+                    time.sleep(0.05)
+                    continue
+                ok_ids = set(rep["acked_ids"])
+                with lock:
+                    if not rep["ok"]:
+                        counts["insert_partial"] += 1
+                    for j, iid in enumerate(ids):
+                        if iid in ok_ids:
+                            acked[iid] = vecs[j]
+                n += insert_rows
+                time.sleep(0.03)
+
+        threads = [threading.Thread(target=client, args=(t,),
+                                    daemon=True)
+                   for t in range(concurrency)]
+        if mode == "sharded":
+            threads.append(threading.Thread(target=inserter,
+                                            daemon=True))
+        if chaos:
+            sched = ChaosSchedule.from_seed(seed, duration_s=duration,
+                                            n_workers=n_workers)
+            harness = ChaosHarness(fleet, sched,
+                                   frame_faults=frame).start()
+        for t in threads:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        if harness is not None:
+            harness.join(timeout=30.0)
+            harness.stop()
+        frame.disarm()
+
+        # heal: every worker active again (restart rejoined, hang
+        # re-registered) before the final accounting + verification
+        t_heal = time.monotonic()
+        while (len(router.active_workers()) < n_workers
+               and time.monotonic() - t_heal < 60.0):
+            time.sleep(0.1)
+        healed = len(router.active_workers()) == n_workers
+
+        # accounting BEFORE verification traffic (the verification
+        # searches below are requests too and would shift the counts)
+        snap = _metrics.default_registry().snapshot()
+
+        def _total(name, label=None):
+            out = {}
+            for s in snap.get(name, {}).get("series", []):
+                key = s["labels"].get(label) if label else "_"
+                out[key] = out.get(key, 0) + int(s["value"])
+            return out
+
+        outcomes = _total("raft_tpu_fleet_requests_total", "outcome")
+        sheds = outcomes.get("shed", 0)
+        terminals = sum(v for o, v in outcomes.items()
+                        if o != "shed")
+        admitted = counts["calls"] - sheds
+        rejoins = sum(_total("raft_tpu_fleet_rejoins_total").values())
+        evictions = _total("raft_tpu_fleet_evictions_total", "reason")
+        retries = sum(_total("raft_tpu_fleet_retries_total").values())
+        frames = _total("raft_tpu_fleet_frame_errors_total", "kind")
+
+        # no rid may carry two terminal flight events (the ring is
+        # bounded, so this is a recent-window duplicate check; the
+        # counter identity above is the full-run count check)
+        rec = flight.default_recorder()
+        term_rids = {}
+        for kind in ("fleet_resolved", "fleet_failed",
+                     "fleet_expired"):
+            for ev in rec.events(kind=kind):
+                rid = (ev.attrs or {}).get("rid")
+                if rid is not None:
+                    term_rids[rid] = term_rids.get(rid, 0) + 1
+        dup_terminals = sum(1 for v in term_rids.values() if v > 1)
+
+        # zero acked-row loss: every acknowledged insert's exact
+        # vector must return its id in top-k from the healed fleet
+        lost, verify_errors, verified = [], 0, 0
+        items = sorted(acked.items())
+        for off in range(0, len(items), 32):
+            chunk = items[off:off + 32]
+            try:
+                out = router.search([v.tolist() for _, v in chunk],
+                                    timeout_s=15.0)
+            except Exception:
+                verify_errors += 1
+                lost.extend(iid for iid, _ in chunk)
+                continue
+            for (iid, _), row in zip(chunk, out["ids"]):
+                verified += 1
+                if iid not in row:
+                    lost.append(iid)
+
+        applied = harness.applied if harness is not None else []
+        proc_faults = [e for e in applied
+                       if e["kind"] in ("kill", "hang")
+                       and "failed" not in e]
+        report.update(
+            counts,
+            sheds=sheds, outcomes=outcomes, admitted=admitted,
+            terminals=terminals,
+            exactly_once=(terminals == admitted
+                          and dup_terminals == 0),
+            dup_terminals=dup_terminals,
+            typed_only=counts["untyped_errors"] == 0,
+            acked_inserts=len(acked), verified=verified,
+            lost_inserts=len(lost), no_insert_loss=not lost,
+            verify_errors=verify_errors, healed=healed,
+            rejoins=rejoins, evictions=evictions, retries=retries,
+            frame_errors=frames,
+            frame_injected=dict(frame.injected),
+            chaos_applied=[e["kind"] for e in applied],
+            chaos_failed=[e["kind"] for e in applied
+                          if "failed" in e],
+            rejoin_seen=(rejoins >= 1 or not proc_faults))
+        report["fleet_ok"] = (report["exactly_once"]
+                              and report["typed_only"]
+                              and report["no_insert_loss"]
+                              and report["healed"]
+                              and report["rejoin_seen"]
+                              and not report["chaos_failed"])
+        return report
+    finally:
+        if harness is not None:
+            harness.stop()
+        fleet.close()
+
+
 def _dump_flight(path):
     """Write the flight recorder's full state (ring + black boxes) to
     ``path`` and say so — the chaos postmortem artifact
@@ -1359,6 +1596,25 @@ def main(argv=None) -> int:
     ap.add_argument("--persist-dir", default=None, metavar="DIR",
                     help="durability directory for --crash-restart "
                          "(default: a fresh temp dir, removed after)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the multi-process FLEET chaos scenario "
+                         "(docs/FAULT_MODEL.md \"Fleet fault "
+                         "domains\"): a router + N worker processes "
+                         "under search+insert traffic with seeded "
+                         "process faults (SIGKILL, hang, slow rejoin, "
+                         "frame faults, fsync stall); exits 1 unless "
+                         "zero acked-row loss, exactly-once typed "
+                         "terminals, and full post-chaos heal hold")
+    ap.add_argument("--fleet-workers", type=int, default=2,
+                    metavar="N",
+                    help="--fleet: worker process count (default 2)")
+    ap.add_argument("--fleet-mode", default="sharded",
+                    choices=("sharded", "replicated"),
+                    help="--fleet: placement mode (replicated is "
+                         "query-only)")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="--fleet: steady traffic only, no fault "
+                         "schedule (scaling/smoke runs)")
     ap.add_argument("--transient-p", type=float, default=0.05,
                     help="chaos: per-batch transient fault probability")
     ap.add_argument("--outage-s", type=float, default=0.8,
@@ -1436,6 +1692,45 @@ def main(argv=None) -> int:
                     help="print the raw report dict as JSON")
     args = ap.parse_args(argv)
 
+    if args.fleet:
+        import shutil
+        import tempfile
+
+        root = args.persist_dir
+        cleanup = root is None
+        if root is None:
+            root = tempfile.mkdtemp(prefix="raft_tpu_fleet_")
+        try:
+            report = run_fleet(
+                root, n_workers=args.fleet_workers,
+                mode=args.fleet_mode, index_rows=args.index_rows,
+                dim=args.dim, k=args.k, seed=args.seed,
+                duration=args.duration,
+                concurrency=args.concurrency, rows=args.rows,
+                nlist=args.nlist or 16, clusters=args.clusters or 8,
+                chaos=not args.no_chaos)
+        finally:
+            if cleanup:
+                shutil.rmtree(root, ignore_errors=True)
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print("== loadgen: fleet %s x%d (seed=%d) =="
+                  % (report["mode"], report["workers"], args.seed))
+            for key in ("duration_s", "calls", "search_ok",
+                        "degraded", "typed_errors", "untyped_errors",
+                        "sheds", "insert_batches", "acked_inserts",
+                        "lost_inserts", "no_insert_loss", "admitted",
+                        "terminals", "dup_terminals", "exactly_once",
+                        "typed_only", "retries", "frame_errors",
+                        "frame_injected", "evictions", "rejoins",
+                        "chaos_applied", "chaos_failed", "healed",
+                        "fleet_ok"):
+                if key in report:
+                    print("  %-24s %s" % (key, report[key]))
+        if not report["fleet_ok"]:
+            _dump_flight("flight_fleet_seed%d.json" % args.seed)
+        return 0 if report["fleet_ok"] else 1
     if args.crash_restart:
         if args.service != "ann":
             raise SystemExit("--crash-restart drives the persistent "

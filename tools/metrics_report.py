@@ -51,6 +51,11 @@ def _fmt_s(v: float) -> str:
 def render_report(snap: dict) -> str:
     lines = []
     metrics = snap.get("metrics", {})
+    fleet = _fleet_summary(snap)
+    if fleet:
+        lines.append("== fleet (router aggregate; docs/FAULT_MODEL.md "
+                     "\"Fleet fault domains\") ==")
+        lines.extend(fleet)
     timers = {n: f for n, f in metrics.items() if f.get("type") == "timer"}
     if timers:
         lines.append("== timers (count / total / mean / p50 / p95 / max) ==")
@@ -140,6 +145,58 @@ def render_report(snap: dict) -> str:
         for name, node in sorted(tree.items()):
             walk(name, node, 0)
     return "\n".join(lines) if lines else "(empty snapshot)"
+
+
+def _fleet_summary(snap: dict) -> list:
+    """Fleet digest from a router's ``/debug/snapshot`` payload: one
+    row per worker (state / generation / WAL seq / serve digest) plus
+    the fleet-wide rollup the router computes from its own end-to-end
+    timer — per-worker p50/p95 come from each worker's reservoir; the
+    true client p99 only the router sees."""
+    fleet = snap.get("fleet")
+    if not fleet:
+        return []
+    rollup = fleet.get("rollup", {})
+    lines = ["  mode=%s shards=%s workers=%d (dead %d) uptime=%ss"
+             % (fleet.get("mode"), fleet.get("shard_count"),
+                rollup.get("workers_total", 0),
+                rollup.get("workers_dead", 0),
+                rollup.get("uptime_s", 0.0))]
+    parts = ["requests=%d" % rollup.get("requests_total", 0),
+             "qps=%g" % rollup.get("qps_lifetime", 0.0)]
+    for key in sorted(rollup):
+        if key.startswith(("p50_", "p99_")):
+            parts.append("%s=%gms" % (key[:-3], rollup[key]))
+    parts.append("slo_burn_max=%g" % rollup.get("slo_burn_max", 0.0))
+    lines.append("  rollup: " + " ".join(parts))
+    workers = fleet.get("workers", {})
+    if workers:
+        lines.append("  %-8s %-9s %-4s %-8s %-6s %-9s %-9s %-8s "
+                     "%-10s %-10s %s"
+                     % ("worker", "state", "gen", "wal_seq", "queue",
+                        "requests", "rejected", "unavail",
+                        "exec_p50", "exec_p95", "slo_burn"))
+        for wid, d in sorted(workers.items()):
+            lines.append(
+                "  %-8s %-9s %-4s %-8s %-6s %-9s %-9s %-8s %-10s "
+                "%-10s %g"
+                % (wid, d.get("state"), d.get("generation", 0),
+                   d.get("wal_seq", 0), d.get("queue_depth", 0),
+                   d.get("requests_total", "-"),
+                   d.get("rejected_total", "-"),
+                   d.get("unavailable_total", "-"),
+                   "%gms" % d.get("exec_p50_ms", 0.0),
+                   "%gms" % d.get("exec_p95_ms", 0.0),
+                   d.get("slo_burn", 0.0)))
+    stats = fleet.get("stats", {})
+    rj = stats.get("last_rejoin") or None
+    if rj:
+        lines.append("  last rejoin: %s gen=%s replayed=%s "
+                     "restore=%ss"
+                     % (rj.get("worker_id"), rj.get("generation"),
+                        rj.get("replayed_records"),
+                        rj.get("restore_s")))
+    return lines
 
 
 def _serve_summary(metrics: dict) -> list:
