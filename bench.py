@@ -1965,6 +1965,152 @@ def _bench_serve_fleet(index_rows, dim, k, duration, concurrency,
     }
 
 
+def _bench_fleet_trace_overhead(index_rows, dim, k, duration,
+                                concurrency, nlist=16):
+    """Fleet tracing cost rung (docs/OBSERVABILITY.md "Fleet
+    tracing"): the distributed-tracing layer — context propagation on
+    every RPC, router hop spans, worker-side trace binding and fleet
+    indexing — must prove its own price end-to-end through the
+    process boundary, exactly as the single-process
+    serve_trace_overhead rung does for the flight recorder.
+
+    One 2-worker sharded fleet, warmed once and shared by every run
+    (worker boot = a jax import each; arm-to-arm fleet rebuilds would
+    swamp the few-percent effect).  A discarded priming run, then 3
+    interleaved runs per arm — recording ON fleet-wide vs OFF
+    (router toggles locally, workers via ``POST /debug/flight``) —
+    best-of-three per arm.  Gates: qps overhead <= 3%, ZERO
+    post-warmup compiles across both worker processes (from the
+    aggregated ``raft_tpu_jit_compile_seconds_count``), and the
+    joined waterfall for a traced request validates clean."""
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    import numpy as np
+
+    from raft_tpu.core import flight as _flight
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.core.metrics import parse_prometheus
+    from raft_tpu.fleet import Fleet, protocol as _fproto
+    from raft_tpu.fleet import tracing as _ftracing
+    from raft_tpu.fleet.worker import _synth
+
+    data = _synth(index_rows, dim, 5, 8)
+    rid_seq = iter(range(1, 1_000_000))
+
+    def drive(router, dur, keep_rids=None):
+        stop = _threading.Event()
+        lock = _threading.Lock()
+        counts = {"calls": 0, "errors": 0}
+
+        def client(idx):
+            rng = np.random.default_rng(200 + idx)
+            while not stop.is_set():
+                picks = rng.integers(0, index_rows, 4)
+                rid = "flt-ovh-%06d" % next(rid_seq)
+                try:
+                    router.search([data[i].tolist() for i in picks],
+                                  timeout_s=10.0, request_id=rid)
+                except RaftError:
+                    with lock:
+                        counts["errors"] += 1
+                    continue
+                with lock:
+                    counts["calls"] += 1
+                    if keep_rids is not None:
+                        keep_rids.append(rid)
+
+        threads = [_threading.Thread(target=client, args=(i,),
+                                     daemon=True)
+                   for i in range(concurrency)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(dur)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        el = max(time.time() - t0, 1e-9)
+        return {"qps": round(4 * counts["calls"] / el, 1),
+                "errors": counts["errors"]}
+
+    def set_tracing(router, on):
+        _flight.set_enabled(on)  # router-side hop spans
+        for wid, pub in sorted(router.registry().items()):
+            _fproto.post_json(
+                "http://127.0.0.1:%d/debug/flight"
+                % pub["data_port"], {"on": on}, timeout=5.0)
+
+    def worker_compiles(router):
+        parsed = parse_prometheus(router.fleet_metrics_text())
+        return int(sum(parsed.get(
+            "raft_tpu_jit_compile_seconds_count", {}).values()))
+
+    fleet_kw = dict(index_rows=index_rows, dim=dim, k=k, seed=5,
+                    clusters=8, nlist=nlist,
+                    service_opts={"delta_cap": 8192})
+    root = tempfile.mkdtemp(prefix="raft_tpu_bench_ftrace_")
+    per_run = max(1.0, duration / 3)
+    offs, ons = [], []
+    on_rids = []
+    try:
+        with Fleet(2, root=root, **fleet_kw) as fl:
+            router = fl.router
+            fl.wait_ready(timeout=180.0)
+            # discarded priming run from the plateau (same rationale
+            # as serve_trace_overhead: the first closed-loop seconds
+            # run slow regardless of arm)
+            drive(router, max(2.0, per_run))
+            compiles0 = worker_compiles(router)
+            try:
+                for _ in range(3):
+                    set_tracing(router, False)
+                    offs.append(drive(router, per_run))
+                    set_tracing(router, True)
+                    ons.append(drive(router, per_run,
+                                     keep_rids=on_rids))
+            finally:
+                # the fleet (and this process) must not leave
+                # recording off for later rungs
+                set_tracing(router, True)
+            post_compiles = worker_compiles(router) - compiles0
+            # the traced arm's spans must join into a clean
+            # waterfall — overhead numbers for a broken trace pipe
+            # would be measuring nothing
+            problems = ["no traced request joined"]
+            for rid in reversed(on_rids[-8:]):
+                status, joined = router.fleet_trace(rid)
+                if status == 200:
+                    problems = (joined.get("problems")
+                                or _ftracing.validate(joined))
+                    break
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    qps_off = max(r["qps"] for r in offs)
+    qps_on = max(r["qps"] for r in ons)
+    overhead = 1.0 - qps_on / qps_off if qps_off else 0.0
+    gates = {
+        # the acceptance bound: fleet tracing on costs <= 3% qps
+        "overhead_ok": overhead <= 0.03,
+        "zero_post_warmup_compiles": post_compiles == 0,
+        "joined_trace_clean": problems == [],
+    }
+    return {
+        "qps_on": qps_on,
+        "qps_off": qps_off,
+        "overhead_frac": round(overhead, 4),
+        "post_warmup_compiles": post_compiles,
+        "join_problems": problems,
+        **gates,
+        "fleet_trace_ok": all(gates.values()),
+        "config": {"index_rows": index_rows, "dim": dim, "k": k,
+                   "nlist": nlist, "concurrency": concurrency,
+                   "rows_per_request": 4, "runs_per_arm": 3,
+                   "shared_fleet": True},
+    }
+
+
 def _bench_comms_p2p(rows, dim, iters):
     """Tagged-p2p staging A/B (docs/ZERO_COPY.md): one full ring
     (every rank sends a (rows, dim) f32 block to its neighbor) per
@@ -2485,6 +2631,13 @@ def child_main():
             # and healthy after rejoin, recovered QPS >= 0.9x pre-kill
             ("serve_fleet", 280,
              lambda: _bench_serve_fleet(2_000, 16, 5, 3.0, 4)),
+            # fleet tracing cost proof (docs/OBSERVABILITY.md "Fleet
+            # tracing"): recording ON fleet-wide vs OFF on one warmed
+            # 2-worker fleet — overhead <= 3% qps, zero post-warmup
+            # compiles across workers, joined waterfall validates
+            ("fleet_trace_overhead", 200,
+             lambda: _bench_fleet_trace_overhead(2_000, 16, 5,
+                                                 6.0, 4)),
             # the out-of-core tier at the same 1M x 128 scale: device
             # budget = 1/4 of the slot store (~4x oversubscription),
             # recall must EQUAL the resident arm, and the double-

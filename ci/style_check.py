@@ -193,7 +193,14 @@ OPS_JAX_FILES = (os.path.join("raft_tpu", "serve", "opsplane.py"),
                  os.path.join("raft_tpu", "serve", "sentinel.py"),
                  # the fleet router aggregates worker scrapes and must
                  # never compile: same ban as the ops handlers
-                 os.path.join("raft_tpu", "fleet", "router.py"))
+                 os.path.join("raft_tpu", "fleet", "router.py"),
+                 # fleet debug/trace aggregation: the cross-process
+                 # join (worker /debug/trace payloads + clock
+                 # alignment) runs inside router and worker HTTP
+                 # handlers — a jax call here could compile or block
+                 # the serving loop mid-scrape
+                 os.path.join("raft_tpu", "fleet", "tracing.py"),
+                 os.path.join("raft_tpu", "fleet", "protocol.py"))
 OPS_JAX_MARKER = "ops-jax-ok"
 
 # tuning-registry drift lint: every config._KNOBS entry with a non-None
@@ -774,11 +781,28 @@ def _selftest_ops_jax():
         ("sentinel.py", "import jax\n", True),
         # the ban is scoped: the rest of serve/ may use jax freely
         ("scheduler.py", "import jax\n", False),
+        # fleet debug/trace aggregation path (PR 17): the join and
+        # the frame protocol are banned; worker.py is NOT (it hosts a
+        # full jax ANNService — its trace handler delegates to
+        # tracing.py, which is where the ban bites)
+        (os.path.join("..", "fleet", "tracing.py"),
+         "import jax\n", True),
+        (os.path.join("..", "fleet", "tracing.py"),
+         "from jax import numpy\n", True),
+        (os.path.join("..", "fleet", "protocol.py"),
+         "x = jax.device_count()\n", True),
+        (os.path.join("..", "fleet", "tracing.py"),
+         "import jax  # ops-jax-ok: fixture\n", False),
+        (os.path.join("..", "fleet", "tracing.py"),
+         "import json\nx = json.loads('{}')\n", False),
+        (os.path.join("..", "fleet", "worker.py"),
+         "import jax\n", False),
     ]
     failures = 0
     with tempfile.TemporaryDirectory() as tmp:
         fixdir = os.path.join(tmp, "raft_tpu", "serve")
         os.makedirs(fixdir)
+        os.makedirs(os.path.join(tmp, "raft_tpu", "fleet"))
         for i, (fname, src, expect) in enumerate(cases):
             path = os.path.join(fixdir, fname)
             with open(path, "w", encoding="utf-8") as f:

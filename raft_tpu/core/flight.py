@@ -69,7 +69,8 @@ from raft_tpu.core import metrics as _metrics
 __all__ = [
     "Event", "Trace", "FlightRecorder", "SLOTracker", "Exemplars",
     "TERMINAL_KINDS", "default_recorder", "record", "record_scoped",
-    "batch_scope", "set_enabled", "is_enabled", "slo_for",
+    "batch_scope", "trace_context", "current_trace_context",
+    "fleet_traces", "set_enabled", "is_enabled", "slo_for",
     "exemplars_for", "slo_snapshot", "exemplars_snapshot",
     "flight_snapshot", "reset",
 ]
@@ -87,6 +88,17 @@ TRACE_MAX_EVENTS = 256
 
 # black-box snapshots retained in memory (each is a bounded event list)
 BLACKBOX_KEEP = 8
+
+# distinct fleet trace ids whose local Trace objects the recorder
+# indexes (FIFO-evicted).  Each entry holds at most a handful of
+# traces (one per RPC attempt that landed here), so the bound is the
+# memory contract for the fleet join path the same way ``capacity``
+# is for the ring.
+FLEET_TRACE_KEEP = 512
+
+# local traces retained per fleet id (retries/hedges to the same
+# process each open a fresh local trace under the same fleet id)
+FLEET_TRACES_PER_ID = 8
 
 
 def set_enabled(on: bool) -> None:
@@ -140,7 +152,8 @@ class Trace:
     atomic under the GIL; the producers are already sequenced by the
     request lifecycle (submit → worker → resolve)."""
 
-    __slots__ = ("trace_id", "service", "tenant", "events", "dropped")
+    __slots__ = ("trace_id", "service", "tenant", "events", "dropped",
+                 "fleet")
 
     def __init__(self, trace_id: int, service: Optional[str],
                  tenant: Optional[str]):
@@ -149,6 +162,11 @@ class Trace:
         self.tenant = tenant
         self.events: List[Event] = []
         self.dropped = 0
+        # fleet trace context this request rides under (propagated by
+        # the router: {"id", "parent", "sent_at"}), or None for a
+        # plain in-process request — see docs/OBSERVABILITY.md
+        # "Fleet tracing"
+        self.fleet: Optional[dict] = None
 
     def add(self, ev: Event) -> None:
         if len(self.events) >= TRACE_MAX_EVENTS:
@@ -179,9 +197,12 @@ class Trace:
         return evs[-1].ts - evs[0].ts
 
     def to_dict(self) -> dict:
-        return {"trace_id": self.trace_id, "service": self.service,
-                "tenant": self.tenant, "terminal": self.terminal(),
-                "dropped": self.dropped, "events": self.timeline()}
+        out = {"trace_id": self.trace_id, "service": self.service,
+               "tenant": self.tenant, "terminal": self.terminal(),
+               "dropped": self.dropped, "events": self.timeline()}
+        if self.fleet is not None:
+            out["fleet"] = dict(self.fleet)
+        return out
 
 
 # -- batch scope: the worker binds the current batch's rider traces to
@@ -205,6 +226,28 @@ def batch_scope(traces: Sequence[Optional[Trace]]):
 
 def _scope_traces() -> Tuple[Trace, ...]:
     return getattr(_tls, "scope", None) or ()
+
+
+@contextlib.contextmanager
+def trace_context(ctx: Optional[dict]):
+    """Bind a propagated fleet trace context (``{"id", "parent",
+    "sent_at"}``) to the calling thread: every :meth:`new_trace` created
+    inside the block is stamped with it and indexed by fleet id, so a
+    worker process can later serve its half of the cross-process
+    waterfall (docs/OBSERVABILITY.md "Fleet tracing").  ``ctx=None``
+    is a no-op block, so callers can pass through whatever the wire
+    carried without branching."""
+    prev = getattr(_tls, "fleet_ctx", None)
+    _tls.fleet_ctx = dict(ctx) if ctx else None
+    try:
+        yield
+    finally:
+        _tls.fleet_ctx = prev
+
+
+def current_trace_context() -> Optional[dict]:
+    """The calling thread's propagated fleet trace context, if any."""
+    return getattr(_tls, "fleet_ctx", None)
 
 
 class FlightRecorder:
@@ -238,18 +281,43 @@ class FlightRecorder:
         self._trace_seq = itertools.count(1)
         self._clock = clock
         self._dump_seq = itertools.count(1)
+        # fleet id -> local Trace objects created under that context
+        # (insertion-ordered; FIFO-evicted at FLEET_TRACE_KEEP ids).
+        # This is what lets the worker answer /debug/trace for a fleet
+        # id even after the global ring has wrapped.
+        self._fleet: Dict[str, List[Trace]] = {}
 
     # ------------------------------------------------------------------ #
     # producers
     # ------------------------------------------------------------------ #
     def new_trace(self, service: Optional[str] = None,
-                  tenant: Optional[str] = None) -> Optional[Trace]:
+                  tenant: Optional[str] = None, *,
+                  fleet: Optional[dict] = None) -> Optional[Trace]:
         """A fresh request trace with a process-unique id, or None when
         recording is disabled (callers treat a None trace as 'no
-        tracing' everywhere)."""
+        tracing' everywhere).  ``fleet`` (or, when absent, the calling
+        thread's :func:`trace_context`) stamps the trace with a
+        propagated fleet context and indexes it by fleet id for the
+        cross-process join."""
         if not _enabled:
             return None
-        return Trace(next(self._trace_seq), service, tenant)
+        tr = Trace(next(self._trace_seq), service, tenant)
+        ctx = fleet if fleet is not None else current_trace_context()
+        if ctx and ctx.get("id") is not None:
+            tr.fleet = dict(ctx)
+            self._index_fleet(tr)
+        return tr
+
+    def _index_fleet(self, trace: Trace) -> None:
+        fid = str(trace.fleet["id"])  # type: ignore[index]
+        with self._lock:
+            lst = self._fleet.get(fid)
+            if lst is None:
+                while len(self._fleet) >= FLEET_TRACE_KEEP:
+                    self._fleet.pop(next(iter(self._fleet)))
+                lst = self._fleet[fid] = []
+            if len(lst) < FLEET_TRACES_PER_ID:
+                lst.append(trace)
 
     def record(self, kind: str, service: Optional[str] = None,
                tenant: Optional[str] = None,
@@ -276,6 +344,15 @@ class FlightRecorder:
             # gone (tools/trace_report.py reads `traces`)
             ring_attrs = dict(attrs or {},
                               traces=[t.trace_id for t in riders])
+            fids = sorted({str(t.fleet["id"]) for t in riders
+                           if t.fleet is not None
+                           and t.fleet.get("id") is not None})
+            if fids:
+                ring_attrs["fleet"] = fids
+        elif trace is not None and trace.fleet is not None:
+            fid = trace.fleet.get("id")
+            if fid is not None:
+                ring_attrs = dict(attrs or {}, fleet=str(fid))
         ev = Event(self._clock(), kind, service, tenant,
                    trace.trace_id if trace is not None else None,
                    ring_attrs)
@@ -313,6 +390,19 @@ class FlightRecorder:
         if last is not None:
             evs = evs[-int(last):]
         return evs
+
+    def fleet_traces(self, fleet_id: str) -> List[Trace]:
+        """The local Trace objects created under the given fleet trace
+        context (empty when unknown or evicted) — the worker's half of
+        ``/fleet/debug/trace/<id>``.  Survives ring wrap: the Trace
+        keeps its own bounded event list."""
+        with self._lock:
+            return list(self._fleet.get(str(fleet_id), ()))
+
+    def fleet_trace_ids(self) -> List[str]:
+        """Indexed fleet ids, oldest first."""
+        with self._lock:
+            return list(self._fleet)
 
     def __len__(self) -> int:
         with self._lock:
@@ -381,10 +471,12 @@ class FlightRecorder:
         return state
 
     def clear(self) -> None:
-        """Drop every event and black box (test isolation)."""
+        """Drop every event, black box and fleet index entry (test
+        isolation)."""
         with self._lock:
             self._ring.clear()
             self._blackboxes.clear()
+            self._fleet.clear()
 
 
 # ---------------------------------------------------------------------- #
@@ -581,6 +673,11 @@ def record_scoped(kind: str, **kwargs: Any) -> Optional[Event]:
     if not _enabled:
         return None
     return default_recorder().record_scoped(kind, **kwargs)
+
+
+def fleet_traces(fleet_id: str) -> List[Trace]:
+    """``default_recorder().fleet_traces(...)`` convenience."""
+    return default_recorder().fleet_traces(fleet_id)
 
 
 def slo_for(service: str, target_s: float, objective: float,

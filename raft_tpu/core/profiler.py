@@ -45,7 +45,8 @@ from raft_tpu.core import metrics as _metrics
 from raft_tpu.core import tracing
 
 __all__ = ["Profiler", "default_profiler", "profiled", "profiled_jit",
-           "compile_cache_stats", "reset_compile_cache_stats"]
+           "compile_cache_stats", "reset_compile_cache_stats",
+           "last_jit_fn"]
 
 
 class _SpanNode:
@@ -104,6 +105,25 @@ _NULL = _NullScope()
 # "jit.<fn>" spans) attributes to its caller's profiler, so a
 # handle-scoped profiler's tree keeps its compile/execute children
 _tls_active = threading.local()
+
+# last profiled_jit executable run on each thread — the serve
+# scheduler's attribution key for the device-complete roofline
+# bracket (``raft_tpu_serve_device_seconds{fn=...}``): the scheduler
+# can't name the program behind its opaque ``execute`` closure, but
+# the wrapper that just ran on its batch thread can
+_tls_last_jit = threading.local()
+
+
+def last_jit_fn() -> Optional[str]:
+    """Name of the most recent :func:`profiled_jit` executable run on
+    THIS thread (None if none ran since :func:`_clear_last_jit_fn`).
+    Matches the cost inventory's per-fn key, so callers can join
+    wall-clock brackets against ``inventory.summary()["per_fn"]``."""
+    return getattr(_tls_last_jit, "fn", None)
+
+
+def _clear_last_jit_fn() -> None:
+    _tls_last_jit.fn = None
 
 
 def _current_profiler() -> "Profiler":
@@ -458,6 +478,7 @@ def profiled_jit(fn=None, *, name: Optional[str] = None,
             if entry[0] == "lazy":
                 # no AOT split for this key: run the (compiling) first
                 # call once and attribute its full time to compile
+                _tls_last_jit.fn = fn_name
                 with _current_profiler().span("jit.%s" % fn_name,
                                               layer="jit"):
                     out = (jitted(*args, **kwargs) if has_varargs
@@ -476,6 +497,7 @@ def profiled_jit(fn=None, *, name: Optional[str] = None,
                     help="instrumented-jit compile-cache hits").inc()
             with _jit_lock:
                 st["hits"] += 1
+        _tls_last_jit.fn = fn_name
         with _current_profiler().span("jit.%s" % fn_name, layer="jit"):
             if entry[0] == "aot":
                 return entry[1](**dyn_kw)

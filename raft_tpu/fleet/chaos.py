@@ -53,7 +53,8 @@ class FrameFaults:
     doc)."""
 
     _IDEMPOTENT = ("/search", "/metrics", "/healthz", "/statusz",
-                   "/debug/snapshot", "/info")
+                   "/debug/snapshot", "/debug/trace", "/debug/flight",
+                   "/info")
 
     def __init__(self, seed: int, base=protocol.http_transport,
                  clock: Callable[[], float] = time.monotonic):
@@ -77,7 +78,8 @@ class FrameFaults:
         with self._lock:
             self._until = 0.0
 
-    def __call__(self, method: str, url: str, body, timeout: float):
+    def __call__(self, method: str, url: str, body, timeout: float,
+                 headers=None):
         with self._lock:
             active = self._clock() < self._until
             drop = active and self._rng.random() < self._drop_p
@@ -87,7 +89,11 @@ class FrameFaults:
                 self.injected["drop"] += 1
             raise CommError("chaos: injected frame drop (%s %s)"
                             % (method, url))
-        status, data = self._base(method, url, body, timeout)
+        if headers:
+            status, data = self._base(method, url, body, timeout,
+                                      headers)
+        else:
+            status, data = self._base(method, url, body, timeout)
         if garble and any(url.endswith(p) or ("%s?" % p) in url
                           for p in self._IDEMPOTENT):
             with self._lock:

@@ -42,11 +42,50 @@ from raft_tpu.core.error import (CommError, CommTimeoutError, LogicError,
 __all__ = [
     "encode_error", "decode_error", "error_response", "http_transport",
     "post_json", "get_json", "get_text", "rendezvous", "rendezvous_rank",
-    "merge_topk",
+    "merge_topk", "trace_frame", "parse_trace", "TRACE_HEADER",
 ]
 
 # status codes the router treats as "the body is a typed raft error"
 ERROR_STATUSES = (409, 429, 500, 503, 504)
+
+# HTTP header mirroring the in-body trace context (body is the
+# authoritative carrier — the header exists so generic proxies/tcpdump
+# sessions can follow a fleet request without parsing JSON bodies)
+TRACE_HEADER = "X-Raft-Fleet-Trace"
+
+
+# ---------------------------------------------------------------------- #
+# propagated trace context
+# ---------------------------------------------------------------------- #
+def trace_frame(fleet_id: str, parent: str,
+                sent_at: float) -> dict:
+    """The propagated fleet trace context: the fleet-wide request id,
+    the span that dispatched this hop (``parent``), and the sender's
+    monotonic clock at send time (``sent_at`` — the receiver reports
+    its own clocks; alignment happens router-side from the heartbeat
+    clock-offset estimate, docs/OBSERVABILITY.md "Fleet tracing")."""
+    return {"id": str(fleet_id), "parent": str(parent),
+            "sent_at": round(float(sent_at), 6)}
+
+
+def parse_trace(obj) -> Optional[dict]:
+    """Validate a wire-carried trace context.  Accepts the structured
+    frame (dict with ``id``) or a legacy opaque id string; anything
+    else — including a garbled frame — degrades to None (tracing is
+    best-effort; a bad context must never fail the request)."""
+    if isinstance(obj, str) and obj:
+        return {"id": obj}
+    if isinstance(obj, dict) and obj.get("id") is not None:
+        out = {"id": str(obj["id"])}
+        if obj.get("parent") is not None:
+            out["parent"] = str(obj["parent"])
+        try:
+            if obj.get("sent_at") is not None:
+                out["sent_at"] = float(obj["sent_at"])
+        except (TypeError, ValueError):
+            pass
+        return out
+    return None
 
 
 # ---------------------------------------------------------------------- #
@@ -116,15 +155,19 @@ def error_response(exc: BaseException) -> Tuple[int, dict]:
 # transport
 # ---------------------------------------------------------------------- #
 def http_transport(method: str, url: str, body: Optional[bytes],
-                   timeout: float) -> Tuple[int, bytes]:
+                   timeout: float,
+                   headers: Optional[dict] = None) -> Tuple[int, bytes]:
     """One HTTP exchange → ``(status, body_bytes)``.  Transport-layer
     failures raise typed comm errors (module doc); HTTP error statuses
     are RETURNED (the caller decodes the typed body), not raised.
     This is the seam the chaos harness wraps to inject dropped and
-    garbled frames."""
+    garbled frames.  ``headers`` adds extra request headers (the trace
+    context mirror, :data:`TRACE_HEADER`)."""
     req = urllib.request.Request(url, data=body, method=method)
     if body is not None:
         req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return int(resp.status), resp.read()
@@ -162,9 +205,22 @@ def _decode_body(status: int, data: bytes, url: str) -> dict:
 
 
 def post_json(url: str, payload: dict, *, timeout: float,
-              transport=http_transport) -> dict:
+              transport=http_transport,
+              trace: Optional[dict] = None) -> dict:
+    """POST a JSON frame.  ``trace`` mirrors the in-body trace context
+    into :data:`TRACE_HEADER`; transports that predate the header
+    parameter (injected test doubles) are still accepted — the body
+    remains the authoritative carrier."""
     body = json.dumps(payload).encode("utf-8")
-    status, data = transport("POST", url, body, timeout)
+    if trace is not None:
+        headers = {TRACE_HEADER: json.dumps(trace, sort_keys=True)}
+        try:
+            status, data = transport("POST", url, body, timeout,
+                                     headers)
+        except TypeError:
+            status, data = transport("POST", url, body, timeout)
+    else:
+        status, data = transport("POST", url, body, timeout)
     return _decode_body(status, data, url)
 
 

@@ -55,6 +55,7 @@ import json
 import re
 import threading
 import time
+import urllib.parse
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -64,7 +65,7 @@ from raft_tpu.core import metrics as _metrics
 from raft_tpu.core.error import (CommError, CommTimeoutError, LogicError,
                                  RaftError, ServiceOverloadError,
                                  ServiceUnavailableError, expects)
-from raft_tpu.fleet import protocol
+from raft_tpu.fleet import protocol, tracing
 from raft_tpu.serve import sentinel as _sentinel
 
 __all__ = ["Router"]
@@ -104,7 +105,10 @@ def _relabel_metrics(text: str, worker: str,
         if m is None:
             continue  # never forward a garbled line to a scraper
         name, _, labels, value = m.groups()
-        inner = 'worker="%s"' % worker
+        # worker ids are operator input (hostile names included):
+        # escape per the prometheus text format or the aggregated
+        # surface stops round-tripping through parse_prometheus
+        inner = 'worker="%s"' % _metrics._escape(worker)
         if labels:
             inner = "%s,%s" % (labels, inner)
         out.append("%s{%s} %s" % (name, inner, value))
@@ -117,7 +121,8 @@ class _WorkerHandle:
     __slots__ = ("worker_id", "generation", "pid", "host", "data_port",
                  "ops_port", "shard_index", "state", "last_beat",
                  "wal_seq", "queue_depth", "registered_t", "restore",
-                 "backpressure_until", "dead_t")
+                 "backpressure_until", "dead_t", "clock_offset",
+                 "clock_rtt")
 
     def __init__(self, worker_id: str):
         self.worker_id = worker_id
@@ -135,6 +140,11 @@ class _WorkerHandle:
         self.restore: Dict[str, object] = {}
         self.backpressure_until = 0.0
         self.dead_t = 0.0
+        # NTP-style clock alignment, estimated worker-side over the
+        # heartbeat ping and reported back: router_clock = worker_clock
+        # + clock_offset, trustworthy to ~clock_rtt / 2
+        self.clock_offset = 0.0
+        self.clock_rtt = 0.0
 
     @property
     def data_url(self) -> str:
@@ -151,6 +161,8 @@ class _WorkerHandle:
                 "data_port": self.data_port, "ops_port": self.ops_port,
                 "wal_seq": self.wal_seq,
                 "queue_depth": self.queue_depth,
+                "clock_offset_s": round(self.clock_offset, 6),
+                "clock_rtt_s": round(self.clock_rtt, 6),
                 "restore": dict(self.restore)}
 
 
@@ -219,6 +231,19 @@ class Router:
         self._pool = ThreadPoolExecutor(
             max_workers=16,
             thread_name_prefix="raft-tpu-%s" % self._name)
+        # fleet-level SLO burn + slowest-K exemplars: the router is
+        # the only process that sees true client latency, so the
+        # "fleet" service gets its own tracker next to the per-worker
+        # ones the aggregation surfaces roll up
+        self._slo = flight.slo_for(
+            "fleet",
+            target_s=config.get_float("serve_slo_target_ms") / 1e3,
+            objective=config.get_float("serve_slo_objective"),
+            windows_s=tuple(sorted(
+                float(w) for w in
+                config.get_float_list("serve_slo_windows_s"))),
+            clock=clock)
+        self._exemplars = flight.exemplars_for("fleet")
         self.sentinel = (_sentinel.AnomalySentinel(
             lambda: {"fleet": self}, clock=clock)
             if sentinel else None)
@@ -341,9 +366,12 @@ class Router:
                           generation=h.generation,
                           shard=h.shard_index)
         self._publish_worker_gauges()
+        # "now" seeds the worker's clock-offset estimator (NTP-style
+        # midpoint over this very exchange) before the first heartbeat
         return 200, {"ok": True,
                      "lease_interval_s": self._lease_interval,
-                     "rejoin": bool(rejoin)}
+                     "rejoin": bool(rejoin),
+                     "now": round(now, 6)}
 
     def _on_heartbeat(self, body: dict) -> Tuple[int, dict]:
         wid = str(body.get("worker_id", ""))
@@ -353,11 +381,26 @@ class Router:
             if h is None or h.state == "dead":
                 # evicted (or unknown): tell the survivor to rejoin —
                 # a long hang must not leave a live-but-unrouted zombie
-                return 200, {"ok": False, "rereg": True}
+                return 200, {"ok": False, "rereg": True,
+                             "now": round(now, 6)}
             h.last_beat = now
             h.wal_seq = int(body.get("wal_seq", h.wal_seq))
             h.queue_depth = int(body.get("queue_depth", 0))
-        return 200, {"ok": True}
+            if body.get("clock_offset_s") is not None:
+                try:
+                    h.clock_offset = float(body["clock_offset_s"])
+                    h.clock_rtt = float(body.get("clock_rtt_s", 0.0))
+                except (TypeError, ValueError):
+                    pass  # a garbled estimate must not drop the beat
+        _gauge("raft_tpu_fleet_clock_offset_seconds",
+               "estimated worker->router monotonic clock offset "
+               "(router = worker + offset), NTP-style over the "
+               "heartbeat ping", worker=wid).set(h.clock_offset)
+        _gauge("raft_tpu_fleet_clock_rtt_seconds",
+               "heartbeat round-trip time backing the clock-offset "
+               "estimate (alignment is trusted to ~rtt/2)",
+               worker=wid).set(h.clock_rtt)
+        return 200, {"ok": True, "now": round(now, 6)}
 
     def _lease_loop(self) -> None:
         while not self._stop.wait(self._lease_interval):
@@ -464,27 +507,28 @@ class Router:
         timeout = self._timeout if timeout_s is None else float(
             timeout_s)
         rid = request_id or "flt-%08d" % next(self._rid_seq)
-        self._admit(rid, "search")
+        rtrace = self._new_trace(rid, tenant)
+        self._admit(rid, "search", rtrace)
         t0 = self._clock()
         deadline = t0 + timeout
         try:
             if self.mode == "replicated":
                 out = self._search_replicated(list(vectors), tenant,
-                                              deadline, rid)
+                                              deadline, rid, rtrace)
             else:
                 out = self._search_sharded(list(vectors), tenant,
-                                           deadline, rid)
+                                           deadline, rid, rtrace)
         except CommTimeoutError as e:
-            self._terminal(rid, "search", "expired", t0,
-                           error=type(e).__name__)
+            self._terminal(rid, "search", "expired", t0, rtrace,
+                           tenant=tenant, error=type(e).__name__)
             raise
         except BaseException as e:
-            self._terminal(rid, "search", "failed", t0,
-                           error=type(e).__name__)
+            self._terminal(rid, "search", "failed", t0, rtrace,
+                           tenant=tenant, error=type(e).__name__)
             raise
         else:
-            self._terminal(rid, "search", "resolved", t0,
-                           degraded=out["degraded"])
+            self._terminal(rid, "search", "resolved", t0, rtrace,
+                           tenant=tenant, degraded=out["degraded"])
             if out["degraded"]:
                 _counter("raft_tpu_fleet_degraded_total",
                          "partial (degraded-flagged) fleet responses"
@@ -495,7 +539,15 @@ class Router:
             with self._lock:
                 self._inflight -= 1
 
-    def _admit(self, rid: str, op: str) -> None:
+    def _new_trace(self, rid: str, tenant: Optional[str]):
+        """The router's own span timeline for one fleet request,
+        indexed by the fleet id (= the request id) in the router-local
+        flight ring — the half of ``/fleet/debug/trace/<id>`` this
+        process owns."""
+        return flight.default_recorder().new_trace(
+            "fleet", tenant, fleet={"id": rid, "parent": "client"})
+
+    def _admit(self, rid: str, op: str, trace=None) -> None:
         with self._lock:
             if self._closed:
                 raise ServiceUnavailableError(
@@ -509,14 +561,16 @@ class Router:
                     self._inflight_cap,
                     retry_after_s=self._lease_interval)
             self._inflight += 1
-        flight.record("fleet_admitted", service="fleet", rid=rid,
-                      op=op)
+        flight.record("fleet_admitted", service="fleet", trace=trace,
+                      rid=rid, op=op)
 
     def _terminal(self, rid: str, op: str, outcome: str, t0: float,
+                  trace=None, tenant: Optional[str] = None,
                   **attrs) -> None:
         latency = max(0.0, self._clock() - t0)
-        flight.record("fleet_%s" % outcome, service="fleet", rid=rid,
-                      op=op, latency_s=round(latency, 6), **attrs)
+        flight.record("fleet_%s" % outcome, service="fleet",
+                      trace=trace, rid=rid, op=op,
+                      latency_s=round(latency, 6), **attrs)
         _counter("raft_tpu_fleet_requests_total",
                  "fleet requests by terminal outcome",
                  outcome=outcome).inc()
@@ -524,11 +578,16 @@ class Router:
             "raft_tpu_fleet_request_seconds",
             help="router end-to-end request latency",
             labels=("op",)).labels(op=op).observe(latency)
+        self._slo.observe(tenant, latency,
+                          deadline_ok=(outcome == "resolved"))
+        if trace is not None:
+            self._exemplars.observe(latency, trace.trace_id)
 
-    def _search_sharded(self, vectors, tenant, deadline, rid) -> dict:
+    def _search_sharded(self, vectors, tenant, deadline, rid,
+                        trace=None) -> dict:
         shards = list(range(self.shard_count))
         futs = {self._pool.submit(self._query_shard, s, vectors,
-                                  tenant, deadline, rid): s
+                                  tenant, deadline, rid, trace): s
                 for s in shards}
         parts, answered = [], []
         remaining = max(0.0, deadline - self._clock())
@@ -548,6 +607,9 @@ class Router:
         k = max(len(row) for d, _ in parts for row in d)
         dists, ids = protocol.merge_topk(parts, k)
         degraded = len(parts) < len(shards)
+        flight.record("fleet_merge", service="fleet", trace=trace,
+                      rid=rid, parts=len(parts), k=k,
+                      degraded=degraded)
         return {"distances": dists, "ids": ids, "degraded": degraded,
                 "shards_answered": sorted(answered),
                 "shards_total": len(shards), "hedged": False}
@@ -560,8 +622,63 @@ class Router:
                     return h
         return None
 
+    def _rpc(self, h: _WorkerHandle, path: str, body: dict,
+             remaining: float, rid: str, trace, attempt: int) -> dict:
+        """One traced router→worker exchange: the propagated trace
+        context rides the body (and the :data:`protocol.TRACE_HEADER`
+        mirror), the span pair ``fleet_rpc_send``/``fleet_rpc_recv``
+        lands in the router's flight ring, and the network residual
+        (wire + queue time outside the worker's own handler clock)
+        feeds ``raft_tpu_fleet_network_seconds`` per worker."""
+        sent_at = self._clock()
+        tctx = protocol.trace_frame(rid, "router", sent_at)
+        body = dict(body)
+        body["trace"] = tctx
+        flight.record("fleet_rpc_send", service="fleet", trace=trace,
+                      rid=rid, worker=h.worker_id, path=path,
+                      attempt=attempt)
+        try:
+            rep = protocol.post_json(
+                h.data_url + path, body, timeout=remaining + 1.0,
+                transport=self._transport, trace=tctx)
+        except BaseException as e:
+            flight.record("fleet_rpc_fail", service="fleet",
+                          trace=trace, rid=rid, worker=h.worker_id,
+                          path=path, attempt=attempt,
+                          error=type(e).__name__)
+            raise
+        elapsed = max(0.0, self._clock() - sent_at)
+        server_s = rep.get("server_seconds")
+        network_s = None
+        if server_s is not None:
+            try:
+                network_s = max(0.0, elapsed - float(server_s))
+            except (TypeError, ValueError):
+                server_s = None
+        # a hedged loser's reply lands AFTER the request already
+        # terminated (first success won); tag it so the join keeps
+        # the straggler visible without it breaking the RPC-bracket
+        # invariants or stretching the merge segment
+        late = trace is not None and any(
+            e.get("kind") in tracing.ROUTER_TERMINALS
+            for e in trace.timeline())
+        extra = {"late": True} if late else {}
+        flight.record("fleet_rpc_recv", service="fleet", trace=trace,
+                      rid=rid, worker=h.worker_id, path=path,
+                      attempt=attempt, elapsed_s=round(elapsed, 6),
+                      server_s=server_s, network_s=network_s, **extra)
+        if network_s is not None:
+            _metrics.default_registry().timer(
+                "raft_tpu_fleet_network_seconds",
+                help="router->worker RPC time outside the worker's "
+                     "own handler (wire + accept-queue residual), "
+                     "per worker",
+                labels=("worker",)).labels(
+                    worker=h.worker_id).observe(network_s)
+        return rep
+
     def _query_shard(self, shard, vectors, tenant, deadline,
-                     rid) -> Optional[tuple]:
+                     rid, trace=None) -> Optional[tuple]:
         """One shard's retry loop.  Returns ``(distances, ids)`` or
         None when the shard stayed unreachable through the deadline —
         the caller degrades instead of failing closed.  Caller bugs
@@ -578,13 +695,11 @@ class Router:
             wait_s = backoff
             if h is not None:
                 try:
-                    rep = protocol.post_json(
-                        h.data_url + "/search",
+                    rep = self._rpc(
+                        h, "/search",
                         {"vectors": vectors, "tenant": tenant,
-                         "timeout_s": round(remaining, 3),
-                         "trace": rid},
-                        timeout=remaining + 1.0,
-                        transport=self._transport)
+                         "timeout_s": round(remaining, 3)},
+                        remaining, rid, trace, attempt)
                     return rep["distances"], rep["ids"]
                 except LogicError:
                     raise
@@ -605,16 +720,17 @@ class Router:
             backoff *= 2.0
 
     def _search_replicated(self, vectors, tenant, deadline,
-                           rid) -> dict:
+                           rid, trace=None) -> dict:
         order = protocol.rendezvous_rank(tenant or rid,
                                          self.active_workers())
         if not order:
             raise ServiceUnavailableError(
                 "fleet has no live workers", "fleet", "no_workers",
                 retry_after_s=self._lease_interval)
-        payload = {"vectors": vectors, "tenant": tenant, "trace": rid}
+        payload = {"vectors": vectors, "tenant": tenant}
         futs = {self._pool.submit(self._query_worker, order[0],
-                                  payload, deadline): order[0]}
+                                  payload, deadline, rid=rid,
+                                  trace=trace): order[0]}
         hedged = False
         last_error: Optional[BaseException] = None
         winner = None
@@ -657,12 +773,17 @@ class Router:
                 hedged = True
                 _counter("raft_tpu_fleet_hedges_total",
                          "hedged cross-worker re-dispatches").inc()
+                flight.record("fleet_hedge", service="fleet",
+                              trace=trace, rid=rid, worker=order[1],
+                              primary=order[0])
                 futs[self._pool.submit(self._query_worker, order[1],
-                                       payload, deadline)] = order[1]
+                                       payload, deadline, rid=rid,
+                                       trace=trace)] = order[1]
 
     def _query_worker(self, worker_id: str, payload: dict,
                       deadline: float, *, path: str = "/search",
-                      op: str = "search") -> dict:
+                      op: str = "search",
+                      rid: Optional[str] = None, trace=None) -> dict:
         """Pinned-worker retry loop (replicated queries, insert
         groups): retries the SAME worker — cross-worker failover is
         the hedger's/owner-contract's decision, not this loop's."""
@@ -684,6 +805,9 @@ class Router:
                 try:
                     body = dict(payload)
                     body["timeout_s"] = round(remaining, 3)
+                    if rid is not None:
+                        return self._rpc(h, path, body, remaining,
+                                         rid, trace, attempt)
                     return protocol.post_json(
                         h.data_url + path,
                         body, timeout=remaining + 1.0,
@@ -749,14 +873,15 @@ class Router:
         timeout = self._timeout if timeout_s is None else float(
             timeout_s)
         rid = request_id or "flt-%08d" % next(self._rid_seq)
-        self._admit(rid, "insert")
+        rtrace = self._new_trace(rid, None)
+        self._admit(rid, "insert", rtrace)
         t0 = self._clock()
         deadline = t0 + timeout
         try:
             return self._insert_admitted(ids, vectors, rid, t0,
-                                         deadline)
+                                         deadline, rtrace)
         except BaseException as e:
-            self._terminal(rid, "insert", "failed", t0,
+            self._terminal(rid, "insert", "failed", t0, rtrace,
                            error=type(e).__name__)
             raise
         finally:
@@ -764,7 +889,7 @@ class Router:
                 self._inflight -= 1
 
     def _insert_admitted(self, ids, vectors, rid: str, t0: float,
-                         deadline: float) -> dict:
+                         deadline: float, rtrace=None) -> dict:
         with self._lock:
             roster = list(self._roster)
         if not roster:
@@ -778,7 +903,8 @@ class Router:
             g[0].append(int(i))
             g[1].append(v)
         futs = {self._pool.submit(self._insert_group, wid, g[0],
-                                  g[1], deadline): (wid, g[0])
+                                  g[1], deadline, rid,
+                                  rtrace): (wid, g[0])
                 for wid, g in groups.items()}
         acked: List[int] = []
         errors: List[dict] = []
@@ -801,13 +927,14 @@ class Router:
             wal[wid] = int(rep.get("wal_seq", 0))
         ok = not errors and len(acked) == len(ids)
         self._terminal(rid, "insert",
-                       "resolved" if ok else "failed", t0,
+                       "resolved" if ok else "failed", t0, rtrace,
                        acked=len(acked), failed_groups=len(errors))
         return {"ok": ok, "request_id": rid, "acked_ids": sorted(acked),
                 "errors": errors, "wal": wal}
 
     def _insert_group(self, worker_id: str, gids: list, gvecs: list,
-                      deadline: float) -> dict:
+                      deadline: float, rid: Optional[str] = None,
+                      trace=None) -> dict:
         with self._lock:
             h = self._handles.get(worker_id)
             if h is not None and h.state == "draining":
@@ -828,7 +955,7 @@ class Router:
         return self._query_worker(worker_id,
                                   {"ids": gids, "vectors": gvecs},
                                   deadline, path="/insert",
-                                  op="insert")
+                                  op="insert", rid=rid, trace=trace)
 
     # ------------------------------------------------------------------ #
     # aggregation surfaces
@@ -913,12 +1040,70 @@ class Router:
                                  if self.sentinel is not None
                                  else None)}
 
+    def fleet_trace(self, fleet_id: str) -> Tuple[int, dict]:
+        """``/fleet/debug/trace/<id>``: the cross-process waterfall —
+        the router's own hop spans joined with every involved worker's
+        local timeline (fetched live from the worker's ``/debug/trace``
+        endpoint), each worker's clock shifted by its heartbeat-
+        estimated offset.  The reply carries the joined ``spans``, the
+        per-hop summaries, the alignment metadata, and the waterfall
+        invariant ``problems`` (empty = monotonic and gapless) —
+        ``tools/trace_report.py`` renders it."""
+        fleet_id = str(fleet_id)
+        router_events: List[dict] = []
+        for t in flight.fleet_traces(fleet_id):
+            router_events.extend(t.timeline())
+        if not router_events:
+            _counter("raft_tpu_fleet_trace_joins_total",
+                     "cross-process trace joins by outcome",
+                     outcome="missing").inc()
+            return 404, {"error": "NotFound",
+                         "message": "unknown fleet trace %r (evicted "
+                                    "or never admitted)" % fleet_id}
+        wids = sorted({str(e["worker"]) for e in router_events
+                       if e.get("worker") is not None})
+        workers: Dict[str, dict] = {}
+        partial = False
+        for wid in wids:
+            with self._lock:
+                h = self._handles.get(wid)
+                offset = h.clock_offset if h is not None else 0.0
+                rtt = h.clock_rtt if h is not None else 0.0
+                url = (h.data_url if h is not None and h.data_port
+                       else None)
+            payload = None
+            if url is not None:
+                status, data = self._scrape(
+                    "%s/debug/trace?id=%s"
+                    % (url, urllib.parse.quote(fleet_id, safe="")))
+                if status == 200:
+                    try:
+                        payload = json.loads(data.decode("utf-8"))
+                    except ValueError:
+                        payload = None
+            if payload is None:
+                partial = True  # dead/unreachable worker: router half
+                payload = {}    # of the hop still renders
+            workers[wid] = {"offset_s": offset, "rtt_s": rtt,
+                            "payload": payload}
+        joined = tracing.join(fleet_id, router_events, workers)
+        joined["partial"] = partial
+        joined["problems"] = tracing.validate(joined)
+        _counter("raft_tpu_fleet_trace_joins_total",
+                 "cross-process trace joins by outcome",
+                 outcome="partial" if partial else "ok").inc()
+        return 200, joined
+
     def fleet_snapshot(self) -> dict:
         """The ``/debug/snapshot`` payload ``tools/metrics_report.py
         --url`` consumes: router registry + per-worker digests + a
         fleet-wide rollup (p99 from the router's own end-to-end timer
         — the only process that sees true client latency)."""
         digests: Dict[str, dict] = {}
+        exemplars: List[dict] = []
+        for ex in flight.exemplars_for("fleet").snapshot():
+            exemplars.append(dict(ex, worker="router",
+                                  service="fleet"))
         for wid, pub in self.registry().items():
             digest = {"state": pub["state"],
                       "generation": pub["generation"],
@@ -935,7 +1120,17 @@ class Router:
                         snap = {}
                     digest.update(self._digest(
                         snap.get("metrics") or {}))
+                    for svc, entries in sorted(
+                            ((snap.get("flight") or {})
+                             .get("exemplars") or {}).items()):
+                        for ex in entries:
+                            exemplars.append(dict(
+                                ex, worker=wid, service=svc))
             digests[wid] = digest
+        # fleet-wide slowest-K with per-worker labels: a p99 number on
+        # the rollup links straight to the process that produced it
+        exemplars.sort(key=lambda e: -float(e.get("latency_ms", 0.0)))
+        del exemplars[8:]
         reg = _metrics.default_registry()
         rollup = {"workers_total": len(digests),
                   "workers_dead": sum(
@@ -943,7 +1138,8 @@ class Router:
                       if d["state"] == "dead"),
                   "slo_burn_max": max(
                       [d.get("slo_burn", 0.0)
-                       for d in digests.values()] or [0.0])}
+                       for d in digests.values()] or [0.0]),
+                  "exemplars": exemplars}
         fam = reg.get("raft_tpu_fleet_request_seconds")
         total_reqs = 0
         if fam is not None:
@@ -1002,6 +1198,8 @@ class Router:
             "/register", "/heartbeat", "/search", "/insert",
             "/fleet/healthz", "/fleet/metrics", "/fleet/statusz",
             "/healthz", "/metrics", "/debug/snapshot") else "unknown"
+        if path.startswith("/fleet/debug/trace/"):
+            endpoint = "/fleet/debug/trace"
         try:
             body = {}
             if method == "POST":
@@ -1062,4 +1260,8 @@ class Router:
                                  else self.sentinel.status())}
             if path == "/debug/snapshot":
                 return 200, self.fleet_snapshot()
+            if path.startswith("/fleet/debug/trace/"):
+                fid = urllib.parse.unquote(
+                    path[len("/fleet/debug/trace/"):])
+                return self.fleet_trace(fid)
         return 404, {"error": "NotFound", "message": path}

@@ -211,6 +211,18 @@ class Fleet:
                                    self.root))
             time.sleep(0.1)
 
+    def trace(self, request_id: str) -> dict:
+        """The joined cross-process waterfall for one fleet request —
+        the in-process twin of ``GET /fleet/debug/trace/<id>`` (same
+        payload; tests and tools/loadgen.py call it without going
+        through HTTP).  Raises ``KeyError`` for an unknown/evicted id
+        so callers distinguish "never traced" from "empty join"."""
+        status, payload = self.router.fleet_trace(request_id)
+        if status != 200:
+            raise KeyError("fleet trace %r: %s"
+                           % (request_id, payload.get("message")))
+        return payload
+
     def kill(self, worker_id: str,
              sig: int = signal.SIGKILL) -> None:
         """The crash path: no goodbye, no snapshot — the WAL is the

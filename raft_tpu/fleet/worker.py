@@ -46,9 +46,11 @@ import signal
 import sys
 import threading
 import time
+import urllib.parse
 from typing import Callable, Dict, Optional
 
-from raft_tpu.fleet import protocol
+from raft_tpu.core import flight
+from raft_tpu.fleet import protocol, tracing
 
 __all__ = ["FleetWorker", "main"]
 
@@ -95,6 +97,11 @@ class FleetWorker:
         self._base_rows = 0
         self._global_ids = None
         self._lock = threading.Lock()
+        # NTP-style clock alignment vs the router, estimated over the
+        # register/heartbeat round trip and reported on the next beat
+        # (docs/OBSERVABILITY.md "Fleet tracing")
+        self._clock_offset: Optional[float] = None
+        self._clock_rtt: Optional[float] = None
 
     # ------------------------------------------------------------------ #
     # build / restore
@@ -207,15 +214,20 @@ class FleetWorker:
 
     def _handle(self, handler, method: str) -> None:
         self._maybe_hang()
-        path = handler.path.split("?", 1)[0]
+        path, _, query = handler.path.partition("?")
         try:
             body = {}
             if method == "POST":
                 length = int(handler.headers.get("Content-Length", 0))
                 raw = handler.rfile.read(length) if length else b"{}"
                 body = json.loads(raw.decode("utf-8"))
+            elif query:
+                body = {k: v[-1] for k, v in
+                        urllib.parse.parse_qs(query).items()}
             route = {
                 ("GET", "/info"): self._ep_info,
+                ("GET", "/debug/trace"): self._ep_trace,
+                ("POST", "/debug/flight"): self._ep_flight,
                 ("POST", "/search"): self._ep_search,
                 ("POST", "/insert"): self._ep_insert,
                 ("POST", "/admin/shutdown"): self._ep_shutdown,
@@ -280,15 +292,24 @@ class FleetWorker:
         import jax.numpy as jnp
         import numpy as np
 
+        t_in = self._clock()
         vectors = body.get("vectors")
         if not isinstance(vectors, list) or not vectors:
             return protocol.error_response(ValueError(
                 "search: 'vectors' must be a non-empty list of rows"))
         q = jnp.asarray(np.asarray(vectors, dtype=np.float32))
         timeout = body.get("timeout_s")
-        fut = self._svc.submit(
-            q, timeout=None if timeout is None else float(timeout),
-            tenant=body.get("tenant"))
+        # propagated fleet trace context: binding it here means the
+        # local Trace the batcher opens inside submit() — and with it
+        # every per-process lifecycle event (admitted, batch_formed,
+        # execute bracket, terminal, hedges, breaker trips recorded
+        # under batch_scope) — carries the fleet trace id and lands in
+        # the recorder's fleet index for /debug/trace to serve
+        with flight.trace_context(protocol.parse_trace(
+                body.get("trace"))):
+            fut = self._svc.submit(
+                q, timeout=None if timeout is None else float(timeout),
+                tenant=body.get("tenant"))
         dists, ids = fut.result(
             timeout=None if timeout is None else float(timeout) + 5.0)
         dists = np.asarray(dists, dtype=np.float32)
@@ -297,13 +318,35 @@ class FleetWorker:
             local = (ids >= 0) & (ids < self._base_rows)
             ids = ids.copy()
             ids[local] = self._global_ids[ids[local]]
+        # server_seconds lets the router split its RPC wall time into
+        # in-worker handling vs network residual (fleet_rpc_recv span)
         return 200, {"worker_id": self.worker_id,
                      "distances": dists.tolist(),
-                     "ids": ids.tolist()}
+                     "ids": ids.tolist(),
+                     "server_seconds": round(
+                         max(0.0, self._clock() - t_in), 6)}
+
+    def _ep_trace(self, body: dict):
+        fid = body.get("id")
+        if not fid:
+            return protocol.error_response(ValueError(
+                "debug/trace: 'id' query parameter is required"))
+        return 200, tracing.local_payload(
+            str(fid), worker_id=self.worker_id,
+            generation=self.generation, clock=self._clock)
+
+    def _ep_flight(self, body: dict):
+        # remote toggle for THIS process's flight recording — the
+        # fleet_trace_overhead bench arms its A/B on one warmed fleet
+        # (router toggles itself locally; workers need the RPC)
+        on = bool(body.get("on", True))
+        flight.set_enabled(on)
+        return 200, {"worker_id": self.worker_id, "flight_enabled": on}
 
     def _ep_insert(self, body: dict):
         import numpy as np
 
+        t_in = self._clock()
         ids = body.get("ids")
         vectors = body.get("vectors")
         if not isinstance(ids, list) or not isinstance(vectors, list) \
@@ -323,7 +366,9 @@ class FleetWorker:
             id_arr, np.asarray(vectors, dtype=np.float32))
         st = self._persist_stats()
         return 200, {"worker_id": self.worker_id, "acked": int(acked),
-                     "wal_seq": int(st.get("wal_seq", 0) or 0)}
+                     "wal_seq": int(st.get("wal_seq", 0) or 0),
+                     "server_seconds": round(
+                         max(0.0, self._clock() - t_in), 6)}
 
     def _ep_shutdown(self, body: dict):
         # quiesce → snapshot half of the drain choreography; the reply
@@ -374,12 +419,38 @@ class FleetWorker:
     def register(self) -> dict:
         payload = dict(self.info())
         payload["event"] = "register"
+        t0 = self._clock()
         reply = protocol.post_json(
             self.router_url.rstrip("/") + "/register", payload,
             timeout=max(5.0, 10.0 * self.lease_interval_s))
+        self._note_clock(reply.get("now"), t0, self._clock())
         self.lease_interval_s = float(
             reply.get("lease_interval_s", self.lease_interval_s))
         return reply
+
+    def _note_clock(self, router_now, t0: float, t1: float) -> None:
+        """NTP-client midpoint estimate over one router exchange:
+        ``offset = router_now - (t0 + t1) / 2`` (router clock = worker
+        clock + offset), trustworthy to ~rtt/2.  Samples with a worse
+        round trip than the retained best are rejected (a GC pause or
+        accept-queue stall would skew the midpoint), but the retained
+        rtt decays each beat so the estimate re-learns after a real
+        shift instead of pinning a stale fast sample forever."""
+        if router_now is None:
+            return
+        try:
+            router_now = float(router_now)
+        except (TypeError, ValueError):
+            return
+        rtt = max(0.0, t1 - t0)
+        offset = router_now - 0.5 * (t0 + t1)
+        with self._lock:
+            best = self._clock_rtt
+            if best is None or rtt <= best * 1.25 + 1e-4:
+                self._clock_offset = offset
+                self._clock_rtt = rtt
+            else:
+                self._clock_rtt = best * 1.05
 
     def _beat_loop(self) -> None:
         while not self._stop.wait(self.lease_interval_s):
@@ -397,6 +468,13 @@ class FleetWorker:
                 "queue_depth": (0 if batcher is None
                                 else int(batcher.depth())),
             }
+            with self._lock:
+                if self._clock_offset is not None:
+                    payload["clock_offset_s"] = round(
+                        self._clock_offset, 6)
+                    payload["clock_rtt_s"] = round(
+                        self._clock_rtt or 0.0, 6)
+            t0 = self._clock()
             try:
                 reply = protocol.post_json(
                     self.router_url.rstrip("/") + "/heartbeat",
@@ -404,6 +482,7 @@ class FleetWorker:
                                          4.0 * self.lease_interval_s))
             except Exception:  # noqa: BLE001 — beat again next tick;
                 continue  # the router's lease timer owns eviction
+            self._note_clock(reply.get("now"), t0, self._clock())
             if reply.get("rereg"):
                 # the router evicted us (e.g. we hung past the lease)
                 # but the process survived: rejoin without a restart

@@ -470,22 +470,43 @@ class OpsPlane:
         """The cost-inventory summary joined to each fn's measured
         execution timer: cost-model flops ÷ measured mean seconds =
         a roofline-style achieved-throughput figure per executable
-        family (host-side dispatch timing — an upper bound; the same
-        join ``tools/metrics_report.py`` renders)."""
+        family.  Two columns bracket the truth: host-side dispatch
+        timing (``raft_tpu_jit_<fn>_seconds``, async — an upper bound
+        on achieved rate) and the device-complete serve bracket
+        (``raft_tpu_serve_device_seconds{fn=...}``, closed only after
+        ``block_until_ready`` — a firm floor).  The same join
+        ``tools/metrics_report.py`` renders."""
         inv = _inventory.summary()
         reg = _metrics.default_registry()
+        # device-complete serve bracket, aggregated over services per
+        # executable family (the fn label is the inventory join key)
+        device: dict = {}
+        fam = reg.get("raft_tpu_serve_device_seconds")
+        if fam is not None:
+            for lbls, series in fam.series():
+                fn = lbls.get("fn")
+                if fn and series.count:
+                    agg = device.setdefault(fn, [0, 0.0])
+                    agg[0] += series.count
+                    agg[1] += series.total
         for fn, st in inv["per_fn"].items():
             fam = reg.get("raft_tpu_jit_%s_seconds" % fn)
-            if fam is None:
-                continue
-            for _, series in fam.series():
-                if series.count:
-                    mean_s = series.total / series.count
-                    st["exec_mean_s"] = round(mean_s, 6)
-                    if mean_s > 0 and st["max_flops"] > 0:
-                        st["achieved_gflops_upper"] = round(
-                            st["max_flops"] / mean_s / 1e9, 3)
-                break
+            if fam is not None:
+                for _, series in fam.series():
+                    if series.count:
+                        mean_s = series.total / series.count
+                        st["exec_mean_s"] = round(mean_s, 6)
+                        if mean_s > 0 and st["max_flops"] > 0:
+                            st["achieved_gflops_upper"] = round(
+                                st["max_flops"] / mean_s / 1e9, 3)
+                    break
+            agg = device.get(fn)
+            if agg and agg[0]:
+                dev_mean = agg[1] / agg[0]
+                st["device_mean_s"] = round(dev_mean, 6)
+                if dev_mean > 0 and st["max_flops"] > 0:
+                    st["achieved_gflops_device"] = round(
+                        st["max_flops"] / dev_mean / 1e9, 3)
         return inv
 
     def _ep_traces(self, qs):

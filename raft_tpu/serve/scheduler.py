@@ -60,6 +60,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core import flight
 from raft_tpu.core import metrics as _metrics
+from raft_tpu.core import profiler as _profiler
 from raft_tpu.core.error import CommTimeoutError, expects
 from raft_tpu.serve import sentinel as _sentinel
 from raft_tpu.serve.batcher import MicroBatcher, _Request
@@ -77,10 +78,10 @@ class _Inflight:
     the worker's start and finish halves)."""
 
     __slots__ = ("live", "spans", "bucket", "payload_rows", "out",
-                 "t_launch", "batch_id")
+                 "t_launch", "batch_id", "exec_fn")
 
     def __init__(self, live, spans, bucket, payload_rows, out, t_launch,
-                 batch_id=None):
+                 batch_id=None, exec_fn=""):
         self.live = live
         self.spans = spans
         self.bucket = bucket
@@ -88,6 +89,7 @@ class _Inflight:
         self.out = out
         self.t_launch = t_launch
         self.batch_id = batch_id
+        self.exec_fn = exec_fn
 
 
 # -- registry helpers (resolved per use: cheap, and reset-proof — a test
@@ -122,6 +124,17 @@ def _rung_timer(service: str, bucket: int):
         help="padded device call latency per shape-bucket rung",
         labels=("service", "rung")).labels(service=service,
                                            rung=bucket)
+
+
+def _device_timer(service: str, fn: str):
+    return _metrics.default_registry().timer(
+        "raft_tpu_serve_device_seconds",
+        help="device-complete padded call latency per executable "
+             "family (fn): launch to blocked-result-ready — the "
+             "bracket block_seconds closes, keyed so the roofline "
+             "inventory join can compute a firm achieved-GFLOP/s "
+             "floor per fn",
+        labels=("service", "fn")).labels(service=service, fn=fn)
 
 
 def _tenant_counter(name: str, help: str, service: str, tenant: str):
@@ -194,6 +207,14 @@ class ServeWorker:
         self._batcher = batcher
         self._policy = policy
         self._execute = execute
+        # executable-family attribution for the device-complete
+        # roofline join: ``execute`` is an opaque service closure, so
+        # the name of the program it ran comes from the profiled_jit
+        # wrapper that executed on this batch thread
+        # (profiler.last_jit_fn()); this remembers the latest sighting
+        # as the fallback for batches that resolve off-thread (hedged
+        # replica arms)
+        self._exec_fn = ""
         self._retry_policy = retry_policy
         self._maintenance = maintenance
         self._maint_interval = float(maintenance_interval_s)
@@ -639,6 +660,7 @@ class ServeWorker:
             # batch_scope: deeper layers (replica rotation / hedging)
             # attach their events to every rider's trace without the
             # execute signature carrying trace handles
+            _profiler._clear_last_jit_fn()
             with flight.batch_scope(rider_traces):
                 if self._retry_policy is not None:
                     # synchronous: each attempt must surface its own
@@ -655,8 +677,15 @@ class ServeWorker:
                         attempt, padded, verb="serve.%s" % self.name)
                 else:
                     out = self._execute(padded)
+            # which program family ran: the profiled_jit wrapper that
+            # executed on this thread names it; a batch whose programs
+            # ran off-thread (hedged replica arms) reuses the family
+            # last seen on this scheduler — same service, same family
+            self._exec_fn = (_profiler.last_jit_fn()
+                             or self._exec_fn)
             return _Inflight(live, spans, bucket, payload_rows, out,
-                             t_launch, batch_id)
+                             t_launch, batch_id,
+                             exec_fn=self._exec_fn)
         except BaseException as e:  # noqa: BLE001 — relayed/requeued per rider
             self._fail_batch(live, e)
             if launched:
@@ -712,6 +741,14 @@ class ServeWorker:
                    "time the worker blocked on device results "
                    "(lower bound on device latency at split time)",
                    self.name).observe(max(0.0, t_ready - t_block))
+            if inflight.exec_fn:
+                # device-COMPLETE bracket: opens at launch, closes
+                # only after block_until_ready returned — unlike the
+                # host-side jit dispatch timer, the device work is
+                # provably finished when this stops, so
+                # flops / this-mean is a floor on achieved rate
+                _device_timer(self.name, inflight.exec_fn).observe(
+                    max(0.0, t_ready - inflight.t_launch))
             flight.record("execute_ready", service=self.name,
                           traces=[r.trace for r in live],
                           batch=inflight.batch_id,

@@ -154,6 +154,9 @@ class AnomalySentinel:
         # per-service (count, total) / h2d cursors for window deltas
         self._exec_cursor: Dict[str, tuple] = {}
         self._h2d_cursor: Dict[str, tuple] = {}
+        # per-(service, worker) cursors over the router's per-hop
+        # network timer — the cross-hop rule's window deltas
+        self._net_cursor: Dict[str, tuple] = {}
 
     # ------------------------------------------------------------------ #
     # driving
@@ -393,6 +396,34 @@ class AnomalySentinel:
             fresh = age is None or float(age) < self._rejoin_hold
             self._judge("rejoin_lag", name, lag_ms, self._rejoin_ms,
                         now, breach=fresh and lag_ms > self._rejoin_ms)
+        self._rule_fleet_network(name, now)
+
+    def _rule_fleet_network(self, name: str, now: float) -> None:
+        """Cross-hop rule: each worker's router-measured network time
+        (``raft_tpu_fleet_network_seconds{worker=...}`` — RPC elapsed
+        minus the worker's self-reported server time) gets its own
+        cursor, baseline, and watch scoped ``<service>:<worker>``, so
+        one worker's degraded link is judged against that link's own
+        history rather than hiding in the fleet mean (the exec_latency
+        per-rung discipline, applied across the process boundary)."""
+        fam = _metrics.default_registry().get(
+            "raft_tpu_fleet_network_seconds")
+        if fam is None:
+            return
+        for labels, s in fam.series():
+            wid = labels.get("worker")
+            if wid is None:
+                continue
+            scope = "%s:%s" % (name, wid)
+            count, total = int(s.count), float(s.total)
+            prev = self._net_cursor.get(scope)
+            self._net_cursor[scope] = (count, total)
+            if prev is None or count <= prev[0]:
+                continue
+            window_mean = (total - prev[1]) / (count - prev[0])
+            self._judge_baseline("fleet_network", scope, window_mean,
+                                 self._latency_factor, now,
+                                 judge=count >= self._min_samples)
 
     # ------------------------------------------------------------------ #
     # consumers (the ops plane's /healthz and /statusz)

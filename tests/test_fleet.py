@@ -649,3 +649,457 @@ class TestReplicatedHedge:
         assert _total("raft_tpu_fleet_hedges_total") == hedges0 + 1
         # let the hang expire so teardown sees a healthy fleet
         time.sleep(1.2)
+
+    def test_hedged_request_joins_with_one_terminal(self, repl):
+        """Exactly-one-terminal across the process boundary on the
+        HEDGED path: the joined trace for a hedged request has one
+        router terminal, a ``fleet_hedge`` span, and validates clean
+        (the loser's late events cannot manufacture a second
+        terminal)."""
+        router = repl.router
+        data = _synth(300, DIM, 3, 0)
+        tenant = "hedgej"
+        primary = protocol.rendezvous_rank(
+            tenant, router.active_workers())[0]
+        port = router.registry()[primary]["data_port"]
+        protocol.post_json("http://127.0.0.1:%d/chaos" % port,
+                           {"fault": "hang", "duration_s": 1.0},
+                           timeout=5.0)
+        rid = "flt-hedge-join"
+        out = router.search([data[5].tolist()], tenant=tenant,
+                            timeout_s=8.0, request_id=rid)
+        assert out["hedged"]
+        time.sleep(1.3)  # hang expires; loser's tail events settle
+        status, joined = router.fleet_trace(rid)
+        assert status == 200
+        router_kinds = [e["kind"] for e in joined["spans"]
+                        if e["proc"] == "router"]
+        assert router_kinds.count("fleet_resolved") == 1
+        assert "fleet_hedge" in router_kinds
+        assert sum(1 for k in router_kinds
+                   if k in ("fleet_failed", "fleet_expired")) == 0
+        from raft_tpu.fleet import tracing
+        assert not [p for p in tracing.validate(joined)
+                    if "terminal" in p]
+
+
+# ---------------------------------------------------------------------- #
+# fleet tracing: context carrier + local index (no processes)
+# ---------------------------------------------------------------------- #
+class TestTraceCarrier:
+    def test_trace_frame_parse_roundtrip(self):
+        ctx = protocol.trace_frame("flt-00000007", "router", 12.5)
+        parsed = protocol.parse_trace(ctx)
+        assert parsed == {"id": "flt-00000007", "parent": "router",
+                          "sent_at": 12.5}
+        # bare-string legacy form still carries the id
+        assert protocol.parse_trace("flt-9")["id"] == "flt-9"
+        for junk in (None, 7, [], {}, {"parent": "x"}):
+            assert protocol.parse_trace(junk) is None
+
+    def test_post_json_mirrors_trace_header(self):
+        seen = {}
+
+        def transport(method, url, body, timeout, headers=None):
+            seen["headers"] = headers
+            return 200, b'{"ok": true}'
+
+        ctx = protocol.trace_frame("flt-1", "router", 1.0)
+        protocol.post_json("http://w/search", {"q": []}, timeout=1.0,
+                           transport=transport, trace=ctx)
+        hdr = seen["headers"][protocol.TRACE_HEADER]
+        assert json.loads(hdr) == ctx
+
+    def test_post_json_falls_back_for_legacy_transports(self):
+        """An injected 4-arg transport (every pre-tracing test double,
+        and FrameFaults before this PR) must keep working when a trace
+        is attached — the body is the authoritative carrier."""
+        calls = []
+
+        def legacy(method, url, body, timeout):
+            calls.append((method, url))
+            return 200, b'{"ok": true}'
+
+        rep = protocol.post_json(
+            "http://w/search", {"q": []}, timeout=1.0,
+            transport=legacy,
+            trace=protocol.trace_frame("flt-2", "router", 0.0))
+        assert rep == {"ok": True} and calls
+
+    def test_frame_faults_forward_headers(self):
+        got = {}
+
+        def base(method, url, body, timeout, headers=None):
+            got["headers"] = headers
+            return 200, b'{"ok": true}'
+
+        ff = FrameFaults(5, base=base)
+        ff("POST", "http://w/search", b"{}", 1.0,
+           headers={"X": "y"})
+        assert got["headers"] == {"X": "y"}
+
+
+class TestFleetTraceIndex:
+    def test_trace_context_binds_and_tags_ring_events(self):
+        rec = flight.default_recorder()
+        ctx = protocol.parse_trace(
+            protocol.trace_frame("flt-ctx-1", "router", 3.0))
+        with flight.trace_context(ctx):
+            tr = rec.new_trace("annx", "t0")
+        assert tr.fleet["id"] == "flt-ctx-1"
+        assert flight.current_trace_context() is None
+        rec.record("admitted", service="annx", trace=tr)
+        rec.record("batch_formed", service="annx", traces=[tr],
+                   rung=8)
+        rec.record("resolved", service="annx", trace=tr)
+        ring = [e.to_dict() for e in rec.events(service="annx")]
+        assert all(e.get("fleet") in ("flt-ctx-1", ["flt-ctx-1"])
+                   for e in ring), ring
+        # the per-fleet-id index holds the trace
+        assert [t.trace_id for t in
+                flight.fleet_traces("flt-ctx-1")] == [tr.trace_id]
+        # to_dict round-trips the fleet slot
+        assert tr.to_dict()["fleet"]["parent"] == "router"
+
+    def test_no_context_means_no_tagging(self):
+        rec = flight.default_recorder()
+        tr = rec.new_trace("annx", "t0")
+        assert tr.fleet is None
+        rec.record("admitted", service="annx", trace=tr)
+        ev = [e.to_dict() for e in rec.events(service="annx")][-1]
+        assert "fleet" not in ev
+
+    def test_index_survives_ring_wrap(self):
+        """The fleet view reconstructs after the global ring wrapped:
+        indexed traces keep their private event lists, so
+        ``local_payload`` still has the full timeline."""
+        from raft_tpu.core.flight import FlightRecorder
+        rec = FlightRecorder(capacity=16)
+        with flight.trace_context({"id": "flt-wrap", "parent":
+                                   "router", "sent_at": 0.0}):
+            tr = rec.new_trace("svc", None)
+        rec.record("admitted", service="svc", trace=tr)
+        rec.record("resolved", service="svc", trace=tr)
+        for i in range(64):  # wrap the 16-slot ring with noise
+            rec.record("compaction", service="other", i=i)
+        assert not rec.events(service="svc")  # ring lost it
+        traces = rec.fleet_traces("flt-wrap")
+        assert len(traces) == 1
+        kinds = [e["kind"] for e in traces[0].timeline()]
+        assert kinds == ["admitted", "resolved"]
+
+    def test_index_bounds_ids_fifo_and_traces_per_id(self):
+        from raft_tpu.core.flight import (FLEET_TRACE_KEEP,
+                                          FLEET_TRACES_PER_ID,
+                                          FlightRecorder)
+        rec = FlightRecorder(capacity=64)
+        for i in range(FLEET_TRACE_KEEP + 3):
+            with flight.trace_context({"id": "flt-%d" % i,
+                                       "parent": "router",
+                                       "sent_at": 0.0}):
+                rec.new_trace("svc", None)
+        ids = rec.fleet_trace_ids()
+        assert len(ids) == FLEET_TRACE_KEEP
+        assert "flt-0" not in ids and "flt-2" not in ids  # FIFO out
+        assert "flt-%d" % (FLEET_TRACE_KEEP + 2) in ids
+        # per-id cap: a retry storm cannot grow one id unboundedly
+        for _ in range(FLEET_TRACES_PER_ID + 5):
+            with flight.trace_context({"id": "flt-burst",
+                                       "parent": "router",
+                                       "sent_at": 0.0}):
+                rec.new_trace("svc", None)
+        assert len(rec.fleet_traces("flt-burst")) == \
+            FLEET_TRACES_PER_ID
+
+
+# ---------------------------------------------------------------------- #
+# fleet tracing: clock-aligned join + validation (synthetic events)
+# ---------------------------------------------------------------------- #
+def _router_events(rid, t0=100.0, worker="w0", server_s=0.008,
+                   terminal="fleet_resolved"):
+    return [
+        {"ts": t0, "kind": "fleet_admitted", "service": "fleet",
+         "rid": rid},
+        {"ts": t0 + 0.001, "kind": "fleet_rpc_send",
+         "service": "fleet", "rid": rid, "worker": worker,
+         "attempt": 0},
+        {"ts": t0 + 0.012, "kind": "fleet_rpc_recv",
+         "service": "fleet", "rid": rid, "worker": worker,
+         "attempt": 0, "elapsed_s": 0.011, "server_s": server_s,
+         "network_s": 0.011 - server_s},
+        {"ts": t0 + 0.013, "kind": terminal, "service": "fleet",
+         "rid": rid},
+    ]
+
+
+def _worker_payload(rid, wid, clock_t0, server_s=0.008):
+    """A worker-half payload whose events sit on the WORKER clock."""
+    events = [
+        {"ts": clock_t0, "kind": "admitted", "service": "ann",
+         "trace_id": 1},
+        {"ts": clock_t0 + server_s * 0.5, "kind": "batch_formed",
+         "service": "ann", "traces": [1]},
+        {"ts": clock_t0 + server_s, "kind": "resolved",
+         "service": "ann", "trace_id": 1},
+    ]
+    return {"fleet": rid, "worker_id": wid, "generation": 1,
+            "now": clock_t0 + 1.0,
+            "traces": [{"trace_id": 1, "service": "ann",
+                        "tenant": None, "events": events}]}
+
+
+class TestTracingJoin:
+    def test_aligned_join_is_monotonic_and_gapless(self):
+        from raft_tpu.fleet import tracing
+        rid = "flt-j1"
+        # worker clock runs 50 s behind the router; its span sits
+        # inside the rpc bracket once shifted by +50
+        payload = _worker_payload(rid, "w0", clock_t0=50.003)
+        joined = tracing.join(
+            rid, _router_events(rid),
+            {"w0": {"offset_s": 50.0, "rtt_s": 0.002,
+                    "payload": payload}})
+        assert joined["terminal"] == "fleet_resolved"
+        assert tracing.validate(joined) == []
+        ts = [e["ts"] for e in joined["spans"]]
+        assert ts == sorted(ts)
+        procs = {e["proc"] for e in joined["spans"]}
+        assert procs == {"router", "w0"}
+        hop = joined["hops"]["w0"]
+        assert hop["attempts"] == 1
+        assert hop["network_s"] == pytest.approx(0.003)
+        # the hop tiling is gapless: consecutive boundaries shared
+        segs = tracing.hop_segments(joined)
+        names = [s["name"] for s in segs]
+        assert names == ["dispatch", "network_out", "worker",
+                         "network_back", "merge_relay"]
+        for a, b in zip(segs, segs[1:]):
+            assert b["t0"] == pytest.approx(a["t1"])
+
+    def test_misaligned_clock_is_flagged(self):
+        from raft_tpu.fleet import tracing
+        rid = "flt-j2"
+        payload = _worker_payload(rid, "w0", clock_t0=50.003)
+        # offset off by 80 ms >> tol (5 ms + rtt/2): the worker span
+        # lands outside its rpc bracket and validate says so
+        joined = tracing.join(
+            rid, _router_events(rid),
+            {"w0": {"offset_s": 50.08, "rtt_s": 0.002,
+                    "payload": payload}})
+        probs = tracing.validate(joined)
+        assert any("clock alignment gap" in p for p in probs)
+
+    def test_double_terminal_is_flagged(self):
+        from raft_tpu.fleet import tracing
+        rid = "flt-j3"
+        evs = _router_events(rid)
+        evs.append({"ts": evs[-1]["ts"] + 0.001,
+                    "kind": "fleet_resolved", "service": "fleet",
+                    "rid": rid})
+        joined = tracing.join(rid, evs, {})
+        assert any("terminal" in p for p in tracing.validate(joined))
+        # and a worker-side duplicate terminal is caught per trace
+        payload = _worker_payload(rid, "w0", clock_t0=100.003)
+        payload["traces"][0]["events"].append(
+            {"ts": 100.02, "kind": "resolved", "service": "ann",
+             "trace_id": 1})
+        joined = tracing.join(
+            rid, _router_events(rid),
+            {"w0": {"offset_s": 0.0, "rtt_s": 0.002,
+                    "payload": payload}})
+        assert any("2 terminals" in p for p in tracing.validate(joined))
+
+    def test_partial_join_without_worker_payload(self):
+        from raft_tpu.fleet import tracing
+        rid = "flt-j4"
+        joined = tracing.join(
+            rid, _router_events(rid),
+            {"w0": {"offset_s": 0.0, "rtt_s": 0.0, "payload": None}})
+        assert joined["hops"]["w0"]["attempts"] == 1
+        assert joined["align"]["w0"]["traces"] == 0
+        # no worker events: nesting checks are vacuous, terminal holds
+        assert tracing.validate(joined) == []
+
+
+# ---------------------------------------------------------------------- #
+# sentinel cross-hop rule: per-worker network baselines
+# ---------------------------------------------------------------------- #
+class TestSentinelFleetNetwork:
+    def test_one_degraded_link_trips_its_own_watch(self):
+        wa, wb = _name("netw"), _name("netw")
+        clock = FakeClock()
+        with config.override(ops_sentinel_min_samples="5",
+                             ops_sentinel_latency_factor="3"):
+            sent = AnomalySentinel(
+                lambda: {"fleet": _FakeFleet()}, interval_s=0.0,
+                clock=clock)
+        timers = {w: default_registry().timer(
+            "raft_tpu_fleet_network_seconds",
+            labels=("worker",)).labels(worker=w) for w in (wa, wb)}
+        sent.tick(force=True)
+        for _ in range(2):
+            for _ in range(5):
+                timers[wa].observe(0.002)
+                timers[wb].observe(0.002)
+            clock.advance(1.0)
+            sent.tick(force=True)
+        watches = sent.status()["watches"]
+        assert not watches["fleet_network/fleet:%s" % wa]["active"]
+        # one link degrades 10x; the other stays healthy
+        for _ in range(6):
+            timers[wa].observe(0.020)
+            timers[wb].observe(0.002)
+        clock.advance(1.0)
+        sent.tick(force=True)
+        active = {(a["rule"], a["service"]) for a in sent.active()}
+        assert ("fleet_network", "fleet:%s" % wa) in active
+        assert ("fleet_network", "fleet:%s" % wb) not in active
+
+
+# ---------------------------------------------------------------------- #
+# prometheus worker-label escaping (regression: hostile worker names)
+# ---------------------------------------------------------------------- #
+class TestWorkerLabelEscaping:
+    def test_hostile_worker_name_roundtrips(self):
+        from raft_tpu.core.metrics import parse_prometheus
+        hostile = 'w"0\\evil\nname'
+        text = ("# HELP m demo\n# TYPE m counter\n"
+                'm{service="a"} 1\nm_plain 2\n')
+        out = _relabel_metrics(text, hostile, set())
+        joined = "\n".join(out) + "\n"
+        # every emitted line is still one line (the newline in the
+        # name must have been escaped, not emitted)
+        assert all("\n" not in ln for ln in out)
+        parsed = parse_prometheus(joined)
+        assert parsed["m"], joined
+        for labels in parsed["m"]:
+            assert dict(labels)["worker"] == hostile
+        for labels in parsed["m_plain"]:
+            assert dict(labels)["worker"] == hostile
+
+
+# ---------------------------------------------------------------------- #
+# live fleet: cross-process joined waterfall
+# ---------------------------------------------------------------------- #
+class TestFleetTracingLive:
+    def test_joined_waterfall_monotonic_and_gapless(self, fleet):
+        """The acceptance criterion: a live request's joined trace at
+        ``/fleet/debug/trace/<id>`` is monotonic and gapless after
+        clock alignment, with exactly one terminal per request."""
+        from raft_tpu.fleet import tracing
+        data = _synth(ROWS, DIM, SEED, 4)
+        rid = "flt-live-join-1"
+        out = fleet.router.search(
+            [data[3].tolist(), data[7].tolist()], request_id=rid)
+        assert not out["degraded"]
+        status, joined = fleet.router.fleet_trace(rid)
+        assert status == 200
+        assert joined["terminal"] == "fleet_resolved"
+        assert not joined["partial"]
+        assert joined["problems"] == []
+        # both shards contributed, each with worker-side spans tagged
+        # by the propagated context
+        assert set(joined["hops"]) == {"w0", "w1"}
+        procs = {e["proc"] for e in joined["spans"]}
+        assert procs == {"router", "w0", "w1"}
+        for wid in ("w0", "w1"):
+            kinds = [e["kind"] for e in joined["spans"]
+                     if e["proc"] == wid]
+            assert "admitted" in kinds and "resolved" in kinds
+            assert kinds.count("resolved") == 1
+        # per-process monotonic (validate already asserts; belt and
+        # braces on the acceptance wording)
+        for proc in procs:
+            ts = [e["ts"] for e in joined["spans"]
+                  if e["proc"] == proc]
+            assert ts == sorted(ts)
+        # hop tiling covers dispatch through merge with shared
+        # boundaries per worker chain
+        segs = tracing.hop_segments(joined)
+        assert {s["name"] for s in segs} == {
+            "dispatch", "network_out", "worker", "network_back",
+            "merge_relay"}
+        # the HTTP spelling returns the same join
+        status, body = _http_json(
+            fleet.router.url + "/fleet/debug/trace/" + rid)
+        assert status == 200 and body["fleet"] == rid
+        assert body["terminal"] == "fleet_resolved"
+        # renderers accept the live payload
+        from tools.trace_report import (fleet_to_chrome_trace,
+                                        render_fleet_waterfall)
+        text = render_fleet_waterfall(joined)
+        assert rid in text and "network_out" in text
+        chrome = fleet_to_chrome_trace(joined)
+        assert any(e["ph"] == "X" and e["name"] == "fleet request"
+                   for e in chrome)
+
+    def test_unknown_id_is_404_not_500(self, fleet):
+        status, payload = fleet.router.fleet_trace("flt-nope")
+        assert status == 404
+        assert "unknown fleet trace" in payload["message"]
+
+    def test_clock_offsets_published_and_sane(self, fleet):
+        # heartbeats have been flowing since fleet start: both
+        # workers must have an offset estimate and a sub-second rtt
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            reg = fleet.router.registry()
+            if all(r.get("clock_rtt_s", 0.0) > 0.0
+                   for r in reg.values()):
+                break
+            time.sleep(0.2)
+        reg = fleet.router.registry()
+        for wid, pub in reg.items():
+            assert pub["clock_rtt_s"] > 0.0, (wid, pub)
+            assert pub["clock_rtt_s"] < 1.0
+            # loopback offsets are small (same physical clock), but
+            # the assertion is on the estimator's bound, not zero
+            assert abs(pub["clock_offset_s"]) < 5.0
+
+    def test_exactly_one_terminal_across_drain(self, fleet):
+        """Exactly-one-terminal per fleet request while a worker
+        drains and rejoins mid-traffic (the drain choreography hands
+        requests off; none may double-terminate or vanish)."""
+        router = fleet.router
+        data = _synth(ROWS, DIM, SEED, 4)
+        rids, stop = [], threading.Event()
+        errs = []
+
+        def client():
+            i = 0
+            while not stop.is_set():
+                rid = "flt-drain-%d" % i
+                i += 1
+                try:
+                    router.search([data[i % ROWS].tolist()],
+                                  timeout_s=8.0, request_id=rid)
+                except RaftError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — untyped = bug
+                    errs.append(e)
+                rids.append(rid)
+                time.sleep(0.01)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        fleet.drain_restart("w1", timeout=120.0)
+        time.sleep(0.3)
+        stop.set()
+        t.join(timeout=30.0)
+        assert not errs
+        assert router.active_workers() == ["w0", "w1"]
+        rec = flight.default_recorder()
+        terminals = {}
+        for kind in ("fleet_resolved", "fleet_failed",
+                     "fleet_expired"):
+            for e in rec.events(kind=kind):
+                rid = e.attrs.get("rid")
+                if rid is not None and rid.startswith("flt-drain-"):
+                    terminals[rid] = terminals.get(rid, 0) + 1
+        admitted = [e.attrs["rid"]
+                    for e in rec.events(kind="fleet_admitted")
+                    if e.attrs.get("rid", "").startswith("flt-drain-")]
+        assert admitted
+        for rid in admitted:
+            assert terminals.get(rid, 0) == 1, rid

@@ -210,7 +210,11 @@ class TestSLO:
                        ("window", "10s"))] == pytest.approx(10.0)
         misses = default_registry().get(
             "raft_tpu_serve_slo_misses_total")
-        assert sum(s.value for _, s in misses.series()) == 2
+        # scoped to THIS tracker's service: the family is process-
+        # global and other suites (e.g. the fleet router's tracker)
+        # legitimately mint their own series
+        assert sum(s.value for lbl, s in misses.series()
+                   if lbl.get("service") == "svc") == 2
 
     def test_deadline_only_mode(self):
         slo = SLOTracker("svc", target_s=0.0, objective=0.99,

@@ -449,7 +449,9 @@ class TestAutotune:
             assert e["winner"] in e["timings_s"]
             assert all(n == 0 for n in
                        e["post_warmup_compiles"].values()), e
-            assert e["margin"] >= 1.0
+            # a reverted entry's margin is honestly < 1: the discarded
+            # winner was faster, just inside the noise band
+            assert e["margin"] >= 1.0 or e["reverted_from"] is not None, e
         # rollup entries cover shape-less lookups
         assert any(e["shape_class"] == "*" for e in table["entries"])
         assert t["index"]

@@ -1288,7 +1288,7 @@ def run_crash_restart(persist_dir, *, index_rows=4000, dim=16, k=5,
 def run_fleet(root, *, n_workers=2, mode="sharded", index_rows=2000,
               dim=16, k=5, seed=0, duration=6.0, concurrency=4,
               rows=4, nlist=16, clusters=8, insert_rows=8,
-              chaos=True):
+              chaos=True, trace_k=0):
     """Fleet chaos scenario (docs/FAULT_MODEL.md "Fleet fault
     domains"): a router + ``n_workers`` worker PROCESSES under
     concurrent closed-loop search traffic plus (sharded mode) an
@@ -1515,6 +1515,42 @@ def run_fleet(root, *, n_workers=2, mode="sharded", index_rows=2000,
                               and report["healed"]
                               and report["rejoin_seen"]
                               and not report["chaos_failed"])
+        # cross-process waterfalls must be joined HERE, while the
+        # fleet is still alive — the join scrapes each owning worker's
+        # /debug/trace endpoint
+        if trace_k:
+            # router-local trace id -> fleet request id (the exemplar
+            # reservoir stores local ids; the join is keyed by rid)
+            tid_to_rid = {}
+            for fid in rec.fleet_trace_ids():
+                for tr in rec.fleet_traces(fid):
+                    tid_to_rid[tr.trace_id] = fid
+            slow = []
+            for ex in flight.exemplars_for("fleet").snapshot():
+                rid = tid_to_rid.get(ex["trace_id"])
+                if rid is None:
+                    continue
+                status, joined = router.fleet_trace(rid)
+                if status == 200:
+                    slow.append({"latency_ms": ex["latency_ms"],
+                                 "rid": rid, "joined": joined})
+                if len(slow) >= trace_k:
+                    break
+            report["slow_fleet_traces"] = slow
+        offenders = sorted(rid for rid, v in term_rids.items()
+                           if v > 1)[:5]
+        report["offending_rids"] = offenders
+        if not report["fleet_ok"] and offenders:
+            # the postmortem artifact a duplicate-terminal failure
+            # needs: the joined cross-process view of each offender,
+            # captured before the fleet dies
+            traces = {}
+            for rid in offenders:
+                try:
+                    traces[rid] = router.fleet_trace(rid)[1]
+                except Exception as e:  # noqa: BLE001 — best-effort dump
+                    traces[rid] = {"error": str(e)}
+            report["offender_traces"] = traces
         return report
     finally:
         if harness is not None:
@@ -1682,8 +1718,11 @@ def main(argv=None) -> int:
                     metavar="K",
                     help="capture flight timelines for the K slowest "
                          "requests (default 3) and print their "
-                         "waterfalls next to the latency rows "
-                         "(docs/OBSERVABILITY.md)")
+                         "waterfalls next to the latency rows; with "
+                         "--fleet, prints the slowest-K CROSS-PROCESS "
+                         "waterfalls (clock-aligned router+worker "
+                         "join; docs/OBSERVABILITY.md \"Fleet "
+                         "tracing\")")
     ap.add_argument("--trace-dump", metavar="PATH", default=None,
                     help="write the whole flight recorder (ring + "
                          "black boxes) to PATH after the run "
@@ -1708,7 +1747,7 @@ def main(argv=None) -> int:
                 duration=args.duration,
                 concurrency=args.concurrency, rows=args.rows,
                 nlist=args.nlist or 16, clusters=args.clusters or 8,
-                chaos=not args.no_chaos)
+                chaos=not args.no_chaos, trace_k=args.trace)
         finally:
             if cleanup:
                 shutil.rmtree(root, ignore_errors=True)
@@ -1728,8 +1767,24 @@ def main(argv=None) -> int:
                         "fleet_ok"):
                 if key in report:
                     print("  %-24s %s" % (key, report[key]))
+            if report.get("slow_fleet_traces"):
+                from tools.trace_report import render_fleet_waterfall
+                for entry in report["slow_fleet_traces"]:
+                    print("-- slow fleet request: %.3fms (rid %s) --"
+                          % (entry["latency_ms"], entry["rid"]))
+                    print(render_fleet_waterfall(entry["joined"]))
         if not report["fleet_ok"]:
             _dump_flight("flight_fleet_seed%d.json" % args.seed)
+            # joined cross-process traces for the offending request
+            # ids, one file each (tools/trace_report.py renders them)
+            for rid, joined in sorted(
+                    (report.get("offender_traces") or {}).items()):
+                path = "fleet_trace_seed%d_%s.json" % (args.seed, rid)
+                with open(path, "w", encoding="utf-8") as f:
+                    json.dump(joined, f, indent=2, sort_keys=True)
+                print("joined fleet trace for offending rid %s -> %s "
+                      "(render with tools/trace_report.py)"
+                      % (rid, path), file=sys.stderr)
         return 0 if report["fleet_ok"] else 1
     if args.crash_restart:
         if args.service != "ann":

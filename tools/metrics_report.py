@@ -515,13 +515,27 @@ def _inventory_summary(snap: dict) -> list:
     summed device-capacity claim, and a roofline-style achieved-
     throughput figure joining the cost model to the measured
     ``raft_tpu_jit_<fn>_seconds`` execution timer (host-side dispatch
-    — an upper bound on achieved FLOP/s, honest for retrace and
-    capacity questions rather than kernel tuning)."""
+    — an upper bound on achieved FLOP/s) and, when the serve layer
+    ran, the device-complete ``raft_tpu_serve_device_seconds{fn=...}``
+    bracket (closed after ``block_until_ready`` — a firm floor).
+    Together the two columns bracket true achieved rate, so kernel
+    work starts from firm numbers."""
     inv = snap.get("inventory") or {}
     per_fn = inv.get("per_fn") or {}
     if not per_fn:
         return []
     metrics = snap.get("metrics", {})
+    # device-complete serve bracket per fn (aggregated over services;
+    # the opsplane join precomputes device_mean_s into the inventory,
+    # but a raw-metrics snapshot may carry only the timer — join both)
+    device = {}
+    for s in metrics.get("raft_tpu_serve_device_seconds",
+                         {}).get("series", []):
+        fn = s.get("labels", {}).get("fn")
+        if fn and s.get("count"):
+            agg = device.setdefault(fn, [0, 0.0])
+            agg[0] += s["count"]
+            agg[1] += s["count"] * s.get("mean", 0.0)
     lines = ["  programs=%d  pinned footprint (args+outs+temps) "
              "= %.1f MB"
              % (inv.get("programs", 0),
@@ -538,6 +552,16 @@ def _inventory_summary(snap: dict) -> list:
             if mean_s > 0 and st["max_flops"] > 0:
                 line += (" -> <=%.1f GFLOP/s"
                          % (st["max_flops"] / mean_s / 1e9))
+        dev_mean = st.get("device_mean_s")
+        if dev_mean is None:
+            agg = device.get(fn)
+            if agg and agg[0]:
+                dev_mean = agg[1] / agg[0]
+        if dev_mean:
+            line += "  device mean=%s" % _fmt_s(dev_mean)
+            if st["max_flops"] > 0:
+                line += (" -> >=%.1f GFLOP/s (device-complete)"
+                         % (st["max_flops"] / dev_mean / 1e9))
         lines.append(line)
     return lines
 

@@ -16,19 +16,29 @@ Two renderings of the same event stream:
   an offset-annotated bar chart — the "why was THIS request slow"
   screen (``tools/loadgen.py --trace`` prints the same rendering for
   the slowest requests of a run).
+- **Fleet waterfall**: a ``/fleet/debug/trace/<id>`` joined payload
+  (docs/OBSERVABILITY.md "Fleet tracing") renders as ONE
+  clock-aligned cross-process waterfall — router hops and worker
+  timelines on a shared router-clock axis, plus the gapless hop
+  tiling (dispatch → network out → worker → network back → merge).
+  ``--chrome`` exports the same join as Perfetto tracks, one process
+  track per hop.  The payload shape is auto-detected.
 
 Input is any flight dump JSON: ``FlightRecorder.dump_to()`` output
 (``{"events": [...], "blackboxes": [...]}``), a single black-box dump
-(``{"reason", "events"}``), or a bare event list.  Events are dicts
-with at least ``ts`` (monotonic seconds) and ``kind``; see the event
+(``{"reason", "events"}``), a bare event list, or a fleet-join
+payload (``{"fleet", "spans", ...}``).  Events are dicts with at
+least ``ts`` (monotonic seconds) and ``kind``; see the event
 vocabulary table in docs/OBSERVABILITY.md.
 
 Usage:
     python tools/trace_report.py dump.json                # summary
     python tools/trace_report.py dump.json --trace-id 17  # waterfall
     python tools/trace_report.py dump.json --chrome trace.json
+    python tools/trace_report.py joined.json              # fleet view
 
 Importable: :func:`to_chrome_trace`, :func:`render_waterfall`,
+:func:`render_fleet_waterfall`, :func:`fleet_to_chrome_trace`,
 :func:`trace_ids` (loadgen and tests reuse them).
 """
 
@@ -169,6 +179,127 @@ def render_waterfall(timeline: List[dict], width: int = 48) -> str:
     return "\n".join(lines)
 
 
+def is_fleet_join(obj) -> bool:
+    """True when ``obj`` is a ``/fleet/debug/trace/<id>`` joined
+    payload rather than a flat flight dump."""
+    return (isinstance(obj, dict) and "fleet" in obj
+            and "spans" in obj)
+
+
+def _bar(t0: float, t1: float, lo: float, span: float,
+         width: int) -> str:
+    """A ``[t0, t1]`` extent as a fixed-width bar over ``[lo,
+    lo+span]``."""
+    p0 = min(width - 1, max(0, int((t0 - lo) / span * (width - 1))))
+    p1 = min(width - 1, max(p0, int((t1 - lo) / span * (width - 1))))
+    return "·" * p0 + "█" * (p1 - p0 + 1) + "·" * (width - 1 - p1)
+
+
+def render_fleet_waterfall(joined: dict, width: int = 48) -> str:
+    """A joined fleet trace as one terminal waterfall: alignment
+    header, per-hop summary, the gapless hop tiling, then every span
+    (router clock, process-labelled)."""
+    from raft_tpu.fleet import tracing
+
+    spans = list(joined.get("spans") or ())
+    if not spans:
+        return ("fleet trace %s: no spans (expired from the ring, or "
+                "never admitted)" % joined.get("fleet"))
+    lo = min(float(e["ts"]) for e in spans)
+    hi = max(float(e["ts"]) for e in spans)
+    span = max(hi - lo, 1e-9)
+    lines = ["fleet trace %s  terminal=%s  total=%.3fms  workers=%d%s"
+             % (joined.get("fleet"), joined.get("terminal"),
+                span * 1e3, len(joined.get("hops") or ()),
+                "  [PARTIAL]" if joined.get("partial") else "")]
+    for wid, a in sorted((joined.get("align") or {}).items()):
+        lines.append("  align %-8s offset=%+.3fms rtt=%.3fms "
+                     "traces=%s gen=%s"
+                     % (wid, a.get("offset_s", 0.0) * 1e3,
+                        a.get("rtt_s", 0.0) * 1e3,
+                        a.get("traces"), a.get("generation")))
+    for wid, hop in sorted((joined.get("hops") or {}).items()):
+        lines.append("  hop   %-8s attempts=%d network=%.3fms "
+                     "server=%.3fms"
+                     % (wid, hop.get("attempts", 0),
+                        hop.get("network_s", 0.0) * 1e3,
+                        hop.get("server_s", 0.0) * 1e3))
+    segs = tracing.hop_segments(joined)
+    if segs:
+        lines.append("  -- hop tiling (gapless boundaries) --")
+        for seg in segs:
+            lines.append(
+                "  %9.3fms  %s %-8s %-12s %.3fms"
+                % ((seg["t0"] - lo) * 1e3,
+                   _bar(seg["t0"], seg["t1"], lo, span, width),
+                   seg["proc"], seg["name"],
+                   (seg["t1"] - seg["t0"]) * 1e3))
+    lines.append("  -- spans (router clock) --")
+    for ev in spans:
+        off = float(ev["ts"]) - lo
+        pos = min(width - 1, int(round(off / span * (width - 1))))
+        bar = "·" * pos + "█"
+        attrs = {k: v for k, v in ev.items()
+                 if k not in ("ts", "kind", "service", "tenant",
+                              "trace_id", "traces", "proc")
+                 and v is not None}
+        attr_s = " ".join("%s=%s" % kv for kv in sorted(attrs.items()))
+        lines.append("  %9.3fms  %-*s %-8s %-16s %s"
+                     % (off * 1e3, width + 1, bar,
+                        ev.get("proc", "?"), ev["kind"], attr_s))
+    for prob in joined.get("problems") or ():
+        lines.append("  !! %s" % prob)
+    return "\n".join(lines)
+
+
+def fleet_to_chrome_trace(joined: dict) -> List[dict]:
+    """A joined fleet trace as Chrome trace-event JSON: one Perfetto
+    process track per hop (router + each worker), the gapless hop
+    tiling as complete slices, every span as an instant event, and
+    the request total on the router track."""
+    from raft_tpu.fleet import tracing
+
+    spans = list(joined.get("spans") or ())
+    if not spans:
+        return []
+    lo = min(float(e["ts"]) for e in spans)
+
+    def us(ts: float) -> float:
+        return round((float(ts) - lo) * 1e6, 1)
+
+    out: List[dict] = []
+    for seg in tracing.hop_segments(joined):
+        out.append({"name": seg["name"], "ph": "X",
+                    "pid": seg["proc"], "tid": "hops",
+                    "ts": us(seg["t0"]),
+                    "dur": round((seg["t1"] - seg["t0"]) * 1e6, 1)})
+    admitted = None
+    terminal_ts = None
+    for ev in spans:
+        proc = ev.get("proc", "?")
+        track = ("trace %s" % ev["trace_id"]
+                 if ev.get("trace_id") is not None else "events")
+        args = {k: v for k, v in ev.items()
+                if k not in ("ts", "kind", "proc")}
+        out.append({"name": ev["kind"], "ph": "i", "s": "t",
+                    "pid": proc, "tid": track, "ts": us(ev["ts"]),
+                    "args": args})
+        if proc == "router":
+            if ev["kind"] == "fleet_admitted":
+                admitted = float(ev["ts"])
+            elif ev["kind"] == joined.get("terminal"):
+                terminal_ts = float(ev["ts"])
+    if admitted is not None and terminal_ts is not None:
+        out.append({"name": "fleet request", "ph": "X",
+                    "pid": "router", "tid": "hops",
+                    "ts": us(admitted),
+                    "dur": round((terminal_ts - admitted) * 1e6, 1),
+                    "args": {"fleet": joined.get("fleet"),
+                             "terminal": joined.get("terminal")}})
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
 def summarize(events: List[dict]) -> str:
     """Per-trace one-liners plus the system-event tail — the index a
     postmortem starts from."""
@@ -211,7 +342,22 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     with open(args.dump, encoding="utf-8") as f:
-        events = load_events(json.load(f))
+        obj = json.load(f)
+
+    if is_fleet_join(obj):
+        if args.chrome:
+            chrome = fleet_to_chrome_trace(obj)
+            with open(args.chrome, "w", encoding="utf-8") as f:
+                json.dump({"traceEvents": chrome}, f, indent=2,
+                          sort_keys=True)
+                f.write("\n")
+            print("wrote %d chrome events to %s"
+                  % (len(chrome), args.chrome))
+            return 0
+        print(render_fleet_waterfall(obj))
+        return 0
+
+    events = load_events(obj)
 
     if args.chrome:
         chrome = to_chrome_trace(events)
