@@ -217,6 +217,23 @@ TUNE_CONFIG = os.path.join("raft_tpu", "config.py")
 TUNE_REGISTRY = os.path.join("raft_tpu", "core", "tuning.py")
 TUNE_EXEMPT = (TUNE_CONFIG, TUNE_REGISTRY)
 
+# block-shape knob lint: tile shapes at fused-kernel call sites
+# (block_q=, block_n=, ...) are REGISTRY integer-ladder knobs
+# (knn_block_q/knn_block_n/nn_block_n, core/tuning.py) with legality
+# predicates (lane/sublane multiples, VMEM fit) — a hand-written
+# integer at a consumer call site bypasses both the predicates and the
+# swept winners, which is exactly how the r5 hard-coded
+# `min(tile_n, 1024)` rotted.  Scope: raft_tpu/ outside the
+# kernel-owning ops/ modules (the kernels RESOLVE the knobs; their
+# signature defaults are not call sites), plus tools/ and bench.py.
+# tests/ pin geometry deliberately (lowering/export shape cases) and
+# are exempt.  `block-shape-ok` marks a deliberate probe/attribution
+# geometry.
+BLOCK_KW_NAMES = ("block_q", "block_n", "block_m", "block_rows",
+                  "block_w")
+BLOCK_KW_MARKER = "block-shape-ok"
+BLOCK_KW_OPS_DIR = os.path.join("raft_tpu", "ops") + os.sep
+
 _metric_doc_text = None
 _tune_sets_cache = None
 
@@ -421,6 +438,10 @@ def check_file(path, doc_text=None, repo_root=None):
     in_ops_jax_scope = rel in OPS_JAX_FILES
     in_tune_scope = (rel.startswith("raft_tpu" + os.sep)
                      and rel not in TUNE_EXEMPT)
+    in_block_scope = ((rel.startswith("raft_tpu" + os.sep)
+                       and not rel.startswith(BLOCK_KW_OPS_DIR))
+                      or rel.startswith("tools" + os.sep)
+                      or rel == "bench.py")
     src_lines = src.splitlines()
     if in_tune_scope:
         owned = {choices: knob for knob, choices, _, _
@@ -472,6 +493,26 @@ def check_file(path, doc_text=None, repo_root=None):
                 and node.module.startswith("raft_tpu")
                 and any(a.name == "*" for a in node.names)):
             problems.append(f"{rel}:{node.lineno}: wildcard raft_tpu import")
+        if in_block_scope and isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if (kw.arg in BLOCK_KW_NAMES
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, int)
+                        and not isinstance(kw.value.value, bool)
+                        and BLOCK_KW_MARKER
+                        not in src_lines[node.lineno - 1]
+                        and BLOCK_KW_MARKER
+                        not in src_lines[kw.value.lineno - 1]):
+                    problems.append(
+                        f"{rel}:{kw.value.lineno}: hand-written block "
+                        f"shape {kw.arg}={kw.value.value} at a kernel "
+                        "call site — tile shapes are registry "
+                        "integer-ladder knobs (knn_block_q/knn_block_n/"
+                        "nn_block_n; docs/TUNING.md \"Kernel "
+                        "block-shape knobs\"): pass None and let the "
+                        "kernel resolve the swept winner, or mark a "
+                        "deliberate probe geometry "
+                        f"`{BLOCK_KW_MARKER}`")
         if (in_serve_exc_scope and isinstance(node, ast.Call)
                 and ((isinstance(node.func, ast.Name)
                       and node.func.id == SERVE_SHED_NAME)
@@ -758,6 +799,7 @@ def selftest():
     failures += _selftest_tuning()
     failures += _selftest_persist_io()
     failures += _selftest_ops_jax()
+    failures += _selftest_block_shape()
     return failures
 
 
@@ -815,6 +857,62 @@ def _selftest_ops_jax():
                       "got %r" % (i, fname, expect, probs),
                       file=sys.stderr)
     print("ops-jax lint selftest: %d fixtures, %d failures"
+          % (len(cases), failures), file=sys.stderr)
+    return failures
+
+
+def _selftest_block_shape():
+    """Executable fixtures for the block-shape literal ban: integer
+    literals for block kwargs are flagged in consumer scope, the
+    ``block-shape-ok`` marker escapes, None/variable arguments pass,
+    and the kernel-owning ops/ modules plus tests/ are out of scope."""
+    import tempfile
+
+    cases = [
+        # (relpath, source, expect_flagged)
+        (os.path.join("raft_tpu", "spatial", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_n=2048)\n", True),
+        (os.path.join("raft_tpu", "spatial", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_q=64, block_n=bn)\n",
+         True),
+        (os.path.join("raft_tpu", "spatial", "f.py"),
+         "d = fused_nn_tile(x, y,\n"
+         "                  block_m=256)\n", True),
+        (os.path.join("raft_tpu", "spatial", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_n=2048)"
+         "  # block-shape-ok: fixture\n", False),
+        (os.path.join("raft_tpu", "spatial", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_n=None)\n", False),
+        (os.path.join("raft_tpu", "spatial", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_n=bn)\n", False),
+        # the kernel modules own their ladders/defaults
+        (os.path.join("raft_tpu", "ops", "f.py"),
+         "d, i = helper(x, q, k, block_n=2048)\n", False),
+        # tests pin geometry deliberately
+        (os.path.join("tests", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_n=1024)\n", False),
+        ("bench.py",
+         "d, i = fused_knn_twophase(x, q, k, block_n=2048)\n", True),
+        (os.path.join("tools", "f.py"),
+         "d, i = fused_knn_tile(x, q, k, block_q=128)\n", True),
+    ]
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for sub in (os.path.join("raft_tpu", "spatial"),
+                    os.path.join("raft_tpu", "ops"), "tests", "tools"):
+            os.makedirs(os.path.join(tmp, sub), exist_ok=True)
+        for i, (relp, src, expect) in enumerate(cases):
+            path = os.path.join(tmp, relp)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(src)
+            probs = [p for p in check_file(path, repo_root=tmp)
+                     if "hand-written block shape" in p]
+            if bool(probs) != expect:
+                failures += 1
+                print("block-shape fixture %d (%s): expected "
+                      "flagged=%s, got %r" % (i, relp, expect, probs),
+                      file=sys.stderr)
+    print("block-shape lint selftest: %d fixtures, %d failures"
           % (len(cases), failures), file=sys.stderr)
     return failures
 

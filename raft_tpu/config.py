@@ -51,9 +51,27 @@ knn_tile_merge
     returns wrong results by design and must never be reachable from
     config).
 fused_knn_impl
-    :func:`raft_tpu.spatial.fused_l2_knn` path: ``xla`` | ``pallas``;
-    unset = per-backend auto (currently ``xla`` everywhere, the r4
-    measured default).
+    :func:`raft_tpu.spatial.fused_l2_knn` path: ``xla`` | ``pallas`` |
+    ``xla_fused`` (the XLA-composed emulation of the fused kernel —
+    the off-TPU fallback and bitwise correctness oracle); unset =
+    per-backend auto (currently ``xla`` everywhere, the r4 measured
+    default).
+knn_block_q / knn_block_n
+    Fused-kNN kernel tile shape (:mod:`raft_tpu.ops.knn_tile` and its
+    ``xla_fused`` emulation): query rows / index columns per tile.
+    Integer ladders validated by the registry's legality predicates
+    (sublane/lane multiples + best-effort VMEM fit —
+    docs/TUNING.md "Kernel block-shape knobs").
+nn_block_n
+    Fused 1-NN kernel index-tile width
+    (:mod:`raft_tpu.ops.nn_tile`); same ladder discipline.
+ivf_scan_impl
+    IVF-Flat probe→scan→select path (:func:`raft_tpu.spatial.ann.
+    ivf_flat_search`): ``xla`` (gather + einsum + select, the
+    reference oracle) | ``pallas`` (fused one-pass slot-streaming
+    kernel, no materialized gather block) | ``pallas_bf16``
+    (bf16-multiplicand variant, f32 accumulate); unset = per-backend
+    auto (currently ``xla`` everywhere until the TPU table lands).
 pq_adc
     IVF-PQ ADC lookup (:func:`raft_tpu.spatial.ann.ivf_pq_search`):
     ``gather`` (per-element LUT) | ``onehot`` (one-hot einsum).
@@ -300,7 +318,15 @@ _KNOBS: Dict[str, Tuple[str, Optional[str], Optional[Tuple[str, ...]]]] = {
     "knn_tile_merge": ("RAFT_TPU_KNN_TILE_MERGE", "merge",
                        ("merge", "fullsort", "sorttile")),
     "fused_knn_impl": ("RAFT_TPU_FUSED_KNN_IMPL", None,
-                       ("xla", "pallas")),
+                       ("xla", "pallas", "xla_fused")),
+    "knn_block_q": ("RAFT_TPU_KNN_BLOCK_Q", "256",
+                    ("64", "128", "256", "512")),
+    "knn_block_n": ("RAFT_TPU_KNN_BLOCK_N", "1024",
+                    ("256", "512", "1024", "2048", "4096")),
+    "nn_block_n": ("RAFT_TPU_NN_BLOCK_N", "1024",
+                   ("256", "512", "1024", "2048", "4096")),
+    "ivf_scan_impl": ("RAFT_TPU_IVF_SCAN_IMPL", None,
+                      ("xla", "pallas", "pallas_bf16")),
     "pq_adc": ("RAFT_TPU_PQ_ADC", "gather", ("gather", "onehot")),
     "spmv_impl": ("RAFT_TPU_SPMV_IMPL", "segment",
                   ("segment", "cumsum", "sortscan")),

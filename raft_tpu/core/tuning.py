@@ -356,6 +356,100 @@ def _legal_knn_tile_merge(value, ctx):
     return _off_tpu_sweep(ctx)
 
 
+# Best-effort VMEM budget for the block-shape legality checks: real
+# v4/v5 cores carry 16 MiB; leave headroom for double-buffered DMA and
+# the select scratch.  A knob that passes here can still be rejected by
+# Mosaic on-chip — the predicate's job is to keep the sweep from timing
+# obviously-doomed shapes, not to model the compiler.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _block_bytes(bq, bn, d, k):
+    """Rough VMEM bytes of one fused-kNN grid step at (bq, bn): query +
+    index tiles (f32, depth padded to the 128-lane multiple), the
+    distance tile, and the running top-k scratch (kpad lanes, dist+idx)."""
+    dp = -(-max(int(d), 1) // 128) * 128 if d and int(d) > 128 else 128
+    kpad = 128
+    if k:
+        kpad = max(128, 1 << max(0, math.ceil(math.log2(int(k)))))
+    return 4 * (bq * dp + bn * dp + bq * bn + 2 * bq * 2 * kpad)
+
+
+def _legal_block(value, ctx, *, unit, companion_default, is_q):
+    """Shared integer-ladder legality: parse, alignment, VMEM fit.
+
+    The fit check uses the *companion* block's config default when the
+    ctx doesn't carry it — each knob is swept independently, so the
+    estimate is per-knob best-effort (module doc of the predicate
+    constant above).  No off-TPU sweep rejection: the same tile shapes
+    drive the ``xla_fused`` reference's geometry, so the ladder is a
+    real (timeable) candidate set on every backend.
+    """
+    try:
+        b = int(value)
+    except (TypeError, ValueError):
+        return "not an integer"
+    if b < unit or b % unit != 0:
+        return ("block shape %d must be a positive multiple of %d "
+                "(%s)" % (b, unit,
+                          "sublane rows" if unit == 8 else "lane width"))
+    d = ctx.get("d")
+    if d is not None:
+        bq, bn = (b, companion_default) if is_q else (companion_default, b)
+        need = _block_bytes(bq, bn, d, ctx.get("k"))
+        if need > _VMEM_BUDGET_BYTES:
+            return ("estimated VMEM %.1f MiB for (block_q=%d, block_n="
+                    "%d, d=%s) exceeds the %.0f MiB budget"
+                    % (need / 2**20, bq, bn, d,
+                       _VMEM_BUDGET_BYTES / 2**20))
+    return None
+
+
+def _legal_knn_block_q(value, ctx):
+    return _legal_block(value, ctx, unit=8, companion_default=1024,
+                        is_q=True)
+
+
+def _legal_knn_block_n(value, ctx):
+    return _legal_block(value, ctx, unit=128, companion_default=256,
+                        is_q=False)
+
+
+def _legal_nn_block_n(value, ctx):
+    # the 1-NN kernel keeps only a (bm, 128) running min — reuse the
+    # kNN estimate with its k-free scratch (k absent from ctx)
+    return _legal_block(value, ctx, unit=128, companion_default=256,
+                        is_q=False)
+
+
+def _legal_ivf_scan(value, ctx):
+    if value in ("pallas", "pallas_bf16"):
+        if ctx.get("k") is not None and int(ctx["k"]) > 128:
+            return ("the fused IVF scan kernel caps k at 128 (bitonic "
+                    "merge width); got k=%d — use impl='xla'"
+                    % int(ctx["k"]))
+        metric = ctx.get("metric")
+        if metric is not None and str(metric) not in (
+                "l2", "sqeuclidean", "euclidean", "l2sqrt"):
+            return ("the fused IVF scan kernel implements the expanded "
+                    "L2 family only; got metric=%r" % (metric,))
+        return _off_tpu_sweep(ctx)
+    return None
+
+
+def _legal_fused_knn_xla_ref(value, ctx):
+    if value == "xla_fused":
+        # the XLA-composed fused twin (ops/knn_tile.fused_knn_xla)
+        # shares the kernel's k <= 128 cap but runs everywhere (it IS
+        # the off-TPU production fallback) — no off-TPU sweep rejection
+        if ctx.get("k") is not None and int(ctx["k"]) > 128:
+            return ("the fused kNN formulation caps k at 128 (bitonic "
+                    "merge width); got k=%d — use impl='xla'"
+                    % int(ctx["k"]))
+        return None
+    return _legal_fused_knn(value, ctx)
+
+
 def _legal_group_size(value, ctx):
     try:
         g = int(value)
@@ -395,13 +489,52 @@ register(
     doc="Pallas fused-kNN/select merge network (ops/knn_tile.py)")
 
 register(
-    "fused_l2_knn", "fused_knn_impl", ("xla", "pallas"),
-    legality=_legal_fused_knn,
+    "fused_l2_knn", "fused_knn_impl", ("xla", "pallas", "xla_fused"),
+    legality=_legal_fused_knn_xla_ref,
     auto_default="xla",
     dims=("n", "k"),
-    doc="fused L2 kNN path (spatial/fused_l2_knn.py); unset = "
-        "per-backend auto (currently xla everywhere, the r4 measured "
-        "default)")
+    doc="fused L2 kNN path (spatial/fused_l2_knn.py): xla = tiled "
+        "two-stage scan, pallas = fused kernel, xla_fused = "
+        "XLA-composed emulation of the kernel (off-TPU fallback + "
+        "bitwise oracle); unset = per-backend auto (currently xla "
+        "everywhere, the r4 measured default)")
+
+register(
+    "fused_knn_tile", "knn_block_q", ("64", "128", "256", "512"),
+    legality=_legal_knn_block_q,
+    dims=("n", "k", "d"),
+    doc="fused-kNN query-tile rows (ops/knn_tile.py + the xla_fused "
+        "emulation's row-tile geometry); sublane-multiple integer "
+        "ladder, VMEM-fit checked (docs/TUNING.md)")
+
+register(
+    "fused_knn_tile", "knn_block_n", ("256", "512", "1024", "2048",
+                                      "4096"),
+    legality=_legal_knn_block_n,
+    dims=("n", "k", "d"),
+    doc="fused-kNN index-tile columns (ops/knn_tile.py + the "
+        "xla_fused emulation); lane-multiple integer ladder, VMEM-fit "
+        "checked")
+
+register(
+    "fused_nn_tile", "nn_block_n", ("256", "512", "1024", "2048",
+                                    "4096"),
+    legality=_legal_nn_block_n,
+    dims=("n", "d"),
+    doc="fused 1-NN index-tile columns (ops/nn_tile.py, consumed by "
+        "distance/fused_l2_nn.py); lane-multiple integer ladder")
+
+register(
+    "ivf_flat_search", "ivf_scan_impl", ("xla", "pallas",
+                                         "pallas_bf16"),
+    legality=_legal_ivf_scan,
+    auto_default="xla",
+    dims=("n", "k", "d"),
+    doc="IVF-Flat probe scan path (spatial/ann.py): xla = gather + "
+        "einsum + select oracle, pallas = fused one-pass "
+        "slot-streaming kernel, pallas_bf16 = bf16-multiplicand "
+        "variant (f32 accumulate); unset = per-backend auto "
+        "(currently xla everywhere until the TPU table lands)")
 
 register(
     "ivf_pq_search", "pq_adc", ("gather", "onehot"),

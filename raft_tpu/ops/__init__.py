@@ -4,11 +4,21 @@ This package plays the role of the reference's custom CUDA kernels
 (pairwise_distance_base.cuh, fused_l2_nn.cuh, fused_l2_knn.cuh,
 selection_faiss.cuh): everything here is written against the TPU memory
 hierarchy (HBM → VMEM → MXU/VPU) with explicit block shapes, and falls back
-to interpreter mode off-TPU so the full test suite runs on CPU.
+to interpreter mode off-TPU so the full test suite runs on CPU.  Each
+kernel has two XLA companions: a fast production twin sharing its tile
+geometry and distance arithmetic (``fused_knn_xla``; the IVF scan's
+``"xla"`` gather path plays this role in spatial/ann.py), and an
+op-for-op replay used as the bitwise correctness oracle in tests
+(``fused_knn_xla_oracle``, ``fused_ivf_scan_xla`` — seconds per call,
+never a serving path).
 """
 
-from raft_tpu.ops.knn_tile import fused_knn_tile
+from raft_tpu.ops.ivf_tile import fused_ivf_scan, fused_ivf_scan_xla
+from raft_tpu.ops.knn_tile import fused_knn_tile, fused_knn_xla, \
+    fused_knn_xla_oracle
 from raft_tpu.ops.nn_tile import fused_nn_tile
 from raft_tpu.ops.pairwise_tile import pairwise_tile
 
-__all__ = ["fused_knn_tile", "fused_nn_tile", "pairwise_tile"]
+__all__ = ["fused_ivf_scan", "fused_ivf_scan_xla", "fused_knn_tile",
+           "fused_knn_xla", "fused_knn_xla_oracle", "fused_nn_tile",
+           "pairwise_tile"]

@@ -1,0 +1,34 @@
+"""Version-tolerant imports for the Pallas API skew on this JAX build.
+
+Two drifts broke the seed's pallas files against the pinned JAX:
+
+1. ``jax.experimental.pallas.tpu`` renamed its compiler-params struct
+   across releases (``CompilerParams`` <-> ``TPUCompilerParams``).
+   Every kernel module imports :data:`CompilerParams` from here instead
+   of guessing which spelling this build carries.
+2. ``jax.export`` is a lazy submodule on this build: attribute access
+   ``jax.export`` raises ``AttributeError`` until the submodule has
+   been imported once.  Importing this module performs that import so
+   call sites (tests asserting ``tpu_custom_call`` in exported HLO) can
+   use the attribute form.
+
+Keep this file dependency-free beyond jax itself — it is imported at
+ops-module import time, before any backend is initialized.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+try:  # pragma: no cover - exercised only on newer builds
+    import jax.export  # noqa: F401  (registers the lazy submodule)
+except ImportError:  # pragma: no cover - very old builds
+    pass
+
+#: The TPU compiler-params dataclass under whichever name this JAX
+#: build exports it.  ``dimension_semantics=`` keyword is stable across
+#: both spellings.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+__all__ = ["CompilerParams"]

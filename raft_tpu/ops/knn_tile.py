@@ -67,6 +67,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.ops import compat
+
 from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled
@@ -97,6 +99,21 @@ def pad_with_norms(a: jnp.ndarray, rows_pad: int, dp: int):
     af = jnp.pad(a.astype(jnp.float32),
                  ((0, rows_pad - a.shape[0]), (0, dp - a.shape[1])))
     return af, jnp.sum(af * af, axis=1)
+
+
+def resolve_blocks(block_q, block_n, *, site, n, k, d, dtype):
+    """Registry resolution of the fused-kNN tile shape: explicit args
+    validate against the integer ladder (as strings — the registry's
+    candidate currency), None falls through the config ladder
+    (override → configure → env → tuning table → default) so swept
+    winners reach every kernel call site with zero consumer literals."""
+    bq = int(tuning.resolve(
+        "knn_block_q", None if block_q is None else str(block_q),
+        site=site, n=n, k=k, d=d, dtype=dtype))
+    bn = int(tuning.resolve(
+        "knn_block_n", None if block_n is None else str(block_n),
+        site=site, n=n, k=k, d=d, dtype=dtype))
+    return bq, bn
 
 
 def _roll_lanes(x: jnp.ndarray, shift: int, interpret: bool) -> jnp.ndarray:
@@ -245,7 +262,7 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
         # design — never reachable from the public dispatch
         # (fused_l2_knn/select_tile whitelists exclude it).
         worst = bd[:, kpad - 1:kpad]
-        hit = jnp.max((dist < worst).astype(jnp.int32)) > 0
+        hit = jnp.max((dist < worst).astype(jnp.float32)) > jnp.float32(0)
         # keep the gate's reduction live by folding it numerically into
         # the output (a same-operand select would be canonicalized away
         # and the gate dead-coded, under-counting the floor)
@@ -259,8 +276,9 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
         # question").  One scalar gate; contributing tiles pay a fixed
         # full-width bitonic sort + one 2*kpad merge tail.
         worst = bd[:, kpad - 1:kpad]
-        # int32 reduce-max, not jnp.any (f64 proxy under x64, as below)
-        hit = jnp.max((dist < worst).astype(jnp.int32)) > 0
+        # f32 reduce-max, not jnp.any (f64 proxy under x64, as below;
+        # Mosaic also lacks integer reductions on this build)
+        hit = jnp.max((dist < worst).astype(jnp.float32)) > jnp.float32(0)
 
         def _update(args):
             d_, bd_, bi_ = args
@@ -280,17 +298,22 @@ def topk_update(dist: jnp.ndarray, bd: jnp.ndarray, bi: jnp.ndarray,
     def gate(state):
         d, bd, _ = state
         worst = bd[:, kpad - 1:kpad]
-        # int32 reduce-max, not jnp.any: Mosaic proxies boolean
+        # f32 reduce-max, not jnp.any: Mosaic proxies boolean
         # reductions through the default float type, which is f64 under
-        # jax_enable_x64 and has no TPU lowering
-        return jnp.max((d < worst).astype(jnp.int32)) > 0
+        # jax_enable_x64 and has no TPU lowering — and this build's
+        # Mosaic has no integer reductions either
+        return jnp.max((d < worst).astype(jnp.float32)) > jnp.float32(0)
 
     def extract_merge(state):
         d, bd, bi = state
         d3 = d.reshape(bm, g, kpad)
         gmin = jnp.min(d3, axis=1)                        # (bm, kpad)
         is_min = d3 == jnp.expand_dims(gmin, 1)
-        gg_star = jnp.min(jnp.where(is_min, gg_iota, jnp.int32(g)), axis=1)
+        # reduce in f32 (exact: gg <= g << 2**24) — this build's Mosaic
+        # has no integer reductions
+        gg_star = jnp.min(
+            jnp.where(is_min, gg_iota, jnp.int32(g)).astype(jnp.float32),
+            axis=1).astype(jnp.int32)
         # candidate global id: strided grouping → column = gg*kpad + r
         cand_i = base_col + gg_star * kpad + r_iota
         cand_i = jnp.where(gmin < inf32, cand_i, jnp.int32(-1))
@@ -394,8 +417,8 @@ def fused_knn_twophase(
     index: jnp.ndarray,
     queries: jnp.ndarray,
     k: int,
-    block_q: int = 256,
-    block_n: int = 1024,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
     precision: str = "highest",
     interpret: Optional[bool] = None,
     merge_select_impl: Optional[str] = None,
@@ -430,6 +453,9 @@ def fused_knn_twophase(
     merge_select_impl = tuning.resolve(
         "merge_select_impl", merge_select_impl,
         site="fused_knn_twophase", k=k, dtype=index.dtype)
+    block_q, block_n = resolve_blocks(block_q, block_n,
+                                      site="fused_knn_twophase",
+                                      n=n, k=k, d=d, dtype=index.dtype)
     if interpret is None:
         interpret = not is_tpu_backend()
     kpad = 128
@@ -462,7 +488,7 @@ def fused_knn_twophase(
             jax.ShapeDtypeStruct((mp, grid[1] * kpad), jnp.float32),
             jax.ShapeDtypeStruct((mp, grid[1] * kpad), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             dimension_semantics=("parallel", "parallel"),
         ),
         interpret=interpret,
@@ -485,8 +511,8 @@ def fused_knn_tile(
     index: jnp.ndarray,
     queries: jnp.ndarray,
     k: int,
-    block_q: int = 256,
-    block_n: int = 1024,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
     precision: str = "highest",
     interpret: Optional[bool] = None,
     merge_impl: Optional[str] = None,
@@ -512,6 +538,9 @@ def fused_knn_tile(
     merge_impl = tuning.resolve("knn_tile_merge", merge_impl,
                                 site="fused_knn_tile", n=n, k=k,
                                 dtype=index.dtype)
+    block_q, block_n = resolve_blocks(block_q, block_n,
+                                      site="fused_knn_tile",
+                                      n=n, k=k, d=d, dtype=index.dtype)
 
     # next power of two >= max(k, 128): the bitonic merge width 2*kpad
     # must be a power of two, and kpad must stay a lane multiple
@@ -552,9 +581,183 @@ def fused_knn_tile(
             pltpu.VMEM((bm, kpad), jnp.float32),
             pltpu.VMEM((bm, kpad), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(qf, xf, qn, xn)
+    return out_d[:nq, :k], out_i[:nq, :k]
+
+
+@profiled("ops")
+def fused_knn_xla(
+    index: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
+    precision: str = "highest",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """XLA-composed fused brute-force kNN — the production off-TPU twin
+    of :func:`fused_knn_tile` (the ``fused_l2_knn`` ``"xla_fused"``
+    candidate), one program: no materialized (nq, n) distance matrix
+    and no second select_k dispatch.
+
+    Shares the kernel's ``tile_geometry``/``pad_with_norms`` padding
+    and per-tile distance arithmetic exactly (dot_general contracting
+    dim 1 at f32, expanded-form norms, ragged-tail mask), so the
+    per-element distance VALUES are bit-identical to the kernel's.
+    Only the running selection differs: each index tile takes an exact
+    ``lax.top_k`` merged into the running (bm, k) window instead of the
+    kernel's lane networks (that op-for-op replay lives in
+    :func:`fused_knn_xla_oracle`; it exists for bitwise tests, not for
+    serving — it is ~1000x slower).  Exact selection over identical
+    values means the OUTPUT distances still match the kernel bitwise;
+    ids agree wherever distances are distinct (equal-distance ties may
+    pick a different id — the kernel's own documented latitude).
+
+    The ``knn_block_q``/``knn_block_n`` ladders drive this path's tile
+    geometry too, which is what makes the block-shape knobs honestly
+    timeable on every backend (tools/autotune.py).
+    """
+    expects(index.ndim == 2 and queries.ndim == 2
+            and index.shape[1] == queries.shape[1],
+            "fused_knn_xla: shape mismatch")
+    n, d = index.shape
+    nq = queries.shape[0]
+    expects(0 < k <= n, "fused_knn_xla: k=%d out of range for n=%d", k, n)
+    expects(k <= 128,
+            "fused_knn_xla: k <= 128 (bitonic width cap; got %d)", k)
+    block_q, block_n = resolve_blocks(block_q, block_n,
+                                      site="fused_knn_xla",
+                                      n=n, k=k, d=d, dtype=index.dtype)
+    kpad = 128
+    bm, bn, g, dp, mp, np_ = tile_geometry(nq, n, d, block_q, block_n,
+                                           unit=kpad)
+    xf, xn_row = pad_with_norms(index, np_, dp)
+    qf, qn_row = pad_with_norms(queries, mp, dp)
+    n_i, n_j = mp // bm, np_ // bn
+    xts = xf.reshape(n_j, bn, dp)
+    xnts = xn_row.reshape(n_j, 1, bn)
+    prec = jax.lax.Precision(precision) if precision else None
+    inf32 = jnp.float32(_INF)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+
+    def row_tile(args):
+        qt, qnt = args                       # (bm, dp), (bm, 1)
+
+        def step(carry, xargs):
+            bneg, bi = carry                 # negated running top-k
+            xt, xnt, j = xargs
+            acc = jax.lax.dot_general(
+                qt, xt, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=prec)
+            dist = jnp.maximum(qnt + xnt - 2.0 * acc, 0.0)
+            dist = jnp.where(j * bn + col < n, dist, inf32)
+            # exact tile top-k on negated distances (top_k is a max
+            # select), then an exact merge of the 2k-wide concat
+            tneg, ti = jax.lax.top_k(-dist, k)
+            cneg = jnp.concatenate([bneg, tneg], axis=1)
+            ci = jnp.concatenate([bi, j * bn + ti], axis=1)
+            mneg, mpos = jax.lax.top_k(cneg, k)
+            return (mneg, jnp.take_along_axis(ci, mpos, axis=1)), None
+
+        init = (jnp.full((bm, k), -_INF, jnp.float32),
+                jnp.full((bm, k), -1, jnp.int32))
+        (bneg, bi), _ = jax.lax.scan(
+            step, init, (xts, xnts, jnp.arange(n_j, dtype=jnp.int32)))
+        return -bneg, bi
+
+    out_d, out_i = jax.lax.map(
+        row_tile, (qf.reshape(n_i, bm, dp), qn_row.reshape(n_i, bm, 1)))
+    return (out_d.reshape(mp, k)[:nq], out_i.reshape(mp, k)[:nq])
+
+
+@profiled("ops")
+def fused_knn_xla_oracle(
+    index: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    block_q: Optional[int] = None,
+    block_n: Optional[int] = None,
+    precision: str = "highest",
+    merge_impl: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Op-for-op XLA replay of :func:`fused_knn_tile` — the kernel's
+    bitwise correctness oracle (tests only; seconds per call — the
+    production XLA twin is :func:`fused_knn_xla`).
+
+    Replays the kernel at the jnp level: the same
+    ``tile_geometry``/``pad_with_norms`` padding, the same per-(i, j)
+    tile distance compute (dot_general + expanded-form norms + the
+    ragged-tail mask), and the very same :func:`topk_update` running
+    top-k (interpret-path lane networks) — a ``lax.scan`` over index
+    tiles inside a ``lax.map`` over row tiles stands in for the
+    (parallel, arbitrary) grid.  Identical op order per element means
+    the interpreted kernel and this path agree BITWISE on one backend
+    (tests/test_fused_kernels.py pins that), which is what makes it an
+    oracle rather than just another implementation.
+
+    scan, not vmap, over the inner axis: vmapping the while-loop gate
+    would rewrite it to a masked fixed-trip form and the op order (and
+    tie behavior) would drift from the kernel's.
+    """
+    expects(index.ndim == 2 and queries.ndim == 2
+            and index.shape[1] == queries.shape[1],
+            "fused_knn_xla_oracle: shape mismatch")
+    n, d = index.shape
+    nq = queries.shape[0]
+    expects(0 < k <= n,
+            "fused_knn_xla_oracle: k=%d out of range for n=%d", k, n)
+    expects(k <= 128,
+            "fused_knn_xla_oracle: k <= 128 (bitonic width cap; got %d)",
+            k)
+    merge_impl = tuning.resolve("knn_tile_merge", merge_impl,
+                                site="fused_knn_xla_oracle", n=n, k=k,
+                                dtype=index.dtype)
+    expects(merge_impl != "skip",
+            "fused_knn_xla_oracle: the 'skip' attribution probe is "
+            "kernel-only")
+    block_q, block_n = resolve_blocks(block_q, block_n,
+                                      site="fused_knn_xla_oracle",
+                                      n=n, k=k, d=d, dtype=index.dtype)
+    kpad = 128
+    while kpad < k:
+        kpad *= 2
+    bm, bn, g, dp, mp, np_ = tile_geometry(nq, n, d, block_q, block_n,
+                                           unit=kpad)
+    xf, xn_row = pad_with_norms(index, np_, dp)
+    qf, qn_row = pad_with_norms(queries, mp, dp)
+    n_i, n_j = mp // bm, np_ // bn
+    xts = xf.reshape(n_j, bn, dp)
+    xnts = xn_row.reshape(n_j, 1, bn)
+    prec = jax.lax.Precision(precision) if precision else None
+    inf32 = jnp.float32(_INF)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+
+    def row_tile(args):
+        qt, qnt = args                       # (bm, dp), (bm, 1)
+
+        def step(carry, xargs):
+            bd, bi = carry
+            xt, xnt, j = xargs
+            acc = jax.lax.dot_general(
+                qt, xt, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=prec)
+            dist = jnp.maximum(qnt + xnt - 2.0 * acc, 0.0)
+            dist = jnp.where(j * bn + col < n, dist, inf32)
+            bd, bi = topk_update(dist, bd, bi, j * bn, kpad=kpad, g=g,
+                                 interpret=True, merge_impl=merge_impl)
+            return (bd, bi), None
+
+        init = (jnp.full((bm, kpad), _INF, jnp.float32),
+                jnp.full((bm, kpad), -1, jnp.int32))
+        (bd, bi), _ = jax.lax.scan(
+            step, init, (xts, xnts, jnp.arange(n_j, dtype=jnp.int32)))
+        return bd, bi
+
+    out_d, out_i = jax.lax.map(
+        row_tile, (qf.reshape(n_i, bm, dp), qn_row.reshape(n_i, bm, 1)))
+    out_d = out_d.reshape(mp, kpad)
+    out_i = out_i.reshape(mp, kpad)
     return out_d[:nq, :k], out_i[:nq, :k]

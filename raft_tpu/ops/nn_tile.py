@@ -44,6 +44,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.ops import compat
+
+from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled
 from raft_tpu.core.utils import is_tpu_backend
@@ -79,7 +82,11 @@ def _nn_kernel(x_ref, y_ref, xn_ref, yn_ref, ov_ref, oi_ref,
     gmin = jnp.min(d3, axis=1)                                # (bm, 128)
     gg_iota = jax.lax.broadcasted_iota(jnp.int32, (bm, g, 128), 1)
     is_min = d3 == jnp.expand_dims(gmin, 1)
-    gg_star = jnp.min(jnp.where(is_min, gg_iota, jnp.int32(g)), axis=1)
+    # reduce in f32 (exact: gg <= g << 2**24) — this build's Mosaic
+    # has no integer reductions
+    gg_star = jnp.min(
+        jnp.where(is_min, gg_iota, jnp.int32(g)).astype(jnp.float32),
+        axis=1).astype(jnp.int32)
     lane = jax.lax.broadcasted_iota(jnp.int32, (bm, 128), 1)
     cand_i = j * bn + gg_star * 128 + lane
     cand_i = jnp.where(gmin < inf32, cand_i, jnp.int32(IDX_SENTINEL))
@@ -103,7 +110,7 @@ def fused_nn_tile(
     x: jnp.ndarray,
     y: jnp.ndarray,
     block_m: int = 256,
-    block_n: int = 1024,
+    block_n: Optional[int] = None,
     precision: str = "highest",
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -120,6 +127,12 @@ def fused_nn_tile(
     m, d = x.shape
     n = y.shape[0]
     expects(n > 0, "fused_nn_tile: empty index")
+    # nn_block_n registry knob: explicit args validate against the
+    # integer ladder; None resolves through the config ladder so swept
+    # winners reach every call site (knn_tile.resolve_blocks rationale)
+    block_n = int(tuning.resolve(
+        "nn_block_n", None if block_n is None else str(block_n),
+        site="fused_nn_tile", n=n, d=d, dtype=x.dtype))
     if interpret is None:
         interpret = not is_tpu_backend()
 
@@ -156,7 +169,7 @@ def fused_nn_tile(
             pltpu.VMEM((bm, 128), jnp.float32),
             pltpu.VMEM((bm, 128), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.ops import compat
+
 from raft_tpu.core import tuning
 from raft_tpu.core.error import expects
 from raft_tpu.core.profiler import profiled
@@ -144,7 +146,7 @@ def select_tile(
             pltpu.VMEM((bm, kpad), jnp.float32),
             pltpu.VMEM((bm, kpad), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -274,6 +274,38 @@ def _validate_nprobe(name: str, nprobe, nlist: int) -> int:
     return nprobe
 
 
+def _probe_compact(q, centroids, cent_slots, nprobe, select_impl=None,
+                   probes=None):
+    """Probe selection + valid-first scan-list compaction — the shared
+    front half of every IVF search path (the XLA fori-loop scan AND the
+    fused Pallas kernel consume the SAME ``slots``/``prank`` arrays, so
+    probe tie order can never differ between them).
+
+    Returns ``(slots (nq, nprobe*max_slots) int32 valid-first
+    -1-padded, prank (same shape) probe ranks, n_live traced
+    worst-case live-slot count)``.
+    """
+    nq = q.shape[0]
+    nlist, max_slots = cent_slots.shape
+    nprobe = min(nprobe, nlist)
+    if probes is None:
+        qc = expanded_sq_dists(q, centroids)
+        _, probes = select_k(qc, nprobe, select_min=True,
+                             impl=select_impl)               # (nq, nprobe)
+    slots = cent_slots[probes].reshape(nq, -1)               # -1-padded
+    prank = jnp.broadcast_to(
+        jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_slots)[None],
+        slots.shape)
+    # valid-first compaction as ONE stable variadic sort (slots/prank
+    # ride as operands) — argsort + two take_along_axis would be serial
+    # per-row gathers on TPU (r4 tile-merge finding)
+    _, slots, prank = lax.sort(
+        ((slots < 0).astype(jnp.int32), slots, prank), dimension=1,
+        num_keys=1, is_stable=True)
+    n_live = jnp.max(jnp.sum(slots >= 0, axis=1))
+    return slots, prank, n_live
+
+
 def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
                        metric, probes=None, select_impl=None):
     """Shared IVF search driver: probe centroids, then scan the probed
@@ -295,23 +327,8 @@ def _probe_scan_search(q, centroids, cent_slots, step_dist, k, nprobe,
     nprobe·max_slots.
     """
     nq = q.shape[0]
-    nlist, max_slots = cent_slots.shape
-    nprobe = min(nprobe, nlist)
-    if probes is None:
-        qc = expanded_sq_dists(q, centroids)
-        _, probes = select_k(qc, nprobe, select_min=True,
-                             impl=select_impl)               # (nq, nprobe)
-    slots = cent_slots[probes].reshape(nq, -1)               # -1-padded
-    prank = jnp.broadcast_to(
-        jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_slots)[None],
-        slots.shape)
-    # valid-first compaction as ONE stable variadic sort (slots/prank
-    # ride as operands) — argsort + two take_along_axis would be serial
-    # per-row gathers on TPU (r4 tile-merge finding)
-    _, slots, prank = lax.sort(
-        ((slots < 0).astype(jnp.int32), slots, prank), dimension=1,
-        num_keys=1, is_stable=True)
-    n_live = jnp.max(jnp.sum(slots >= 0, axis=1))
+    slots, prank, n_live = _probe_compact(q, centroids, cent_slots,
+                                          nprobe, select_impl, probes)
 
     dt = jnp.result_type(q.dtype, jnp.float32)
     init = (jnp.full((nq, k), jnp.inf, dt),
@@ -435,9 +452,39 @@ def ivf_flat_build(X, params: IVFFlatParams,
     return idx
 
 
+def _metric_family(metric) -> str:
+    """The registry-legality metric string for an IVF DistanceType
+    (the quantizers are L2-only, so this is a two-way split)."""
+    return ("l2sqrt" if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded)
+            else "l2")
+
+
 def _ivf_flat_search_impl(centroids, slot_vecs, slot_norms, slot_ids,
                           cent_slots, q, k, nprobe, metric,
-                          select_impl=None):
+                          select_impl=None, scan_impl=None):
+    # scan-path resolution (override → configure → env → table →
+    # auto "xla"): the fused Pallas kernel streams slot tiles through
+    # VMEM with a running top-k (ops/ivf_tile.py — no materialized
+    # (nq, cap, d) gather block); "xla" is the reference gather+einsum+
+    # select oracle below.  Resolved at trace time like select_impl —
+    # the executable-cache caveat (config.py module doc) applies.
+    scan_impl = tuning.resolve(
+        "ivf_scan_impl", scan_impl, site="ivf_flat_search",
+        n=slot_vecs.shape[0] * slot_vecs.shape[1], k=k, d=q.shape[1],
+        metric=_metric_family(metric), dtype=q.dtype) or "xla"
+    if scan_impl in ("pallas", "pallas_bf16"):
+        from raft_tpu.ops.ivf_tile import fused_ivf_scan
+
+        slots, _prank, _n_live = _probe_compact(
+            q, centroids, cent_slots,
+            min(nprobe, cent_slots.shape[0]), select_impl)
+        dist, ids = fused_ivf_scan(
+            q, slot_vecs, slot_norms, slot_ids, slots, k,
+            accum_bf16=(scan_impl == "pallas_bf16"))
+        if metric in (D.L2SqrtExpanded, D.L2SqrtUnexpanded):
+            dist = jnp.sqrt(dist)
+        return dist, ids
+
     qn = jnp.sum(q * q, axis=1)
 
     def step_dist(slx, _pjx):
@@ -459,7 +506,8 @@ def _ivf_flat_search_impl(centroids, slot_vecs, slot_norms, slot_ids,
 # loadgen's post-warmup-compile count read compile_cache_stats(), so the
 # programs ANNService fronts must attribute their compiles there like
 # every other served primitive (tiled_knn, serve_pairwise)
-_IVF_FLAT_STATICS = ("k", "nprobe", "metric", "select_impl")
+_IVF_FLAT_STATICS = ("k", "nprobe", "metric", "select_impl",
+                     "scan_impl")
 _ivf_flat_search_jit = profiled_jit(
     name="ivf_flat_search",
     static_argnames=_IVF_FLAT_STATICS)(_ivf_flat_search_impl)
@@ -471,7 +519,8 @@ _ivf_flat_search_jit_donated = profiled_jit(
 def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
                     nprobe: Optional[int] = None, handle=None, *,
                     delta=None, donate_queries: bool = False,
-                    select_impl: Optional[str] = None
+                    select_impl: Optional[str] = None,
+                    scan_impl: Optional[str] = None
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Search an IVF-Flat index (reference approx_knn_search, ann.hpp:71);
     ``nprobe`` defaults to the build params' value.
@@ -484,7 +533,11 @@ def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
     implementation explicitly (None = the ``select_impl`` knob;
     ``"approx"`` is membership-exact at recall 1.0 and measured ~7x
     faster than the full-sort payload path at k=100 on the CPU
-    backend, at the cost of tie order).
+    backend, at the cost of tie order).  ``scan_impl`` pins the probe
+    scan path: ``"xla"`` (gather+einsum+select oracle), ``"pallas"``
+    (the fused one-pass slot-streaming kernel, ops/ivf_tile.py) or
+    ``"pallas_bf16"`` (bf16 multiplicands, f32 accumulate); None =
+    the ``ivf_scan_impl`` knob (auto "xla" until the TPU table lands).
     """
     q = jnp.asarray(queries)
     nprobe = index.nprobe if nprobe is None else nprobe
@@ -499,7 +552,7 @@ def ivf_flat_search(index: IVFFlatIndex, queries, k: int,
                else _ivf_flat_search_jit)
     out = base_fn(index.centroids, index.slot_vecs, norms,
                   index.slot_ids, index.cent_slots, q, k, nprobe,
-                  metric, select_impl=select_impl)
+                  metric, select_impl=select_impl, scan_impl=scan_impl)
     if delta is not None:
         out = _merge_delta(out, delta, q, k, metric, donate_queries)
     record_on_handle(handle, *out)

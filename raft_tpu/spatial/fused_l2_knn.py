@@ -19,6 +19,12 @@ TPU re-design, two implementations sharing the same contract:
   (:mod:`raft_tpu.ops.knn_tile`) — distance tile and running top-k both
   VMEM-resident, threshold-gated bitonic merge, the true analog of the
   reference's one-kernel design.
+- ``impl="xla_fused"``: the XLA-composed fused twin
+  (:func:`raft_tpu.ops.knn_tile.fused_knn_xla`) — the kernel's tile
+  geometry and distance arithmetic with an exact per-tile
+  ``lax.top_k`` running merge: one program, no (nq, n) matrix, the
+  off-TPU production fallback.  (The op-for-op bitwise oracle is
+  ``fused_knn_xla_oracle``, tests only.)
 - ``impl=None`` (default): "xla" everywhere as of r4 — the one honest
   steady-state measurement (100k×1024q k=100, v5e) put the tile-scan
   at 1.74 s vs the fused kernel's 4.01 s, so the default follows the
@@ -67,7 +73,9 @@ def fused_l2_knn(
         Index rows per scan step; bounds the live distance tile to
         (n_queries, tile_n) (xla impl) / the kernel index-block (pallas).
     impl:
-        "xla", "pallas", or None = pick per backend (see module doc).
+        "xla", "pallas", "xla_fused" (the XLA-composed fused twin of
+        the kernel — one program, off-TPU production fallback,
+        ops/knn_tile.py), or None = pick per backend (see module doc).
         Env override: RAFT_TPU_FUSED_KNN_IMPL.
     donate_queries:
         Consume the queries buffer (the xla scan path donates it to
@@ -98,9 +106,15 @@ def fused_l2_knn(
     if impl == "pallas":
         from raft_tpu.ops.knn_tile import fused_knn_tile
 
-        return fused_knn_tile(index, queries, k,
-                              block_n=min(tile_n, 1024),
-                              precision=precision)
+        # tile shape comes from the knn_block_q/knn_block_n registry
+        # knobs inside the kernel entry — no consumer-local literal, so
+        # swept winners reach this call site (ci/style_check.py bans
+        # re-introducing one)
+        return fused_knn_tile(index, queries, k, precision=precision)
+    if impl == "xla_fused":
+        from raft_tpu.ops.knn_tile import fused_knn_xla
+
+        return fused_knn_xla(index, queries, k, precision=precision)
     # stable tile-dist identity: a per-call closure would retrace the
     # whole tiled scan every call (r5 retrace audit); the precision
     # variant is lru-memoized and the query norms ride along as a

@@ -1,0 +1,248 @@
+"""Interpreted-Pallas vs XLA-reference parity for the fused kernels.
+
+The contract under test (ISSUE r6): every fused kernel ships with an
+XLA-composed companion selected through the tuning registry —
+
+- an op-for-op ORACLE that replays the kernel's exact op order at the
+  jnp level, so interpreted kernel and oracle agree BITWISE on one
+  backend (``fused_knn_xla_oracle``, ``fused_ivf_scan_xla``); and
+- for brute-force kNN, a FAST production twin (``fused_knn_xla``) with
+  the same tile geometry and distance arithmetic but an exact
+  ``lax.top_k`` running merge: distance VALUES match the kernel
+  bitwise, ids agree wherever distances are distinct.
+
+COST DISCIPLINE: one interpret-mode execution of a while-loop
+running-select kernel costs ~15 s FLAT on CPU (the gate loop's lane
+networks dispatch eagerly — not compile-cached), and the op-for-op
+oracles pay the same per tile.  Tier-1 keeps at most a couple of
+interpret executions; the full rung x k x dtype matrix is
+``@pytest.mark.slow`` (run with ``-m slow``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tuning
+from raft_tpu.core.error import LogicError
+from raft_tpu.ops.ivf_tile import fused_ivf_scan, fused_ivf_scan_xla
+from raft_tpu.ops.knn_tile import (fused_knn_tile, fused_knn_xla,
+                                   fused_knn_xla_oracle)
+from raft_tpu.spatial.fused_l2_knn import fused_l2_knn
+
+
+def _np_knn(x, q, k):
+    """Full-sort host reference: squared L2, ascending, stable ids."""
+    d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    ids = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, ids, axis=1), ids
+
+
+def _rand(shape, seed):
+    return np.random.RandomState(seed).random(shape).astype(np.float32)
+
+
+def _slot_store(S, cap, d, seed, vacancy_rows=0):
+    """Synthetic slotted IVF store: (S, cap, d) vectors, squared norms,
+    global ids with ``vacancy_rows`` trailing -1 vacancies per slot."""
+    rng = np.random.RandomState(seed)
+    sv = rng.random((S, cap, d)).astype(np.float32)
+    sn = (sv * sv).sum(-1).astype(np.float32)
+    si = np.arange(S * cap, dtype=np.int32).reshape(S, cap)
+    if vacancy_rows:
+        si[:, cap - vacancy_rows:] = -1
+        sv[:, cap - vacancy_rows:] = 0.0
+        sn[:, cap - vacancy_rows:] = 0.0
+    return sv, sn, si
+
+
+# --------------------------------------------------------------------- #
+# fast twin: exactness + tie-break (cheap, tier-1)
+# --------------------------------------------------------------------- #
+class TestFusedKnnXlaTwin:
+    @pytest.mark.parametrize("n,d,nq,k", [
+        (96, 8, 16, 5),
+        (700, 24, 33, 11),
+        (2048, 64, 32, 128),   # k at the kpad cap
+    ])
+    def test_exact_vs_full_sort(self, n, d, nq, k):
+        x, q = _rand((n, d), 1), _rand((nq, d), 2)
+        dd, ii = fused_knn_xla(jnp.asarray(x), jnp.asarray(q), k)
+        rd, _ = _np_knn(x, q, k)
+        dd, ii = np.asarray(dd), np.asarray(ii)
+        np.testing.assert_allclose(dd, rd, atol=1e-4)
+        # id contract: every returned id really has the distance at
+        # its rank (expanded-form rounding may swap near-ties, so ids
+        # are checked through their distances, not positionally), and
+        # no id repeats within a row
+        for r in range(nq):
+            assert len(set(ii[r].tolist())) == k
+            np.testing.assert_allclose(
+                ((q[r] - x[ii[r]]) ** 2).sum(-1), rd[r], atol=1e-4)
+
+    def test_tie_break_at_k_boundary(self):
+        # duplicate index rows straddle the k boundary: the running
+        # merge must keep exactly k of the tied distance and never
+        # emit a duplicate or out-of-range id
+        base = _rand((8, 16), 3)
+        x = np.concatenate([base] * 6, axis=0)        # 48 rows, 6-way ties
+        q = base[:3] + 0.0
+        k = 9                                         # ties cross k=9
+        dd, ii = fused_knn_xla(jnp.asarray(x), jnp.asarray(q), k)
+        dd, ii = np.asarray(dd), np.asarray(ii)
+        rd, _ = _np_knn(x, q, k)
+        np.testing.assert_allclose(dd, rd, atol=1e-5)
+        for r in range(q.shape[0]):
+            assert len(set(ii[r].tolist())) == k      # no id reuse
+            assert ((ii[r] >= 0) & (ii[r] < x.shape[0])).all()
+            # every returned id really has the reported distance
+            np.testing.assert_allclose(
+                ((q[r] - x[ii[r]]) ** 2).sum(-1), dd[r], atol=1e-5)
+
+    def test_k_cap(self):
+        x, q = _rand((512, 8), 4), _rand((4, 8), 5)
+        with pytest.raises(LogicError):
+            fused_knn_xla(jnp.asarray(x), jnp.asarray(q), 129)
+
+    def test_dispatch_through_fused_l2_knn(self):
+        # impl="xla_fused" must route the public entry point to the
+        # twin and agree with the shipped tiled-scan pipeline
+        x, q = _rand((600, 32), 6), _rand((24, 32), 7)
+        df, jf = fused_l2_knn(jnp.asarray(x), jnp.asarray(q), 10,
+                              impl="xla_fused")
+        dr, jr = fused_l2_knn(jnp.asarray(x), jnp.asarray(q), 10,
+                              impl="xla")
+        np.testing.assert_allclose(np.asarray(df), np.asarray(dr),
+                                   atol=1e-4)
+        assert np.array_equal(np.asarray(jf), np.asarray(jr))
+
+
+# --------------------------------------------------------------------- #
+# block-shape knob legality (registry predicates; no kernel runs)
+# --------------------------------------------------------------------- #
+class TestBlockKnobLegality:
+    def test_ladder_values_resolve(self):
+        for v in ("256", "512", "1024", "2048", "4096"):
+            got = tuning.resolve("knn_block_n", v, site="t",
+                                 n=4096, k=16, d=32)
+            assert got == v
+
+    def test_off_ladder_rejected(self):
+        with pytest.raises(LogicError):
+            tuning.resolve("knn_block_n", "300", site="t",
+                           n=4096, k=16, d=32)
+
+    def test_lane_multiple_enforced(self):
+        # 64 is sublane-legal for block_q but NOT lane-legal for block_n
+        assert tuning.check("knn_block_q", "64", n=4096, k=16,
+                            d=32) == "64"
+        with pytest.raises(LogicError, match="multiple"):
+            tuning.check("knn_block_n", "8", n=4096, k=16, d=32)
+
+    def test_vmem_fit_rejects_wide_blocks_at_depth(self):
+        # (block_n=4096, d=4096): the index tile alone is 64 MiB —
+        # far past the 12 MiB kernel budget
+        with pytest.raises(LogicError, match="VMEM"):
+            tuning.check("knn_block_n", "4096", n=100_000, k=64,
+                         d=4096)
+
+    def test_twin_resolves_blocks_from_registry(self, monkeypatch):
+        # the twin's call-site geometry comes from the knobs: pinning
+        # knn_block_n via env must change the tile split without
+        # changing results
+        x, q = _rand((600, 16), 8), _rand((8, 16), 9)
+        d0, i0 = fused_knn_xla(jnp.asarray(x), jnp.asarray(q), 4)
+        monkeypatch.setenv("RAFT_TPU_KNN_BLOCK_N", "256")
+        d1, i1 = fused_knn_xla(jnp.asarray(x), jnp.asarray(q), 4)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+# --------------------------------------------------------------------- #
+# interpreted kernel vs references — ONE small interpret execution per
+# test (~15 s each); the matrix lives in the slow block below
+# --------------------------------------------------------------------- #
+class TestKernelParityTier1:
+    def test_knn_kernel_bitwise_vs_fast_twin(self):
+        # ragged n (tail mask), ragged nq (row padding), k off the
+        # lane width — distances must match the twin BITWISE; ids agree
+        # on distinct distances (random floats: ties improbable)
+        x, q = _rand((700, 24), 10), _rand((33, 24), 11)
+        k = 11
+        dk, ik = fused_knn_tile(jnp.asarray(x), jnp.asarray(q), k,
+                                interpret=True)
+        dx, ix = fused_knn_xla(jnp.asarray(x), jnp.asarray(q), k)
+        np.testing.assert_array_equal(np.asarray(dk), np.asarray(dx))
+        assert np.array_equal(np.asarray(ik), np.asarray(ix))
+
+    def test_ivf_kernel_bitwise_vs_oracle(self):
+        # vacancies + short (-1-padded) scan lists in one shot
+        S, cap, d, k, nq, n_steps = 6, 24, 10, 5, 7, 4
+        sv, sn, si = _slot_store(S, cap, d, 12, vacancy_rows=3)
+        q = _rand((nq, d), 13)
+        rng = np.random.RandomState(14)
+        slots = np.stack([rng.permutation(S)[:n_steps]
+                          for _ in range(nq)]).astype(np.int32)
+        slots[0, 2:] = -1                             # short scan list
+        args = (jnp.asarray(q), jnp.asarray(sv), jnp.asarray(sn),
+                jnp.asarray(si), jnp.asarray(slots), k)
+        dk, ik = fused_ivf_scan(*args, interpret=True)
+        dx, ix = fused_ivf_scan_xla(*args)
+        np.testing.assert_array_equal(np.asarray(dk), np.asarray(dx))
+        np.testing.assert_array_equal(np.asarray(ik), np.asarray(ix))
+
+
+# --------------------------------------------------------------------- #
+# the full parity matrix: rung x k x dtype (slow; ~15 s per case)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+class TestKernelParityMatrix:
+    @pytest.mark.parametrize("n,k", [(300, 1), (700, 11), (1500, 100)])
+    def test_knn_oracle_bitwise(self, n, k):
+        x, q = _rand((n, 24), 20), _rand((17, 24), 21)
+        dk, ik = fused_knn_tile(jnp.asarray(x), jnp.asarray(q), k,
+                                interpret=True)
+        do, io = fused_knn_xla_oracle(jnp.asarray(x), jnp.asarray(q), k)
+        np.testing.assert_array_equal(np.asarray(dk), np.asarray(do))
+        np.testing.assert_array_equal(np.asarray(ik), np.asarray(io))
+
+    def test_knn_kernel_tie_break_at_k_boundary(self):
+        # duplicate rows 6-way at k=9: the kernel's running bitonic
+        # merge and the twin must agree on the tied distance multiset
+        base = _rand((8, 16), 22)
+        x = np.concatenate([base] * 6, axis=0)
+        q = base[:3] + 0.0
+        k = 9
+        dk, ik = fused_knn_tile(jnp.asarray(x), jnp.asarray(q), k,
+                                interpret=True)
+        dk, ik = np.asarray(dk), np.asarray(ik)
+        rd, _ = _np_knn(x, q, k)
+        np.testing.assert_allclose(dk, rd, atol=1e-5)
+        for r in range(q.shape[0]):
+            assert len(set(ik[r].tolist())) == k
+            np.testing.assert_allclose(
+                ((q[r] - x[ik[r]]) ** 2).sum(-1), dk[r], atol=1e-5)
+
+    @pytest.mark.parametrize("accum_bf16", [False, True])
+    def test_ivf_oracle_bitwise_by_dtype(self, accum_bf16):
+        S, cap, d, k, nq, n_steps = 8, 40, 18, 13, 9, 5
+        sv, sn, si = _slot_store(S, cap, d, 23, vacancy_rows=2)
+        q = _rand((nq, d), 24)
+        rng = np.random.RandomState(25)
+        slots = np.stack([rng.permutation(S)[:n_steps]
+                          for _ in range(nq)]).astype(np.int32)
+        args = (jnp.asarray(q), jnp.asarray(sv), jnp.asarray(sn),
+                jnp.asarray(si), jnp.asarray(slots), k)
+        dk, ik = fused_ivf_scan(*args, accum_bf16=accum_bf16,
+                                interpret=True)
+        dx, ix = fused_ivf_scan_xla(*args, accum_bf16=accum_bf16)
+        # kernel vs oracle is bitwise in BOTH dtypes (same op order);
+        # bf16 accuracy vs f32 truth is a separate, tolerance question
+        np.testing.assert_array_equal(np.asarray(dk), np.asarray(dx))
+        np.testing.assert_array_equal(np.asarray(ik), np.asarray(ix))
+        if accum_bf16:
+            df, _ = fused_ivf_scan_xla(*args)  # f32 truth
+            np.testing.assert_allclose(np.asarray(dk), np.asarray(df),
+                                       atol=5e-2)

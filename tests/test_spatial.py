@@ -197,6 +197,11 @@ def test_brute_force_knn_grouped_labels(rng):
 # --------------------------------------------------------------------- #
 # fused distance+top-k Pallas kernel (interpret mode on CPU)
 # --------------------------------------------------------------------- #
+# interpret-mode executions of the while-loop running-select kernels
+# cost ~15s per call flat (the gate loop dispatches its lane networks
+# eagerly), so the full matrices are opt-in; the fast tier-1 parity
+# coverage for these kernels lives in tests/test_fused_kernels.py
+@pytest.mark.slow
 @pytest.mark.parametrize("n,nq,d,k", [
     (300, 17, 13, 5),         # sub-tile everything, odd sizes
     (3000, 33, 128, 100),     # multi index tile, kpad==128, north-star k
@@ -220,6 +225,7 @@ def test_fused_knn_tile_exact(rng, n, nq, d, k):
     assert (np.asarray(idx) >= 0).all() and (np.asarray(idx) < n).all()
 
 
+@pytest.mark.slow
 def test_fused_knn_tile_duplicate_rows(rng):
     """Duplicate points produce exact-tie distances; the selected set must
     still be a valid kNN set (no id duplicated within a row)."""
@@ -235,6 +241,7 @@ def test_fused_knn_tile_duplicate_rows(rng):
     np.testing.assert_allclose(np.asarray(dist)[:, :3], 0.0, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_knn_tile_merge_impls_agree(rng):
     """The log2-stage bitonic-merge tail ("merge", default) and the
     full log^2 sort of the concatenation ("fullsort") are two networks
@@ -264,8 +271,11 @@ def test_fused_knn_tile_merge_impls_agree(rng):
                     sorted(r % 150 for r in row_f)
 
 
+@pytest.mark.slow
 def test_fused_l2_knn_impl_dispatch(rng):
-    """impl="pallas" and impl="xla" agree through the public entry."""
+    """impl="pallas" and impl="xla" agree through the public entry
+    (~15s: the pallas arm executes interpreted off-TPU; the fast
+    xla_fused twin's dispatch is covered in tests/test_fused_kernels.py)."""
     index = rng.standard_normal((600, 32)).astype(np.float32)
     queries = rng.standard_normal((41, 32)).astype(np.float32)
     d_x, i_x = fused_l2_knn(jnp.asarray(index), jnp.asarray(queries), 9,
@@ -500,6 +510,10 @@ class TestSelectKImpl:
         got = np.take_along_axis(np.asarray(keys), i_c[:, :60], 1)
         np.testing.assert_allclose(got, np.asarray(d_c)[:, :60], atol=1e-6)
 
+    # select_tile interpret-mode executions cost ~15s per call flat
+    # (module comment at test_fused_knn_tile_exact); the tier-1 fast
+    # coverage is tests/test_fused_kernels.py + the lowering suite
+    @pytest.mark.slow
     @pytest.mark.parametrize("m,n,k", [
         (32, 4096, 16), (7, 8192, 100), (5, 1000, 3),   # ragged width
         (3, 257, 100),                                   # w barely > 2k
@@ -522,6 +536,7 @@ class TestSelectKImpl:
                                    atol=1e-6)
         assert np.asarray(i_p).min() >= 0
 
+    @pytest.mark.slow
     def test_pallas_select_max_and_payload(self):
         rng = np.random.default_rng(5)
         keys = jnp.asarray(rng.standard_normal((6, 2000)), jnp.float32)
@@ -536,6 +551,7 @@ class TestSelectKImpl:
                                    atol=1e-6)
         np.testing.assert_array_equal(np.asarray(v_p), np.asarray(v_t))
 
+    @pytest.mark.slow
     def test_pallas_deficit_rows_stay_in_range(self):
         """Rows with fewer than k finite keys: +inf fills the deficit
         and ids stay in range (the kernel's -1 sentinel must be
@@ -557,6 +573,7 @@ class TestSelectKImpl:
         np.testing.assert_allclose(got, np.asarray(d_p)[:, :40],
                                    atol=1e-6)
 
+    @pytest.mark.slow
     def test_pallas_duplicate_ties_no_id_reuse(self):
         """Exact-tie keys: the selected id set must not repeat an id."""
         rng = np.random.default_rng(7)
@@ -574,6 +591,7 @@ class TestSelectKImpl:
         with pytest.raises(Exception, match="128"):
             select_k(jnp.ones((2, 600)), 200, impl="pallas")
 
+    @pytest.mark.slow
     def test_pallas_randomized_geometry_sweep(self):
         """Seeded fuzz over (m, w, k, block) geometry: the kernel's
         padding/grouping rules must hold at arbitrary ragged shapes,
@@ -689,6 +707,7 @@ class TestRerank:
     (3000, 33, 128, 100),     # multi index tile, north-star k
     (2500, 24, 64, 10),
 ])
+@pytest.mark.slow
 def test_fused_knn_twophase_exact(rng, n, nq, d, k):
     """No-carry two-phase kernel (r5): per-tile select + XLA merge must
     match the naive reference exactly (interpret mode on CPU)."""

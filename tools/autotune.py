@@ -84,6 +84,22 @@ def catalog(smoke: bool):
              {"n": 2048, "k": 8}, {"nq": 32, "d": 16}),
             ("fused_knn_tile", "knn_tile_merge", "ktile2k_smoke",
              {"n": 2048, "k": 8}, {"nq": 32, "d": 16}),
+            # Pallas block-shape cells: legal to sweep on EVERY backend
+            # (the ladder drives the fast XLA twin's geometry off-TPU),
+            # so the CPU smoke path always exercises at least one
+            # Pallas cell and the TPU sweep path can't rot here.  The
+            # builders run one untimed interpreted-kernel parity check
+            # per cell off-TPU (interpreted Pallas never in the timing
+            # loop — it is ~1000x slow).
+            ("fused_knn_tile", "knn_block_q", "blkq2k_smoke",
+             {"n": 2048, "k": 8, "d": 16}, {"nq": 32}),
+            ("fused_knn_tile", "knn_block_n", "blkn2k_smoke",
+             {"n": 2048, "k": 8, "d": 16}, {"nq": 32}),
+            ("fused_nn_tile", "nn_block_n", "nnblk2k_smoke",
+             {"n": 2048, "d": 16}, {"nq": 32}),
+            ("ivf_flat_search", "ivf_scan_impl", "ivf1k_smoke",
+             {"n": 1024, "k": 8, "d": 16},
+             {"nlist": 8, "nprobe": 4, "nq": 16}),
             ("csr_spmv", "spmv_impl", "spmv4k_smoke",
              {"rows": 4096, "nnz": 32768}, {}),
             ("ivf_pq_search", "pq_adc", "pq2k_smoke",
@@ -105,6 +121,19 @@ def catalog(smoke: bool):
          {"n": 20000, "k": 32}, {"nq": 128, "d": 64}),
         ("fused_knn_tile", "knn_tile_merge", "ktile20k",
          {"n": 20000, "k": 32}, {"nq": 128, "d": 64}),
+        # block-shape ladders (integer knobs): timed through the fused
+        # Pallas kernel on TPU and the xla_fused reference off-TPU —
+        # the SAME block geometry drives both, so every venue gets
+        # real timings (interpreted Pallas is never in the loop)
+        ("fused_knn_tile", "knn_block_q", "blkq20k",
+         {"n": 20000, "k": 32, "d": 64}, {"nq": 128}),
+        ("fused_knn_tile", "knn_block_n", "blkn20k",
+         {"n": 20000, "k": 32, "d": 64}, {"nq": 128}),
+        ("fused_nn_tile", "nn_block_n", "nnblk20k",
+         {"n": 20000, "d": 64}, {"nq": 128}),
+        ("ivf_flat_search", "ivf_scan_impl", "ivf32k",
+         {"n": 32768, "k": 10, "d": 64},
+         {"nlist": 64, "nprobe": 8, "nq": 128}),
         ("csr_spmv", "spmv_impl", "spmv200k",
          {"rows": 200000, "nnz": 2000000}, {}),
         ("ivf_pq_search", "pq_adc", "pq32k",
@@ -204,6 +233,107 @@ def _build_fused_knn_tile(dims, extra, cell):
     return make
 
 
+def _parity_or_die(got, want, what):
+    import numpy as np
+
+    gd, gi = got
+    wd, wi = want
+    if not (np.array_equal(np.asarray(gi), np.asarray(wi))
+            and np.allclose(np.asarray(gd), np.asarray(wd))):
+        raise AssertionError(
+            "autotune %s: interpreted kernel disagrees with the timed "
+            "reference — the sweep would persist a shape the kernel "
+            "does not honor" % what)
+
+
+def _build_knn_block(knob_kw):
+    """Builder factory for the knn_block_q / knn_block_n integer
+    ladders.  On TPU the candidate block shape is timed through the
+    fused Pallas kernel; off-TPU through :func:`fused_knn_xla`, whose
+    tile geometry the SAME knob drives — so the sweep times a real
+    executable on every backend and interpreted Pallas stays out of
+    the timing loop.  Small off-TPU cells additionally run ONE untimed
+    interpreted-kernel execution at cell-build time, checked against
+    the fast XLA twin (distances exact, ids equal on distinct
+    distances) — the CPU smoke sweep exercises the kernel code path
+    itself, so the TPU sweep can't rot on this box."""
+    def build(dims, extra, cell):
+        import jax
+
+        from raft_tpu.core.utils import is_tpu_backend
+        from raft_tpu.ops.knn_tile import fused_knn_tile, fused_knn_xla
+
+        x = _jnp(_rand((dims["n"], dims["d"])))
+        q = _jnp(_rand((extra["nq"], dims["d"]), seed=1))
+        k = dims["k"]
+        on_tpu = is_tpu_backend()
+        if not on_tpu and dims["n"] <= 2048:
+            _parity_or_die(fused_knn_tile(x, q, k, interpret=True),
+                           fused_knn_xla(x, q, k),
+                           "fused_knn_tile[%s]" % cell)
+
+        def make(cand):
+            kw = {knob_kw: int(cand)}
+            if on_tpu:
+                return lambda: jax.block_until_ready(
+                    fused_knn_tile(x, q, k, **kw))
+            return lambda: jax.block_until_ready(
+                fused_knn_xla(x, q, k, **kw))
+        return make
+    return build
+
+
+def _build_nn_block(dims, extra, cell):
+    """nn_block_n ladder: fused Pallas NN kernel on TPU; off-TPU the
+    candidate drives ``tile_n`` of the XLA scan fallback
+    (:func:`fused_l2_nn_min_reduce`) — the same index-tile-width role,
+    a real timeable executable.  Small off-TPU cells run one untimed
+    interpreted-kernel agreement check at cell-build time."""
+    import jax
+
+    from raft_tpu.core.utils import is_tpu_backend
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
+    from raft_tpu.ops.nn_tile import fused_nn_tile
+
+    x = _jnp(_rand((extra["nq"], dims["d"])))
+    y = _jnp(_rand((dims["n"], dims["d"]), seed=1))
+    on_tpu = is_tpu_backend()
+    if not on_tpu and dims["n"] <= 2048:
+        _parity_or_die(fused_nn_tile(x, y, interpret=True),
+                       fused_l2_nn_min_reduce(x, y),
+                       "fused_nn_tile[%s]" % cell)
+
+    def make(cand):
+        if on_tpu:
+            return lambda: jax.block_until_ready(
+                fused_nn_tile(x, y, block_n=int(cand)))
+        return lambda: jax.block_until_ready(
+            fused_l2_nn_min_reduce(x, y, tile_n=int(cand)))
+    return make
+
+
+def _build_ivf_flat_search(dims, extra, cell):
+    import jax
+
+    from raft_tpu.spatial.ann import IVFFlatParams, ivf_flat_build, \
+        ivf_flat_search
+
+    x = _rand((dims["n"], dims["d"]))
+    q = _jnp(_rand((extra["nq"], dims["d"]), seed=1))
+    params = IVFFlatParams(nlist=extra["nlist"],
+                           nprobe=extra["nprobe"])
+    index = ivf_flat_build(_jnp(x), params)
+    k = dims["k"]
+
+    def make(cand):
+        # scan_impl is a trace-time static: each candidate compiles
+        # its own executable (warmup call pays that, per the
+        # time_candidate contract)
+        return lambda: jax.block_until_ready(
+            ivf_flat_search(index, q, k, scan_impl=cand))
+    return make
+
+
 def _build_csr_spmv(dims, extra, cell):
     import jax
     import numpy as np
@@ -280,10 +410,22 @@ BUILDERS = {
     "tiled_knn": _build_tiled_knn,
     "fused_l2_knn": _build_fused_l2_knn,
     "fused_knn_tile": _build_fused_knn_tile,
+    # knob-keyed entries take precedence over the op key: multi-knob
+    # ops (fused_knn_tile sweeps a merge impl AND two block ladders)
+    # need per-knob workloads
+    ("fused_knn_tile", "knn_block_q"): _build_knn_block("block_q"),
+    ("fused_knn_tile", "knn_block_n"): _build_knn_block("block_n"),
+    "fused_nn_tile": _build_nn_block,
+    "ivf_flat_search": _build_ivf_flat_search,
     "csr_spmv": _build_csr_spmv,
     "ivf_pq_search": _build_ivf_pq_search,
     "mnmg_knn": _build_mnmg_knn,
 }
+
+
+def _builder(op, knob):
+    """Knob-keyed builder when registered, else the op's builder."""
+    return BUILDERS.get((op, knob)) or BUILDERS[op]
 
 
 # --------------------------------------------------------------------- #
@@ -377,7 +519,7 @@ def sweep_cell(op, knob, cell_name, dims, extra, *, iters,
     skipped = {c: why for c, why in cands if why is not None}
     if not legal:
         return None
-    make = BUILDERS[op](dims, extra, cell_name)
+    make = _builder(op, knob)(dims, extra, cell_name)
     timings, compiles = {}, {}
     for cand in legal:
         t, extra_compiles = time_candidate(
@@ -563,8 +705,8 @@ def tuned_vs_default(table, *, iters=5, log=print):
             cell_r["ratio"] = 1.0
             cell_r["note"] = "winner is the default"
         else:
-            make = BUILDERS[e["op"]](e["dims"], e.get("extra", {}),
-                                     e["cell"] + "_ab")
+            make = _builder(e["op"], e["knob"])(
+                e["dims"], e.get("extra", {}), e["cell"] + "_ab")
             tw, td, compiles = _time_ab(
                 make(e["winner"]), make(default), iters=iters,
                 op=e["op"], cell=e["cell"] + "_ab",
